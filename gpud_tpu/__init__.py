@@ -1,0 +1,11 @@
+"""gpud_tpu — ``tpud``: a TPU-native fleet-health monitoring daemon.
+
+A ground-up re-design of the capability surface of leptonai/gpud
+(reference mounted at /root/reference) for TPU fleets: libtpu/tpu-info/ICI
+in place of NVML/NVLink/InfiniBand, with a JAX/Pallas analytics path for
+on-chip telemetry scanning (models/, ops/, parallel/).
+"""
+
+from gpud_tpu.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,338 @@
+"""Component model and registry.
+
+The component model is the heart of the daemon: every health check is a
+``Component`` that the registry owns and the server/scan paths drive
+(reference: components/types.go:20-107, components/registry.go:24-226).
+
+Design notes (TPU edition):
+- ``TpudInstance`` is the dependency-injection container handed to every
+  component constructor (reference: components/registry.go:24-104 GPUdInstance).
+- ``PollingComponent`` implements the shared 1-minute self-ticker pattern
+  (reference: components/accelerator/nvidia/temperature/component.go:81-97) so
+  concrete components only implement ``check_once``.
+- A component's externals are function-valued attributes so tests can swap
+  them without mocking frameworks (reference test strategy, SURVEY §4.1).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import traceback
+from typing import Callable, Dict, List, Optional, TYPE_CHECKING
+
+from gpud_tpu.api.v1.types import (
+    Event,
+    HealthState,
+    HealthStateType,
+    SuggestedActions,
+)
+from gpud_tpu.log import get_logger
+
+if TYPE_CHECKING:  # avoid import cycles at runtime
+    from gpud_tpu.eventstore import EventStore
+    from gpud_tpu.host import RebootEventStore
+    from gpud_tpu.tpu.instance import TPUInstance
+
+logger = get_logger(__name__)
+
+DEFAULT_POLL_INTERVAL = 60.0  # seconds (reference: temperature/component.go:83)
+
+
+class AlreadyRegisteredError(Exception):
+    pass
+
+
+class FailureInjector:
+    """Test-only failure injection knobs threaded through TpudInstance
+    (reference: components/registry.go:77-104)."""
+
+    def __init__(
+        self,
+        chip_ids_lost: Optional[List[int]] = None,
+        chip_ids_requires_reset: Optional[List[int]] = None,
+        chip_ids_hbm_ecc_pending: Optional[List[int]] = None,
+        chip_ids_thermal_slowdown: Optional[List[int]] = None,
+        ici_links_down: Optional[List[str]] = None,
+        tpu_enumeration_error: bool = False,
+        product_name_override: str = "",
+    ) -> None:
+        self.chip_ids_lost = chip_ids_lost or []
+        self.chip_ids_requires_reset = chip_ids_requires_reset or []
+        self.chip_ids_hbm_ecc_pending = chip_ids_hbm_ecc_pending or []
+        self.chip_ids_thermal_slowdown = chip_ids_thermal_slowdown or []
+        self.ici_links_down = ici_links_down or []
+        self.tpu_enumeration_error = tpu_enumeration_error
+        self.product_name_override = product_name_override
+
+    def empty(self) -> bool:
+        return not (
+            self.chip_ids_lost
+            or self.chip_ids_requires_reset
+            or self.chip_ids_hbm_ecc_pending
+            or self.chip_ids_thermal_slowdown
+            or self.ici_links_down
+            or self.tpu_enumeration_error
+            or self.product_name_override
+        )
+
+
+class TpudInstance:
+    """DI container for component constructors
+    (reference: components/registry.go:24-104)."""
+
+    def __init__(
+        self,
+        machine_id: str = "",
+        tpu_instance: Optional["TPUInstance"] = None,
+        db_rw=None,
+        db_ro=None,
+        event_store: Optional["EventStore"] = None,
+        reboot_event_store: Optional["RebootEventStore"] = None,
+        mount_points: Optional[List[str]] = None,
+        mount_targets: Optional[List[str]] = None,
+        kernel_modules_to_check: Optional[List[str]] = None,
+        kmsg_path: str = "",
+        failure_injector: Optional[FailureInjector] = None,
+        config=None,
+    ) -> None:
+        self.machine_id = machine_id
+        self.tpu_instance = tpu_instance
+        self.db_rw = db_rw
+        self.db_ro = db_ro
+        self.event_store = event_store
+        self.reboot_event_store = reboot_event_store
+        self.mount_points = mount_points or []
+        self.mount_targets = mount_targets or []
+        self.kernel_modules_to_check = kernel_modules_to_check or []
+        self.kmsg_path = kmsg_path
+        self.failure_injector = failure_injector
+        self.config = config
+
+
+class CheckResult:
+    """Result of one component check (reference: components/types.go:85-101).
+
+    Concrete components may subclass to attach structured payloads; the base
+    carries the health state list which is all the server needs.
+    """
+
+    def __init__(
+        self,
+        component_name: str,
+        health: str = HealthStateType.HEALTHY,
+        reason: str = "",
+        error: str = "",
+        suggested_actions: Optional[SuggestedActions] = None,
+        extra_info: Optional[Dict[str, str]] = None,
+        component_type: str = "",
+        run_mode: str = "",
+        raw_output: str = "",
+        states: Optional[List[HealthState]] = None,
+    ) -> None:
+        self._component_name = component_name
+        self.health = health
+        self.reason = reason
+        self.error = error
+        self.suggested_actions = suggested_actions
+        self.extra_info = extra_info or {}
+        self.component_type = component_type
+        self.run_mode = run_mode
+        self.raw_output = raw_output
+        self.time = time.time()
+        self._states = states
+
+    def component_name(self) -> str:
+        return self._component_name
+
+    def summary(self) -> str:
+        return self.reason or ("ok" if self.health == HealthStateType.HEALTHY else self.health)
+
+    def health_state_type(self) -> str:
+        return self.health
+
+    def health_states(self) -> List[HealthState]:
+        if self._states is not None:
+            return list(self._states)
+        return [
+            HealthState(
+                time=self.time,
+                component=self._component_name,
+                component_type=self.component_type,
+                name=self._component_name,
+                run_mode=self.run_mode,
+                health=self.health,
+                reason=self.reason,
+                error=self.error,
+                suggested_actions=self.suggested_actions,
+                extra_info=dict(self.extra_info),
+                raw_output=self.raw_output,
+            )
+        ]
+
+    def __str__(self) -> str:
+        return self.summary()
+
+
+class Component:
+    """Base component (reference: components/types.go:20-67).
+
+    Subclasses must set ``NAME`` and implement ``check_once() -> CheckResult``.
+    Optional capabilities mirror the reference's optional interfaces:
+    ``can_deregister()`` (Deregisterable), ``set_healthy()`` (HealthSettable).
+    """
+
+    NAME = ""
+    TAGS: List[str] = []
+
+    def __init__(self, instance: TpudInstance) -> None:
+        self.instance = instance
+        self._last_mu = threading.Lock()
+        self._last_check_result: Optional[CheckResult] = None
+
+    # -- identity ----------------------------------------------------------
+    def name(self) -> str:
+        return self.NAME
+
+    def tags(self) -> List[str]:
+        return list(self.TAGS)
+
+    def is_supported(self) -> bool:
+        return True
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Called at server start; spawn pollers here."""
+
+    def close(self) -> None:
+        """Called at server shutdown."""
+
+    # -- checking ----------------------------------------------------------
+    def check_once(self) -> CheckResult:
+        raise NotImplementedError
+
+    def check(self) -> CheckResult:
+        """Run the check, trapping exceptions into an Unhealthy result so a
+        crashing data source never takes the poller loop down."""
+        try:
+            cr = self.check_once()
+        except Exception as e:  # noqa: BLE001 — health checks must not raise
+            logger.exception("component %s check failed", self.NAME)
+            cr = CheckResult(
+                component_name=self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=f"check failed: {e}",
+                error=traceback.format_exc(limit=5),
+            )
+        with self._last_mu:
+            self._last_check_result = cr
+        return cr
+
+    def last_health_states(self) -> List[HealthState]:
+        """Latest cached health states; Healthy-by-default before first check
+        (reference: components/types.go:54-58)."""
+        with self._last_mu:
+            cr = self._last_check_result
+        if cr is None:
+            return [
+                HealthState(
+                    component=self.NAME,
+                    name=self.NAME,
+                    health=HealthStateType.INITIALIZING,
+                    reason="no check performed yet",
+                )
+            ]
+        return cr.health_states()
+
+    def events(self, since: float) -> List[Event]:
+        return []
+
+    # -- optional capabilities --------------------------------------------
+    def can_deregister(self) -> bool:
+        return False
+
+
+class PollingComponent(Component):
+    """Component with the shared periodic-check goroutine pattern
+    (reference: components/accelerator/nvidia/temperature/component.go:81-97).
+
+    ``time_now_fn`` / ``sleep interval`` are injectable for tests.
+    """
+
+    POLL_INTERVAL = DEFAULT_POLL_INTERVAL
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self._stop_event = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.time_now_fn: Callable[[], float] = time.time
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop_event.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"tpud-poll-{self.NAME}", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        # first check runs inside the poller thread so a hung data source
+        # can never wedge daemon startup (reference runs the initial Check in
+        # the spawned goroutine, temperature/component.go:81-97)
+        self.check()
+        while not self._stop_event.wait(self.POLL_INTERVAL):
+            self.check()
+
+    def close(self) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+InitFunc = Callable[[TpudInstance], Component]
+
+
+class Registry:
+    """Thread-safe name→Component registry
+    (reference: components/registry.go:106-226)."""
+
+    def __init__(self, instance: TpudInstance) -> None:
+        self._mu = threading.RLock()
+        self._instance = instance
+        self._components: Dict[str, Component] = {}
+
+    def must_register(self, init_func: InitFunc) -> Component:
+        c, err = self.register(init_func)
+        if err is not None:
+            raise err
+        assert c is not None
+        return c
+
+    def register(self, init_func: InitFunc):
+        try:
+            c = init_func(self._instance)
+        except Exception as e:  # noqa: BLE001
+            return None, e
+        with self._mu:
+            if c.name() in self._components:
+                return None, AlreadyRegisteredError(c.name())
+            self._components[c.name()] = c
+        return c, None
+
+    def all(self) -> List[Component]:
+        with self._mu:
+            return [self._components[k] for k in sorted(self._components)]
+
+    def get(self, name: str) -> Optional[Component]:
+        with self._mu:
+            return self._components.get(name)
+
+    def deregister(self, name: str) -> Optional[Component]:
+        with self._mu:
+            return self._components.pop(name, None)
+
+    def names(self) -> List[str]:
+        with self._mu:
+            return sorted(self._components)

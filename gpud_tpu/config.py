@@ -1,0 +1,113 @@
+"""Daemon configuration.
+
+Mirrors the reference's config surface (reference: pkg/config/config.go:17-130,
+pkg/config/default.go:15-34,137-157): defaults of port 15132 (we keep the same
+port so tooling carries over), data dir /var/lib/tpud (or ~/.tpud when not
+root), metrics retention 3h, events retention 14d, compact disabled.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+DEFAULT_PORT = 15132                     # reference: pkg/config/default.go
+DEFAULT_METRICS_RETENTION = 3 * 3600     # 3h  (reference: default.go:26)
+DEFAULT_EVENTS_RETENTION = 14 * 86400    # 14d (reference: default.go:28)
+DEFAULT_POLL_INTERVAL = 60               # 1m component cadence
+DEFAULT_SCRAPE_INTERVAL = 60             # 1m metrics syncer
+DEFAULT_RECORDER_INTERVAL = 15 * 60      # 15m self-metrics recorder
+DEFAULT_SESSION_PIPE_INTERVAL = 3        # 3s (reference: server.go:616)
+
+STATE_FILE = "tpud.state"                # reference: default.go:137-157 (gpud.state)
+FIFO_FILE = "tpud.fifo"
+PACKAGES_DIR = "packages"
+TARGET_VERSION_FILE = "target_version"
+PLUGIN_SPECS_FILE = "plugins.yaml"
+LOG_FILE = "tpud.log"
+AUDIT_LOG_FILE = "tpud.audit.log"
+
+
+def resolve_data_dir(data_dir: str = "") -> str:
+    """Reference: pkg/config ResolveDataDir — /var/lib/gpud for root,
+    ~/.gpud otherwise."""
+    if data_dir:
+        return data_dir
+    if os.environ.get("TPUD_DATA_DIR"):
+        return os.environ["TPUD_DATA_DIR"]
+    if hasattr(os, "geteuid") and os.geteuid() == 0:
+        return "/var/lib/tpud"
+    return os.path.expanduser("~/.tpud")
+
+
+@dataclass
+class Config:
+    port: int = DEFAULT_PORT
+    data_dir: str = ""
+    db_in_memory: bool = False           # reference: pkg/server/server.go:132-154
+    metrics_retention_seconds: int = DEFAULT_METRICS_RETENTION
+    events_retention_seconds: int = DEFAULT_EVENTS_RETENTION
+    poll_interval_seconds: int = DEFAULT_POLL_INTERVAL
+    scrape_interval_seconds: int = DEFAULT_SCRAPE_INTERVAL
+    compact_period_seconds: int = 0      # 0 = disabled (reference default)
+    enable_auto_update: bool = True
+    endpoint: str = ""                   # control-plane endpoint
+    token: str = ""
+    machine_id: str = ""
+    components_enabled: List[str] = field(default_factory=list)   # empty = all
+    components_disabled: List[str] = field(default_factory=list)
+    kernel_modules_to_check: List[str] = field(default_factory=list)
+    mount_points: List[str] = field(default_factory=list)
+    mount_targets: List[str] = field(default_factory=list)
+    expected_chip_count: int = 0         # 0 = derive from accelerator type
+    accelerator_type_override: str = ""
+    kmsg_path: str = ""                  # empty = /dev/kmsg (or TPUD_KMSG_FILE_PATH)
+    plugin_specs_file: str = ""
+    pprof: bool = False
+    log_level: str = "info"
+    log_file: str = ""
+    audit_log_file: str = ""
+    tls: bool = True
+    # failure injection (hidden flags in the reference, command.go:345-410)
+    inject: Dict[str, str] = field(default_factory=dict)
+
+    def resolved_data_dir(self) -> str:
+        return resolve_data_dir(self.data_dir)
+
+    def state_file(self) -> str:
+        if self.db_in_memory:
+            return ":memory:"
+        return os.path.join(self.resolved_data_dir(), STATE_FILE)
+
+    def fifo_file(self) -> str:
+        return os.path.join(self.resolved_data_dir(), FIFO_FILE)
+
+    def packages_dir(self) -> str:
+        return os.path.join(self.resolved_data_dir(), PACKAGES_DIR)
+
+    def target_version_file(self) -> str:
+        return os.path.join(self.resolved_data_dir(), TARGET_VERSION_FILE)
+
+    def resolved_plugin_specs_file(self) -> str:
+        return self.plugin_specs_file or os.path.join(
+            self.resolved_data_dir(), PLUGIN_SPECS_FILE
+        )
+
+    def validate(self) -> Optional[str]:
+        if not (0 < self.port < 65536):
+            return f"invalid port {self.port}"
+        if self.metrics_retention_seconds < 60:
+            return "metrics retention must be >= 60s"
+        if self.events_retention_seconds < 60:
+            return "events retention must be >= 60s"
+        return None
+
+
+def default_config(**overrides) -> Config:
+    cfg = Config()
+    for k, v in overrides.items():
+        if not hasattr(cfg, k):
+            raise AttributeError(f"unknown config field: {k}")
+        setattr(cfg, k, v)
+    return cfg

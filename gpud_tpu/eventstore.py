@@ -1,0 +1,190 @@
+"""Event store: per-component event buckets in one SQLite DB.
+
+Reference: pkg/eventstore/database.go:18-90, pkg/eventstore/types.go:55-70.
+Schema columns timestamp/name/type/message/extra_info; retention purge runs
+at retention/5 intervals per bucket; buckets expose
+insert/find/get/latest/purge.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from gpud_tpu.api.v1.types import Event
+from gpud_tpu.log import get_logger
+from gpud_tpu.sqlite import DB
+
+logger = get_logger(__name__)
+
+
+def _row_to_event(component: str, row) -> Event:
+    """row = (timestamp, name, type, message, extra_info)."""
+    extra = {}
+    if len(row) > 4 and row[4]:
+        try:
+            extra = json.loads(row[4])
+        except ValueError:
+            extra = {}
+    return Event(
+        component=component, time=row[0], name=row[1], type=row[2],
+        message=row[3], extra_info=extra,
+    )
+
+
+TABLE = "tpud_events_v0_1"  # schema version in table name (reference: database.go:18)
+
+DEFAULT_RETENTION = 14 * 86400  # 14d (reference: pkg/config/default.go:28)
+
+
+class Bucket:
+    """Per-component view over the shared events table
+    (reference: pkg/eventstore/types.go:59-70)."""
+
+    def __init__(self, store: "EventStore", component: str) -> None:
+        self._store = store
+        self.component = component
+
+    def name(self) -> str:
+        return self.component
+
+    def insert(self, ev: Event) -> None:
+        self._store._insert(self.component, ev)
+
+    def find(self, ev: Event) -> Optional[Event]:
+        """Find an identical event (same time/name/type/message) — used for
+        dedupe before insert (reference: xid/component.go:545-570)."""
+        return self._store._find(self.component, ev)
+
+    def get(self, since: float) -> List[Event]:
+        """All events at/after ``since``, newest first."""
+        return self._store._get(self.component, since)
+
+    def latest(self) -> Optional[Event]:
+        evs = self._store._get(self.component, 0.0, limit=1)
+        return evs[0] if evs else None
+
+    def purge(self, before: float) -> int:
+        return self._store._purge(self.component, before)
+
+    def close(self) -> None:
+        pass
+
+
+class EventStore:
+    """Reference: pkg/eventstore/database.go:71 New().
+
+    One store per daemon; buckets share the table keyed by component name.
+    A background purger per bucket runs at retention/5 cadence
+    (reference: database.go:85-90) — implemented as one shared thread to
+    keep thread count flat.
+    """
+
+    def __init__(self, db: DB, retention_seconds: int = DEFAULT_RETENTION) -> None:
+        self.db = db
+        self.retention_seconds = retention_seconds
+        self._buckets: Dict[str, Bucket] = {}
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._purger: Optional[threading.Thread] = None
+        self.time_now_fn = time.time
+        db.execute(
+            f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+                component TEXT NOT NULL,
+                timestamp REAL NOT NULL,
+                name TEXT NOT NULL,
+                type TEXT NOT NULL,
+                message TEXT,
+                extra_info TEXT
+            )"""
+        )
+        db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_comp_ts ON {TABLE} (component, timestamp)"
+        )
+
+    def bucket(self, component: str) -> Bucket:
+        with self._mu:
+            b = self._buckets.get(component)
+            if b is None:
+                b = Bucket(self, component)
+                self._buckets[component] = b
+            return b
+
+    # -- internal ops ------------------------------------------------------
+    def _insert(self, component: str, ev: Event) -> None:
+        extra = json.dumps(ev.extra_info, sort_keys=True) if ev.extra_info else ""
+        self.db.execute(
+            f"INSERT INTO {TABLE} (component, timestamp, name, type, message, extra_info) "
+            "VALUES (?, ?, ?, ?, ?, ?)",
+            (component, ev.time, ev.name, ev.type, ev.message, extra),
+        )
+
+    def _find(self, component: str, ev: Event) -> Optional[Event]:
+        row = self.db.query_one(
+            f"SELECT timestamp, name, type, message, extra_info FROM {TABLE} "
+            "WHERE component=? AND timestamp=? AND name=? AND type=? AND message=? LIMIT 1",
+            (component, ev.time, ev.name, ev.type, ev.message),
+        )
+        if row is None:
+            return None
+        return _row_to_event(component, row)
+
+    def _get(self, component: str, since: float, limit: int = 0) -> List[Event]:
+        sql = (
+            f"SELECT timestamp, name, type, message, extra_info FROM {TABLE} "
+            "WHERE component=? AND timestamp>=? ORDER BY timestamp DESC"
+        )
+        params: list = [component, since]
+        if limit:
+            sql += " LIMIT ?"
+            params.append(limit)
+        rows = self.db.query(sql, params)
+        return [_row_to_event(component, r) for r in rows]
+
+    def _purge(self, component: str, before: float) -> int:
+        cur = self.db.execute(
+            f"DELETE FROM {TABLE} WHERE component=? AND timestamp<?",
+            (component, before),
+        )
+        return cur.rowcount
+
+    def latest_events(self, since: float) -> Dict[str, List[Event]]:
+        rows = self.db.query(
+            f"SELECT component, timestamp, name, type, message, extra_info FROM {TABLE} "
+            "WHERE timestamp>=? ORDER BY timestamp DESC",
+            (since,),
+        )
+        out: Dict[str, List[Event]] = {}
+        for r in rows:
+            out.setdefault(r[0], []).append(_row_to_event(r[0], r[1:]))
+        return out
+
+    # -- retention ---------------------------------------------------------
+    def start_purger(self) -> None:
+        if self._purger is not None:
+            return
+        self._purger = threading.Thread(
+            target=self._purge_loop, name="tpud-eventstore-purger", daemon=True
+        )
+        self._purger.start()
+
+    def _purge_loop(self) -> None:
+        interval = max(60.0, self.retention_seconds / 5.0)  # reference: database.go:85-90
+        while not self._stop.wait(interval):
+            cutoff = self.time_now_fn() - self.retention_seconds
+            try:
+                n = self.db.execute(
+                    f"DELETE FROM {TABLE} WHERE timestamp<?", (cutoff,)
+                ).rowcount
+                if n:
+                    logger.info("eventstore purged %d events", n)
+            except Exception:  # noqa: BLE001
+                logger.exception("eventstore purge failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._purger is not None:
+            self._purger.join(timeout=2.0)
+            self._purger = None

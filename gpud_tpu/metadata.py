@@ -1,0 +1,57 @@
+"""Metadata key-value table.
+
+Reference: pkg/metadata/metadata.go:33-53 — persists machine_id, token,
+machine_proof, endpoint, public/private IP, node labels, login timestamp in
+the state DB so the daemon can resume its control-plane identity across
+restarts and reboots.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from gpud_tpu.sqlite import DB
+
+TABLE = "tpud_metadata_v0_1"
+
+# canonical keys (reference: pkg/metadata/metadata.go:33-53)
+KEY_MACHINE_ID = "machine_id"
+KEY_TOKEN = "token"
+KEY_MACHINE_PROOF = "machine_proof"
+KEY_ENDPOINT = "endpoint"
+KEY_PUBLIC_IP = "public_ip"
+KEY_PRIVATE_IP = "private_ip"
+KEY_NODE_LABELS = "node_labels"
+KEY_LOGIN_SUCCESS_TS = "login_success_ts"
+KEY_EXPECTED_CHIP_COUNT = "expected_chip_count"
+KEY_ACCELERATOR_TYPE = "accelerator_type"
+KEY_ICI_THRESHOLDS = "ici_thresholds"
+
+
+class Metadata:
+    def __init__(self, db: DB) -> None:
+        self.db = db
+        db.execute(
+            f"CREATE TABLE IF NOT EXISTS {TABLE} (key TEXT PRIMARY KEY, value TEXT)"
+        )
+
+    def get(self, key: str, default: str = "") -> str:
+        row = self.db.query_one(f"SELECT value FROM {TABLE} WHERE key=?", (key,))
+        return row[0] if row else default
+
+    def set(self, key: str, value: str) -> None:
+        self.db.execute(
+            f"INSERT INTO {TABLE} (key, value) VALUES (?, ?) "
+            "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+            (key, value),
+        )
+
+    def delete(self, key: str) -> None:
+        self.db.execute(f"DELETE FROM {TABLE} WHERE key=?", (key,))
+
+    def all(self) -> Dict[str, str]:
+        return {r[0]: r[1] for r in self.db.query(f"SELECT key, value FROM {TABLE}")}
+
+    def machine_id(self) -> Optional[str]:
+        v = self.get(KEY_MACHINE_ID)
+        return v or None

@@ -1,0 +1,32 @@
+"""Test configuration.
+
+JAX-dependent tests run on a virtual 8-device CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=8) so multi-chip sharding
+is exercised without TPU hardware, mirroring the reference's mock-NVML
+strategy of running "with GPUs" on GPU-less CI
+(reference: pkg/nvidia/nvml/lib/default.go:26-30).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# the mock TPU backend by default so every test runs on a CPU-only box
+# (reference: GPUD_NVML_MOCK_ALL_SUCCESS, SURVEY §4.3)
+os.environ.setdefault("TPUD_TPU_MOCK_ALL_SUCCESS", "1")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def tmp_db(tmp_path):
+    from gpud_tpu.sqlite import DB
+
+    db = DB(str(tmp_path / "state.db"))
+    yield db
+    db.close()

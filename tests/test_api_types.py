@@ -1,0 +1,85 @@
+"""Wire-type round-trip tests (reference test style: table-driven, colocated)."""
+
+from gpud_tpu.api.v1.types import (
+    ComponentHealthStates,
+    Event,
+    EventType,
+    HealthState,
+    HealthStateType,
+    MachineInfo,
+    Metric,
+    RepairActionType,
+    SuggestedActions,
+    TPUChipInfo,
+    TPUInfo,
+)
+
+
+def test_health_state_roundtrip():
+    hs = HealthState(
+        time=123.0,
+        component="cpu",
+        name="cpu",
+        health=HealthStateType.DEGRADED,
+        reason="high load",
+        suggested_actions=SuggestedActions(
+            description="reboot it",
+            repair_actions=[RepairActionType.REBOOT_SYSTEM],
+        ),
+        extra_info={"load": "12.3"},
+    )
+    d = hs.to_dict()
+    back = HealthState.from_dict(d)
+    assert back.component == "cpu"
+    assert back.health == "Degraded"
+    assert back.suggested_actions.repair_actions == ["REBOOT_SYSTEM"]
+    assert back.extra_info["load"] == "12.3"
+
+
+def test_health_state_raw_output_truncated():
+    hs = HealthState(raw_output="x" * 10000)
+    assert len(hs.raw_output) == HealthState.MAX_RAW_OUTPUT
+
+
+def test_event_type_from_string():
+    assert EventType.from_string("Fatal") == "Fatal"
+    assert EventType.from_string("bogus") == "Unknown"
+
+
+def test_event_roundtrip():
+    ev = Event(component="tpu", time=5.0, name="hbm-ecc", type=EventType.FATAL, message="m")
+    assert Event.from_dict(ev.to_dict()) == ev
+
+
+def test_metric_roundtrip():
+    m = Metric(unix_seconds=9, name="temp", labels={"chip": "0"}, value=45.5)
+    assert Metric.from_dict(m.to_dict()) == m
+
+
+def test_component_health_states_envelope():
+    env = ComponentHealthStates(component="disk", states=[HealthState(component="disk")])
+    back = ComponentHealthStates.from_dict(env.to_dict())
+    assert back.component == "disk"
+    assert len(back.states) == 1
+
+
+def test_machine_info_with_tpu_info():
+    mi = MachineInfo(
+        machine_id="m1",
+        hostname="h",
+        tpu_info=TPUInfo(
+            product="v5p",
+            accelerator_type="v5p-256",
+            topology="4x4x8",
+            chip_count=4,
+            chips=[TPUChipInfo(chip_id=0, device_path="/dev/accel0")],
+        ),
+    )
+    back = MachineInfo.from_dict(mi.to_dict())
+    assert back.tpu_info.accelerator_type == "v5p-256"
+    assert back.tpu_info.chips[0].device_path == "/dev/accel0"
+
+
+def test_machine_info_without_tpu():
+    back = MachineInfo.from_dict(MachineInfo(machine_id="m2").to_dict())
+    assert back.tpu_info is None

@@ -1,0 +1,91 @@
+import time
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components.base import (
+    AlreadyRegisteredError,
+    CheckResult,
+    Component,
+    PollingComponent,
+    Registry,
+    TpudInstance,
+)
+
+
+class GoodComp(Component):
+    NAME = "good"
+    TAGS = ["host"]
+
+    def check_once(self):
+        return CheckResult(self.NAME, reason="fine")
+
+
+class BadComp(Component):
+    NAME = "bad"
+
+    def check_once(self):
+        raise RuntimeError("boom")
+
+
+class TickComp(PollingComponent):
+    NAME = "tick"
+    POLL_INTERVAL = 0.05
+
+    def __init__(self, inst):
+        super().__init__(inst)
+        self.count = 0
+
+    def check_once(self):
+        self.count += 1
+        return CheckResult(self.NAME)
+
+
+def test_last_health_states_before_check():
+    c = GoodComp(TpudInstance())
+    states = c.last_health_states()
+    assert states[0].health == HealthStateType.INITIALIZING
+
+
+def test_check_caches_result():
+    c = GoodComp(TpudInstance())
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert c.last_health_states()[0].reason == "fine"
+
+
+def test_check_traps_exceptions():
+    c = BadComp(TpudInstance())
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "boom" in cr.summary()
+
+
+def test_polling_component_ticks_and_closes():
+    c = TickComp(TpudInstance())
+    c.start()
+    time.sleep(0.2)
+    c.close()
+    n = c.count
+    assert n >= 2  # immediate check + at least one tick
+    time.sleep(0.15)
+    assert c.count == n  # stopped
+
+
+def test_registry_register_and_dedupe():
+    reg = Registry(TpudInstance())
+    reg.must_register(GoodComp)
+    _, err = reg.register(GoodComp)
+    assert isinstance(err, AlreadyRegisteredError)
+    assert reg.get("good") is not None
+    assert reg.names() == ["good"]
+    assert reg.deregister("good").name() == "good"
+    assert reg.get("good") is None
+    assert reg.deregister("good") is None  # safe double-deregister
+
+
+def test_registry_init_error_returned():
+    def bad_init(_inst):
+        raise ValueError("nope")
+
+    reg = Registry(TpudInstance())
+    c, err = reg.register(bad_init)
+    assert c is None and isinstance(err, ValueError)

@@ -1,0 +1,47 @@
+from gpud_tpu.api.v1.types import Event, EventType
+from gpud_tpu.eventstore import EventStore
+
+
+def test_bucket_insert_get_latest(tmp_db):
+    es = EventStore(tmp_db)
+    b = es.bucket("tpu-errors")
+    b.insert(Event(time=10.0, name="e1", type=EventType.WARNING, message="w"))
+    b.insert(Event(time=20.0, name="e2", type=EventType.FATAL, message="f"))
+    evs = b.get(0.0)
+    assert [e.name for e in evs] == ["e2", "e1"]  # newest first
+    assert b.latest().name == "e2"
+    assert b.get(15.0)[0].name == "e2" and len(b.get(15.0)) == 1
+
+
+def test_bucket_find_for_dedupe(tmp_db):
+    es = EventStore(tmp_db)
+    b = es.bucket("x")
+    ev = Event(time=5.0, name="dup", type=EventType.INFO, message="m")
+    assert b.find(ev) is None
+    b.insert(ev)
+    assert b.find(ev) is not None
+
+
+def test_buckets_isolated(tmp_db):
+    es = EventStore(tmp_db)
+    es.bucket("a").insert(Event(time=1.0, name="ea"))
+    es.bucket("b").insert(Event(time=2.0, name="eb"))
+    assert [e.name for e in es.bucket("a").get(0)] == ["ea"]
+    assert [e.name for e in es.bucket("b").get(0)] == ["eb"]
+
+
+def test_purge(tmp_db):
+    es = EventStore(tmp_db)
+    b = es.bucket("p")
+    for t in (1.0, 2.0, 3.0):
+        b.insert(Event(time=t, name=f"e{t}"))
+    assert b.purge(2.5) == 2
+    assert len(b.get(0)) == 1
+
+
+def test_latest_events_grouped(tmp_db):
+    es = EventStore(tmp_db)
+    es.bucket("a").insert(Event(time=1.0, name="ea"))
+    es.bucket("b").insert(Event(time=2.0, name="eb"))
+    grouped = es.latest_events(0)
+    assert set(grouped) == {"a", "b"}

@@ -1,0 +1,78 @@
+import os
+
+from gpud_tpu import config as cfg
+from gpud_tpu.metadata import KEY_MACHINE_ID, KEY_TOKEN, Metadata
+from gpud_tpu.sqlite import DB, open_rw_ro, stats
+
+
+def test_metadata_set_get_delete(tmp_db):
+    md = Metadata(tmp_db)
+    assert md.machine_id() is None
+    md.set(KEY_MACHINE_ID, "m-123")
+    md.set(KEY_TOKEN, "t-1")
+    md.set(KEY_TOKEN, "t-2")  # upsert
+    assert md.machine_id() == "m-123"
+    assert md.get(KEY_TOKEN) == "t-2"
+    assert md.all() == {KEY_MACHINE_ID: "m-123", KEY_TOKEN: "t-2"}
+    md.delete(KEY_TOKEN)
+    assert md.get(KEY_TOKEN) == ""
+
+
+def test_sqlite_rw_ro_pair(tmp_path):
+    rw, ro = open_rw_ro(str(tmp_path / "s.db"))
+    rw.execute("CREATE TABLE t (x INTEGER)")
+    rw.execute("INSERT INTO t VALUES (7)")
+    assert ro.query_one("SELECT x FROM t")[0] == 7
+    try:
+        ro.execute("INSERT INTO t VALUES (8)")
+        raised = False
+    except Exception:
+        raised = True
+    assert raised  # RO handle refuses writes
+    rw.close()
+    ro.close()
+
+
+def test_sqlite_in_memory_shared():
+    db = DB(":memory:")
+    db.execute("CREATE TABLE t (x INTEGER)")
+    db.execute("INSERT INTO t VALUES (1)")
+    import threading
+
+    seen = []
+    t = threading.Thread(target=lambda: seen.append(db.query_one("SELECT x FROM t")))
+    t.start()
+    t.join()
+    assert seen[0][0] == 1
+
+
+def test_sqlite_compact_and_size(tmp_db):
+    tmp_db.execute("CREATE TABLE t (x TEXT)")
+    tmp_db.executemany("INSERT INTO t VALUES (?)", [("y" * 100,)] * 100)
+    assert tmp_db.size_bytes() > 0
+    assert tmp_db.compact() >= 0.0
+    assert stats()["vacuum_total"] >= 1
+
+
+def test_config_defaults_and_paths(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUD_DATA_DIR", str(tmp_path))
+    c = cfg.default_config()
+    assert c.port == 15132
+    assert c.metrics_retention_seconds == 3 * 3600
+    assert c.events_retention_seconds == 14 * 86400
+    assert c.validate() is None
+    assert c.state_file() == os.path.join(str(tmp_path), "tpud.state")
+    assert c.packages_dir().endswith("packages")
+    c2 = cfg.default_config(db_in_memory=True)
+    assert c2.state_file() == ":memory:"
+    bad = cfg.default_config(port=0)
+    assert bad.validate() is not None
+
+
+def test_config_unknown_override_rejected():
+    try:
+        cfg.default_config(bogus=1)
+        raised = False
+    except AttributeError:
+        raised = True
+    assert raised
