@@ -1,0 +1,246 @@
+"""tpud command-line interface.
+
+Reference: cmd/gpud/command/command.go:51-913 — subcommands up/down/run/
+scan/status/compact/inject-fault/set-healthy/metadata/update/release/... .
+This module grows with the build; each subcommand cites its reference
+analog.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from gpud_tpu import config as cfgmod
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.log import AuditLogger, set_audit_logger, setup as log_setup
+from gpud_tpu.version import __version__
+
+
+def _add_common_flags(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--data-dir", default="", help="state directory (default /var/lib/tpud or ~/.tpud)")
+    p.add_argument("--log-level", default="info")
+    p.add_argument("--kmsg-path", default="", help="override /dev/kmsg (or env TPUD_KMSG_FILE_PATH)")
+
+
+def _build_config(args) -> "cfgmod.Config":
+    cfg = cfgmod.default_config()
+    if getattr(args, "data_dir", ""):
+        cfg.data_dir = args.data_dir
+    if getattr(args, "kmsg_path", ""):
+        cfg.kmsg_path = args.kmsg_path
+    if getattr(args, "port", None):
+        cfg.port = args.port
+    if getattr(args, "db_in_memory", False):
+        cfg.db_in_memory = True
+    if getattr(args, "no_tls", False):
+        cfg.tls = False
+    if getattr(args, "accelerator_type", ""):
+        cfg.accelerator_type_override = args.accelerator_type
+    if getattr(args, "expected_chip_count", 0):
+        cfg.expected_chip_count = args.expected_chip_count
+    cfg.log_level = getattr(args, "log_level", "info")
+    return cfg
+
+
+def cmd_scan(args) -> int:
+    """Reference: cmd/gpud scan → pkg/scan/scan.go:33."""
+    import os
+
+    from gpud_tpu.scan import scan
+
+    if args.kmsg_path:
+        # scan-mode components resolve the kmsg path via the env override
+        os.environ["TPUD_KMSG_FILE_PATH"] = args.kmsg_path
+    results = scan(accelerator_type=args.accelerator_type)
+    unhealthy = [
+        r for r in results if r.health_state_type() != HealthStateType.HEALTHY
+    ]
+    return 1 if unhealthy and args.strict else 0
+
+
+def cmd_run(args) -> int:
+    """Reference: cmd/gpud run → pkg/server.New (SURVEY §3.1)."""
+    cfg = _build_config(args)
+    log_setup(cfg.log_level, cfg.log_file)
+    if cfg.audit_log_file:
+        set_audit_logger(AuditLogger(cfg.audit_log_file))
+
+    from gpud_tpu.server.server import Server
+
+    srv = Server(config=cfg)
+    srv.start()
+    print(f"tpud {__version__} listening on {srv.base_url()}", flush=True)
+
+    stop = {"flag": False}
+
+    def _sig(_s, _f):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.5)
+    finally:
+        srv.stop()
+    return 0
+
+
+def cmd_inject_fault(args) -> int:
+    """Reference: cmd/gpud inject-fault → pkg/fault-injector."""
+    from gpud_tpu.fault_injector import Injector, Request
+
+    req = Request(
+        tpu_error_name=args.name or "",
+        chip_id=args.chip_id,
+        detail=args.detail or "",
+        kernel_message=args.kernel_message or "",
+    )
+    inj = Injector(kmsg_path=args.kmsg_path)
+    err = inj.inject(req)
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    print("fault injected")
+    return 0
+
+
+def _client(args):
+    from gpud_tpu.client.v1 import Client
+
+    scheme = "http" if getattr(args, "no_tls", False) else "https"
+    return Client(base_url=f"{scheme}://localhost:{args.port}")
+
+
+def cmd_status(args) -> int:
+    """Reference: cmd/gpud status — queries the running daemon."""
+    try:
+        c = _client(args)
+        hz = c.healthz()
+        states = c.get_health_states()
+    except Exception as e:  # noqa: BLE001
+        print(f"tpud unreachable on port {args.port}: {e}", file=sys.stderr)
+        return 1
+    print(f"tpud {hz.get('version', '?')} healthy")
+    bad = 0
+    for comp in states:
+        for st in comp.states:
+            glyph = "✔" if st.health == HealthStateType.HEALTHY else "✘"
+            if st.health != HealthStateType.HEALTHY:
+                bad += 1
+            print(f"  {glyph} {comp.component}: {st.health} {st.reason}")
+    return 1 if bad else 0
+
+
+def cmd_compact(args) -> int:
+    """Reference: cmd/gpud compact (command.go:629) — offline VACUUM."""
+    from gpud_tpu.sqlite import DB
+
+    cfg = _build_config(args)
+    db = DB(cfg.state_file())
+    secs = db.compact()
+    print(f"compacted {cfg.state_file()} in {secs:.2f}s "
+          f"({db.size_bytes()} bytes)")
+    return 0
+
+
+def cmd_set_healthy(args) -> int:
+    try:
+        c = _client(args)
+        c.set_healthy(args.component)
+    except Exception as e:  # noqa: BLE001
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(f"set-healthy: {args.component}")
+    return 0
+
+
+def cmd_metadata(args) -> int:
+    """Reference: cmd/gpud metadata — dump the metadata table."""
+    from gpud_tpu.metadata import Metadata
+    from gpud_tpu.sqlite import DB
+
+    cfg = _build_config(args)
+    md = Metadata(DB(cfg.state_file()))
+    print(json.dumps(md.all(), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_machine_info(args) -> int:
+    from gpud_tpu.machine_info import get_machine_info
+    from gpud_tpu.tpu.instance import new_instance
+
+    mi = get_machine_info(tpu=new_instance(accelerator_type=args.accelerator_type))
+    print(json.dumps(mi.to_dict(), indent=2, sort_keys=True))
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpud", description="TPU fleet-health monitoring daemon"
+    )
+    p.add_argument("--version", action="version", version=f"tpud {__version__}")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("scan", help="one-shot health scan (no daemon)")
+    _add_common_flags(ps)
+    ps.add_argument("--accelerator-type", default="")
+    ps.add_argument("--strict", action="store_true", help="exit 1 on any unhealthy check")
+    ps.set_defaults(fn=cmd_scan)
+
+    pr = sub.add_parser("run", help="run the daemon")
+    _add_common_flags(pr)
+    pr.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
+    pr.add_argument("--db-in-memory", action="store_true")
+    pr.add_argument("--no-tls", action="store_true")
+    pr.add_argument("--accelerator-type", default="")
+    pr.add_argument("--expected-chip-count", type=int, default=0)
+    pr.set_defaults(fn=cmd_run)
+
+    pi = sub.add_parser("inject-fault", help="inject a synthetic fault via kmsg")
+    _add_common_flags(pi)
+    pi.add_argument("--name", help="catalogued TPU error name (e.g. tpu_hbm_ecc_uncorrectable)")
+    pi.add_argument("--chip-id", type=int, default=0)
+    pi.add_argument("--detail", default="")
+    pi.add_argument("--kernel-message", default="", help="raw kernel message instead of --name")
+    pi.set_defaults(fn=cmd_inject_fault)
+
+    pst = sub.add_parser("status", help="query the running daemon")
+    pst.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
+    pst.add_argument("--no-tls", action="store_true", help="daemon runs with --no-tls")
+    pst.set_defaults(fn=cmd_status)
+
+    pc = sub.add_parser("compact", help="VACUUM the state DB (daemon stopped)")
+    _add_common_flags(pc)
+    pc.set_defaults(fn=cmd_compact)
+
+    ph = sub.add_parser("set-healthy", help="clear a component's sticky state")
+    ph.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
+    ph.add_argument("--no-tls", action="store_true", help="daemon runs with --no-tls")
+    ph.add_argument("--component", required=True)
+    ph.set_defaults(fn=cmd_set_healthy)
+
+    pm = sub.add_parser("metadata", help="dump the metadata table")
+    _add_common_flags(pm)
+    pm.set_defaults(fn=cmd_metadata)
+
+    pmi = sub.add_parser("machine-info", help="print machine info JSON")
+    pmi.add_argument("--accelerator-type", default="")
+    pmi.set_defaults(fn=cmd_machine_info)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    log_setup(getattr(args, "log_level", "info"))
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

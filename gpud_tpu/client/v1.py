@@ -1,0 +1,156 @@
+"""Typed HTTP client for the tpud local API.
+
+Reference: client/v1/v1.go:23-543 — GetComponents/GetInfo/GetHealthStates/
+GetEvents/GetMetrics/Deregister/SetHealthy/TriggerCheck; used by the CLI
+subcommands and the e2e suite.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import requests
+import urllib3
+
+from gpud_tpu.api.v1.types import (
+    ComponentEvents,
+    ComponentHealthStates,
+    ComponentInfo,
+    ComponentMetrics,
+    MachineInfo,
+)
+
+# the local API uses a self-signed cert by design (reference: server.go:507)
+urllib3.disable_warnings(urllib3.exceptions.InsecureRequestWarning)
+
+DEFAULT_TIMEOUT = 30.0
+
+
+class ClientError(Exception):
+    def __init__(self, status: int, body: str) -> None:
+        super().__init__(f"HTTP {status}: {body[:200]}")
+        self.status = status
+        self.body = body
+
+
+class Client:
+    def __init__(
+        self,
+        base_url: str = "https://localhost:15132",
+        timeout: float = DEFAULT_TIMEOUT,
+        session: Optional[requests.Session] = None,
+    ) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.http = session or requests.Session()
+        self.http.verify = False
+        # REQUESTS_CA_BUNDLE/CURL_CA_BUNDLE in the environment would
+        # override verify=False on merge; the local API is always
+        # self-signed so ignore the environment entirely
+        self.http.trust_env = False
+
+    # -- plumbing ----------------------------------------------------------
+    def _req(self, method: str, path: str, params=None, body=None):
+        resp = self.http.request(
+            method,
+            self.base_url + path,
+            params=params,
+            json=body,
+            timeout=self.timeout,
+        )
+        if resp.status_code >= 400:
+            raise ClientError(resp.status_code, resp.text)
+        ctype = resp.headers.get("content-type", "")
+        if "json" in ctype:
+            return resp.json()
+        return resp.text
+
+    # -- API (reference: client/v1/v1.go) ---------------------------------
+    def healthz(self) -> Dict:
+        return self._req("GET", "/healthz")
+
+    def get_components(self) -> List[str]:
+        return self._req("GET", "/v1/components")
+
+    def deregister_component(self, name: str) -> Dict:
+        return self._req("DELETE", "/v1/components", params={"componentName": name})
+
+    def trigger_check(self, component: str = "", tag: str = "") -> List[ComponentHealthStates]:
+        params = {}
+        if component:
+            params["componentName"] = component
+        if tag:
+            params["tagName"] = tag
+        data = self._req("GET", "/v1/components/trigger-check", params=params)
+        return [ComponentHealthStates.from_dict(d) for d in data]
+
+    def set_healthy(self, component: str) -> Dict:
+        return self._req(
+            "POST", "/v1/components/set-healthy", params={"componentName": component}
+        )
+
+    def get_health_states(
+        self, components: Optional[List[str]] = None
+    ) -> List[ComponentHealthStates]:
+        params = {"components": ",".join(components)} if components else None
+        data = self._req("GET", "/v1/states", params=params)
+        return [ComponentHealthStates.from_dict(d) for d in data]
+
+    def get_events(
+        self,
+        start_time: Optional[float] = None,
+        end_time: Optional[float] = None,
+        components: Optional[List[str]] = None,
+    ) -> List[ComponentEvents]:
+        params = {}
+        if start_time is not None:
+            params["startTime"] = start_time
+        if end_time is not None:
+            params["endTime"] = end_time
+        if components:
+            params["components"] = ",".join(components)
+        data = self._req("GET", "/v1/events", params=params or None)
+        return [ComponentEvents.from_dict(d) for d in data]
+
+    def get_metrics(
+        self,
+        since: Optional[float] = None,
+        components: Optional[List[str]] = None,
+    ) -> List[ComponentMetrics]:
+        params = {}
+        if since is not None:
+            params["since"] = since
+        if components:
+            params["components"] = ",".join(components)
+        data = self._req("GET", "/v1/metrics", params=params or None)
+        return [ComponentMetrics.from_dict(d) for d in data]
+
+    def get_info(self, components: Optional[List[str]] = None) -> List[ComponentInfo]:
+        params = {"components": ",".join(components)} if components else None
+        data = self._req("GET", "/v1/info", params=params)
+        return [ComponentInfo.from_dict(d) for d in data]
+
+    def get_machine_info(self) -> MachineInfo:
+        return MachineInfo.from_dict(self._req("GET", "/machine-info"))
+
+    def get_prometheus_metrics(self) -> str:
+        return self._req("GET", "/metrics")
+
+    def inject_fault(
+        self,
+        tpu_error_name: str = "",
+        chip_id: int = 0,
+        detail: str = "",
+        kernel_message: str = "",
+    ) -> Dict:
+        return self._req(
+            "POST",
+            "/inject-fault",
+            body={
+                "tpu_error_name": tpu_error_name,
+                "chip_id": chip_id,
+                "detail": detail,
+                "kernel_message": kernel_message,
+            },
+        )

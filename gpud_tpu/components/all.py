@@ -1,0 +1,33 @@
+"""Ordered registration list of all built-in components
+(reference: components/all/all.go:56-90)."""
+
+from __future__ import annotations
+
+from typing import List
+
+from gpud_tpu.components.base import InitFunc
+from gpud_tpu.components.cpu import CPUComponent
+from gpud_tpu.components.disk import DiskComponent
+from gpud_tpu.components.memory import MemoryComponent
+from gpud_tpu.components.os_comp import OSComponent
+from gpud_tpu.components.tpu.chip_counts import TPUChipCountsComponent
+from gpud_tpu.components.tpu.error_kmsg import TPUErrorKmsgComponent
+from gpud_tpu.components.tpu.hbm import TPUHbmComponent
+from gpud_tpu.components.tpu.power import TPUPowerComponent
+from gpud_tpu.components.tpu.temperature import TPUTemperatureComponent
+
+
+def all_components() -> List[InitFunc]:
+    """Registration order mirrors dependency order: host basics first,
+    then accelerator components."""
+    return [
+        OSComponent,
+        CPUComponent,
+        MemoryComponent,
+        DiskComponent,
+        TPUChipCountsComponent,
+        TPUTemperatureComponent,
+        TPUHbmComponent,
+        TPUPowerComponent,
+        TPUErrorKmsgComponent,
+    ]

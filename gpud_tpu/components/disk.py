@@ -1,0 +1,82 @@
+"""Disk component (reference: components/disk — lsblk/findmnt/statfs usage
+with configurable mount points; we use psutil + statvfs which reads the
+same kernel sources without exec'ing external tools)."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List
+
+import psutil
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
+from gpud_tpu.metrics.registry import gauge
+
+NAME = "disk"
+
+_g_total = gauge("tpud_disk_total_bytes", "filesystem size")
+_g_used = gauge("tpud_disk_used_bytes", "filesystem used")
+_g_used_pct = gauge("tpud_disk_used_percent", "filesystem used percent")
+
+DEFAULT_USED_PCT_DEGRADED = 95.0
+
+_EPHEMERAL_FS = {"tmpfs", "devtmpfs", "overlay", "squashfs", "proc", "sysfs", "ramfs"}
+
+
+class DiskComponent(PollingComponent):
+    NAME = NAME
+    TAGS = ["host", "disk"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self.mount_points: List[str] = list(instance.mount_points)
+        self.mount_targets: List[str] = list(instance.mount_targets)
+        self.get_partitions_fn = psutil.disk_partitions
+        self.get_usage_fn = psutil.disk_usage
+
+    def _watched_mounts(self) -> Dict[str, str]:
+        """mount point → device; always includes '/', plus configured ones."""
+        mounts: Dict[str, str] = {}
+        try:
+            for p in self.get_partitions_fn(all=False):
+                if p.fstype in _EPHEMERAL_FS:
+                    continue
+                mounts[p.mountpoint] = p.device
+        except OSError:
+            pass
+        if "/" not in mounts:
+            mounts["/"] = "rootfs"
+        return mounts
+
+    def check_once(self) -> CheckResult:
+        missing = [p for p in self.mount_points if not os.path.isdir(p)]
+        missing += [p for p in self.mount_targets if not os.path.isdir(p)]
+
+        worst_pct = 0.0
+        extra: Dict[str, str] = {}
+        for mp in sorted(self._watched_mounts()):
+            try:
+                u = self.get_usage_fn(mp)
+            except OSError:
+                continue
+            labels = {"component": NAME, "mount_point": mp}
+            _g_total.set(u.total, labels)
+            _g_used.set(u.used, labels)
+            _g_used_pct.set(u.percent, labels)
+            extra[f"used_percent:{mp}"] = f"{u.percent:.1f}"
+            worst_pct = max(worst_pct, u.percent)
+
+        if missing:
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=f"mount point(s) missing: {', '.join(missing)}",
+                extra_info=extra,
+            )
+        health = HealthStateType.HEALTHY
+        reason = f"max filesystem usage {worst_pct:.1f}%"
+        if worst_pct >= DEFAULT_USED_PCT_DEGRADED:
+            health = HealthStateType.DEGRADED
+            reason = f"filesystem nearly full: {worst_pct:.1f}% used"
+        return CheckResult(self.NAME, health=health, reason=reason, extra_info=extra)

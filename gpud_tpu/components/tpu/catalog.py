@@ -1,0 +1,216 @@
+"""TPU driver/runtime kernel-error catalog.
+
+This is the TPU analog of the NVIDIA XID catalog (reference:
+components/accelerator/nvidia/xid/catalog_generated.go:1-30 — 94 codes with
+per-code severities, suggested actions and reboot thresholds, plus the
+NVSwitch SXid catalog). The reference's catalog is NVIDIA-documented; TPU
+driver error strings are not publicly catalogued the same way, so this
+catalog covers the observable classes of TPU-VM kernel/driver failures:
+
+- the Google accel/TPU driver (``accel``/``google_tpu``/gasket kmsg lines),
+- HBM ECC machine-check lines,
+- ICI (inter-chip interconnect) link state transitions,
+- PCIe AER errors on the TPU's root ports,
+- libtpu/runtime fatal lines forwarded to kmsg by the fault injector,
+- tpud's own canonical injection format ``TPU-ERR: <name> ...``
+  (pkg/fault-injector analog) so injected and organic faults share one
+  detection path.
+
+Each entry carries the per-error reboot threshold driving the
+reboot→HW-inspection escalation state machine
+(reference: xid/threshold.go + health_state.go:56-80).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Pattern
+
+from gpud_tpu.api.v1.types import EventType, RepairActionType
+
+
+@dataclass(frozen=True)
+class CatalogEntry:
+    code: int
+    name: str
+    pattern: Pattern
+    event_type: str
+    description: str
+    repair_actions: tuple = ()
+    # after this many reboots with the error recurring, escalate to
+    # HARDWARE_INSPECTION (reference: xid/threshold.go). 0 = never escalate.
+    reboot_threshold: int = 1
+    # does this error impact workloads (drives Unhealthy vs informational)?
+    critical: bool = True
+
+
+def _e(
+    code: int,
+    name: str,
+    regex: str,
+    event_type: str,
+    description: str,
+    repair: tuple,
+    reboot_threshold: int = 1,
+    critical: bool = True,
+) -> CatalogEntry:
+    return CatalogEntry(
+        code=code,
+        name=name,
+        pattern=re.compile(regex, re.IGNORECASE),
+        event_type=event_type,
+        description=description,
+        repair_actions=repair,
+        reboot_threshold=reboot_threshold,
+        critical=critical,
+    )
+
+
+_REBOOT = (RepairActionType.REBOOT_SYSTEM,)
+_HW = (RepairActionType.HARDWARE_INSPECTION,)
+_REBOOT_HW = (RepairActionType.REBOOT_SYSTEM, RepairActionType.HARDWARE_INSPECTION)
+_NONE = (RepairActionType.IGNORE_NO_ACTION_REQUIRED,)
+_APP = (RepairActionType.CHECK_USER_APP_AND_TPU,)
+
+CATALOG: List[CatalogEntry] = [
+    # --- driver-level chip failures --------------------------------------
+    _e(1, "tpu_chip_lost",
+       r"(accel\d+.*(device lost|not responding|fell off the bus)|TPU-ERR: tpu_chip_lost)",
+       EventType.FATAL,
+       "TPU chip stopped responding to the driver",
+       _REBOOT_HW, reboot_threshold=2),
+    _e(2, "tpu_driver_timeout",
+       r"(accel\d*.*(command |request |ioctl )?timeout|google_tpu.*timeout|TPU-ERR: tpu_driver_timeout)",
+       EventType.CRITICAL,
+       "TPU driver command timeout",
+       _REBOOT, reboot_threshold=2),
+    _e(3, "tpu_driver_crash",
+       r"(accel\d*.*(firmware (crash|fault)|fatal error)|google_tpu.*(oops|panic|BUG)|TPU-ERR: tpu_driver_crash)",
+       EventType.FATAL,
+       "TPU driver/firmware crashed",
+       _REBOOT_HW, reboot_threshold=2),
+    _e(4, "tpu_chip_reset_required",
+       r"(accel\d+.*reset required|TPU-ERR: tpu_chip_reset_required)",
+       EventType.CRITICAL,
+       "TPU chip requires reset",
+       _REBOOT, reboot_threshold=3),
+    # --- HBM / memory -----------------------------------------------------
+    _e(10, "tpu_hbm_ecc_uncorrectable",
+       r"((uncorrectable|double[- ]bit).*(HBM|ECC|memory error)|HBM.*uncorrectable|TPU-ERR: tpu_hbm_ecc_uncorrectable)",
+       EventType.FATAL,
+       "uncorrectable HBM ECC error",
+       _REBOOT_HW, reboot_threshold=1),
+    _e(11, "tpu_hbm_ecc_correctable",
+       r"((correctable|single[- ]bit).*(HBM|ECC)|HBM.*correctable|TPU-ERR: tpu_hbm_ecc_correctable)",
+       EventType.WARNING,
+       "correctable HBM ECC error (no action; tracked for trends)",
+       _NONE, reboot_threshold=0, critical=False),
+    _e(12, "tpu_hbm_oom",
+       r"(HBM (allocation failure|out of memory)|RESOURCE_EXHAUSTED.*HBM|TPU-ERR: tpu_hbm_oom)",
+       EventType.WARNING,
+       "HBM allocation failure — likely workload oversubscription",
+       _APP, reboot_threshold=0, critical=False),
+    # --- ICI fabric -------------------------------------------------------
+    _e(20, "tpu_ici_link_down",
+       r"(ICI (link|port).*(down|inactive|lost)|interchip interconnect.*down|TPU-ERR: tpu_ici_link_down)",
+       EventType.CRITICAL,
+       "ICI link down — slice fabric degraded",
+       _REBOOT_HW, reboot_threshold=2),
+    _e(21, "tpu_ici_link_flap",
+       r"(ICI (link|port).*(flap|retrain|re-?established)|TPU-ERR: tpu_ici_link_flap)",
+       EventType.WARNING,
+       "ICI link flapped",
+       _NONE, reboot_threshold=3, critical=False),
+    _e(22, "tpu_ici_crc_errors",
+       r"(ICI.*CRC error|interchip.*checksum|TPU-ERR: tpu_ici_crc_errors)",
+       EventType.WARNING,
+       "ICI CRC errors — cable/connector suspect",
+       _HW, reboot_threshold=2, critical=False),
+    _e(23, "tpu_ici_cable_fault",
+       r"(ICI.*cable (fault|error|unplugged)|TPU-ERR: tpu_ici_cable_fault)",
+       EventType.FATAL,
+       "ICI cable fault",
+       _HW, reboot_threshold=0),
+    # --- thermal / power --------------------------------------------------
+    _e(30, "tpu_thermal_trip",
+       r"((TPU|accel).*(thermal (trip|shutdown|throttl)|overtemp)|TPU-ERR: tpu_thermal_trip)",
+       EventType.CRITICAL,
+       "TPU thermal trip/throttle",
+       _HW, reboot_threshold=2),
+    _e(31, "tpu_power_fault",
+       r"((TPU|accel).*(power (fault|brownout|supply failure))|TPU-ERR: tpu_power_fault)",
+       EventType.FATAL,
+       "TPU power delivery fault",
+       _HW, reboot_threshold=1),
+    # --- PCIe -------------------------------------------------------------
+    _e(40, "tpu_pcie_uncorrectable",
+       r"(pcieport.*AER.*(uncorrect|fatal)|TPU-ERR: tpu_pcie_uncorrectable)",
+       EventType.CRITICAL,
+       "PCIe uncorrectable error on TPU path",
+       _REBOOT_HW, reboot_threshold=2),
+    _e(41, "tpu_pcie_correctable",
+       r"(pcieport.*AER.*correct|TPU-ERR: tpu_pcie_correctable)",
+       EventType.WARNING,
+       "PCIe correctable errors on TPU path",
+       _NONE, reboot_threshold=0, critical=False),
+    # --- runtime ----------------------------------------------------------
+    _e(50, "tpu_runtime_fatal",
+       r"(libtpu.*(fatal|SIGSEGV|check failure)|tpu_runtime.*fatal|TPU-ERR: tpu_runtime_fatal)",
+       EventType.CRITICAL,
+       "TPU runtime (libtpu) fatal error",
+       _APP, reboot_threshold=2),
+    _e(51, "tpu_megascale_dcn_error",
+       r"(megascale.*(error|unreachable|timeout)|DCN transport.*(error|fail)|TPU-ERR: tpu_megascale_dcn_error)",
+       EventType.CRITICAL,
+       "multi-slice DCN transport error",
+       _APP, reboot_threshold=2, critical=False),
+]
+
+_BY_NAME = {c.name: c for c in CATALOG}
+_BY_CODE = {c.code: c for c in CATALOG}
+
+
+def lookup(name: str) -> Optional[CatalogEntry]:
+    return _BY_NAME.get(name)
+
+
+def lookup_code(code: int) -> Optional[CatalogEntry]:
+    return _BY_CODE.get(code)
+
+
+_CHIP_RE = re.compile(r"(?:chip[ =]?|accel)(\d+)", re.IGNORECASE)
+
+
+@dataclass
+class MatchedError:
+    entry: CatalogEntry
+    chip_id: Optional[int]
+    raw: str
+
+
+def match(line: str) -> Optional[MatchedError]:
+    """Match one kmsg line against the catalog (first hit wins; catalog is
+    ordered most-specific-first within each class)."""
+    for entry in CATALOG:
+        if entry.pattern.search(line):
+            chip = None
+            m = _CHIP_RE.search(line)
+            if m:
+                try:
+                    chip = int(m.group(1))
+                except ValueError:
+                    chip = None
+            return MatchedError(entry=entry, chip_id=chip, raw=line)
+    return None
+
+
+def injection_line(name: str, chip_id: int = 0, detail: str = "") -> str:
+    """Canonical injection format understood by ``match`` — what
+    ``tpud inject-fault`` writes (reference: pkg/fault-injector
+    xid.GetMessageToInject analog)."""
+    entry = _BY_NAME.get(name)
+    if entry is None:
+        raise KeyError(f"unknown TPU error name: {name!r}")
+    suffix = f" {detail}" if detail else ""
+    return f"TPU-ERR: {name} chip={chip_id}{suffix}"

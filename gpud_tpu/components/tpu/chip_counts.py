@@ -1,0 +1,106 @@
+"""TPU chip-count component: lost-chip detection.
+
+Reference: components/accelerator/nvidia/gpu-counts (502) — device
+enumeration vs expected count (settable via flag/session updateConfig).
+"""
+
+from __future__ import annotations
+
+from gpud_tpu.api.v1.types import (
+    HealthStateType,
+    RepairActionType,
+    SuggestedActions,
+)
+from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
+from gpud_tpu.metrics.registry import gauge
+from gpud_tpu.tpu.topology import expected_local_chips
+
+NAME = "accelerator-tpu-chip-counts"
+
+_g_count = gauge("tpud_tpu_chip_count", "enumerated TPU chips")
+_g_expected = gauge("tpud_tpu_chip_count_expected", "expected TPU chips")
+
+LABELS = {"component": NAME}
+
+
+class TPUChipCountsComponent(PollingComponent):
+    NAME = NAME
+    TAGS = ["accelerator", "tpu"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self.tpu = instance.tpu_instance
+        # runtime-configurable expectation (session updateConfig analog,
+        # reference: pkg/session/session.go:222-227)
+        cfg = instance.config
+        self.expected_count = getattr(cfg, "expected_chip_count", 0) if cfg else 0
+
+    def is_supported(self) -> bool:
+        # an enumeration *failure* is supported-but-unhealthy, not
+        # unsupported — otherwise a chips-fell-off-the-bus boot would be
+        # reported as "not supported" and never checked
+        if self.tpu is None:
+            return False
+        return self.tpu.tpu_lib_exists() or bool(self.tpu.init_error())
+
+    def _expected(self) -> int:
+        if self.expected_count:
+            return self.expected_count
+        if self.tpu is not None:
+            return expected_local_chips(self.tpu.accelerator_type())
+        return 0
+
+    def check_once(self) -> CheckResult:
+        if self.tpu is None or not self.tpu.tpu_lib_exists():
+            err = self.tpu.init_error() if self.tpu is not None else "no TPU instance"
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY if err else HealthStateType.HEALTHY,
+                reason=err or "no TPUs on this host",
+            )
+        devs = self.tpu.devices()
+        healthy_devs = {cid: d for cid, d in devs.items() if not d.lost}
+        lost = sorted(cid for cid, d in devs.items() if d.lost)
+        needs_reset = sorted(cid for cid, d in devs.items() if d.requires_reset)
+        expected = self._expected()
+        _g_count.set(len(healthy_devs), LABELS)
+        _g_expected.set(expected, LABELS)
+
+        extra = {
+            "found": str(len(healthy_devs)),
+            "expected": str(expected),
+            "accelerator_type": self.tpu.accelerator_type(),
+        }
+        if lost or (expected and len(healthy_devs) < expected):
+            detail = f"found {len(healthy_devs)}/{expected or '?'} chips"
+            if lost:
+                detail += f"; lost chip(s) {lost}"
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=f"TPU chip(s) missing: {detail}",
+                suggested_actions=SuggestedActions(
+                    description="TPU chips fell off the bus — reboot; if it persists, inspect hardware",
+                    repair_actions=[
+                        RepairActionType.REBOOT_SYSTEM,
+                        RepairActionType.HARDWARE_INSPECTION,
+                    ],
+                ),
+                extra_info=extra,
+            )
+        if needs_reset:
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=f"TPU chip(s) require reset: {needs_reset}",
+                suggested_actions=SuggestedActions(
+                    description="TPU chips in reset-required state",
+                    repair_actions=[RepairActionType.REBOOT_SYSTEM],
+                ),
+                extra_info=extra,
+            )
+        return CheckResult(
+            self.NAME,
+            reason=f"all {len(healthy_devs)} expected chips present",
+            extra_info=extra,
+        )

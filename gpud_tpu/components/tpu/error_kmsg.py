@@ -1,0 +1,168 @@
+"""TPU kernel-error component — the XID-component analog.
+
+Reference: components/accelerator/nvidia/xid (5137 LoC) — kmsg regex +
+catalog; event-sourced health merging reboot events with error events and
+escalating suggested actions via per-error reboot thresholds
+(component.go:400-650); SetHealthy trims history (636-650); daemon mode
+consumes the follow watcher, scan mode reads the whole ring buffer
+(component.go:214-265).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from gpud_tpu.api.v1.types import Event, EventType, HealthStateType
+from gpud_tpu.components.base import CheckResult, Component, TpudInstance
+from gpud_tpu.components.tpu import catalog
+from gpud_tpu.components.tpu.health_state import (
+    EVENT_NAME_SET_HEALTHY,
+    evolve_health,
+)
+from gpud_tpu.kmsg.syncer import Syncer
+from gpud_tpu.kmsg.watcher import read_all
+from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import counter
+
+NAME = "accelerator-tpu-error-kmsg"
+
+logger = get_logger(__name__)
+
+_c_errors = counter("tpud_tpu_kmsg_errors_total", "matched TPU kernel errors")
+
+DEFAULT_LOOKBACK_SECONDS = 14 * 86400  # events retention window
+UPDATE_INTERVAL = 30.0  # state re-evaluation ticker (reference: component.go 30s)
+
+
+def kmsg_match(line: str) -> Optional[tuple]:
+    """MatchFunc for the shared kmsg watcher."""
+    m = catalog.match(line)
+    if m is None:
+        return None
+    return (m.entry.name, m.entry.event_type, line.strip())
+
+
+class TPUErrorKmsgComponent(Component):
+    NAME = NAME
+    TAGS = ["accelerator", "tpu", "kmsg"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self._event_bucket = (
+            instance.event_store.bucket(NAME) if instance.event_store else None
+        )
+        self.reboot_event_store = instance.reboot_event_store
+        self.lookback_seconds = DEFAULT_LOOKBACK_SECONDS
+        self.time_now_fn = time.time
+        self._stop = threading.Event()
+        self._ticker: Optional[threading.Thread] = None
+        self.syncer: Optional[Syncer] = None
+        if self._event_bucket is not None:
+            self.syncer = Syncer(
+                kmsg_match, self._event_bucket, on_event=self._on_event
+            )
+
+    def is_supported(self) -> bool:
+        # supported wherever kmsg is readable; on non-TPU hosts it simply
+        # never matches (cheap regex on the shared watcher). In scan mode
+        # (no event store) check_once reads the whole ring buffer instead
+        # (reference: xid/component.go:214-265).
+        return True
+
+    # -- event path --------------------------------------------------------
+    def _on_event(self, ev: Event) -> None:
+        _c_errors.inc(labels={"component": NAME, "error": ev.name})
+        self._reevaluate()
+
+    def start(self) -> None:
+        # the SharedWatcher (server-owned) feeds self.syncer; here we only
+        # run the periodic re-evaluation ticker (reference: component.go
+        # updateCurrentState every 30s)
+        if self._ticker is not None:
+            return
+        self._stop.clear()
+        self._ticker = threading.Thread(
+            target=self._tick_loop, name=f"tpud-{NAME}-ticker", daemon=True
+        )
+        self._ticker.start()
+
+    def _tick_loop(self) -> None:
+        self.check()
+        while not self._stop.wait(UPDATE_INTERVAL):
+            self.check()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._ticker is not None:
+            self._ticker.join(timeout=2.0)
+            self._ticker = None
+
+    # -- health evaluation -------------------------------------------------
+    def _merged_events(self) -> List[Event]:
+        since = self.time_now_fn() - self.lookback_seconds
+        evs: List[Event] = []
+        if self._event_bucket is not None:
+            evs.extend(self._event_bucket.get(since))
+        if self.reboot_event_store is not None:
+            evs.extend(self.reboot_event_store.get_reboot_events(since))
+        return evs
+
+    def _reevaluate(self) -> CheckResult:
+        return self.check()
+
+    def check_once(self) -> CheckResult:
+        if self._event_bucket is None:
+            # scan mode (no event store): read the whole ring buffer now
+            # (reference: xid/component.go:214-265 scan path)
+            found = []
+            for msg in read_all():
+                m = catalog.match(msg.message)
+                if m is not None:
+                    found.append(
+                        Event(
+                            component=NAME,
+                            time=msg.time,
+                            name=m.entry.name,
+                            type=m.entry.event_type,
+                            message=msg.message,
+                        )
+                    )
+            ev = evolve_health(found)
+            return CheckResult(
+                self.NAME,
+                health=ev.health,
+                reason=ev.reason or "no TPU errors in kmsg ring buffer",
+                suggested_actions=ev.suggested_actions,
+            )
+        ev = evolve_health(self._merged_events())
+        extra = {name: str(n) for name, n in ev.active_errors.items()}
+        return CheckResult(
+            self.NAME,
+            health=ev.health,
+            reason=ev.reason,
+            suggested_actions=ev.suggested_actions,
+            extra_info=extra,
+        )
+
+    def events(self, since: float):
+        if self._event_bucket is None:
+            return []
+        return self._event_bucket.get(since)
+
+    # -- operator actions --------------------------------------------------
+    def set_healthy(self) -> None:
+        """Insert a SetHealthy marker: evolve_health clears everything
+        before it (reference: xid/set_healthy.go + component.go:636-650)."""
+        if self._event_bucket is not None:
+            self._event_bucket.insert(
+                Event(
+                    component=NAME,
+                    time=self.time_now_fn(),
+                    name=EVENT_NAME_SET_HEALTHY,
+                    type=EventType.INFO,
+                    message="operator set-healthy",
+                )
+            )
+        self.check()

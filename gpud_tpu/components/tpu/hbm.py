@@ -1,0 +1,121 @@
+"""TPU HBM component: usage + ECC health.
+
+Reference blend of components/accelerator/nvidia/memory (usage gauges) and
+remapped-rows (587 LoC — pending ⇒ reboot, failed ⇒ HW inspection;
+rationale at xid/component.go:276-290). TPU HBM ECC plays the role of GPU
+row-remapping: correctable counts are gauges; an uncorrectable/pending
+state drives suggested actions.
+"""
+
+from __future__ import annotations
+
+from gpud_tpu.api.v1.types import (
+    Event,
+    EventType,
+    HealthStateType,
+    RepairActionType,
+    SuggestedActions,
+)
+from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
+from gpud_tpu.components.tpu.shared import sampler_for
+from gpud_tpu.metrics.registry import gauge
+
+NAME = "accelerator-tpu-hbm"
+
+_g_used = gauge("tpud_tpu_hbm_used_bytes", "TPU HBM used bytes")
+_g_total = gauge("tpud_tpu_hbm_total_bytes", "TPU HBM total bytes")
+_g_ecc_corr = gauge("tpud_tpu_hbm_ecc_correctable_total", "correctable HBM ECC errors")
+_g_ecc_uncorr = gauge(
+    "tpud_tpu_hbm_ecc_uncorrectable_total", "uncorrectable HBM ECC errors"
+)
+
+
+class TPUHbmComponent(PollingComponent):
+    NAME = NAME
+    TAGS = ["accelerator", "tpu", "hbm"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self.tpu = instance.tpu_instance
+        self.sampler = sampler_for(self.tpu)
+        self._event_bucket = (
+            instance.event_store.bucket(NAME) if instance.event_store else None
+        )
+
+    def is_supported(self) -> bool:
+        return (
+            self.tpu is not None
+            and self.tpu.tpu_lib_exists()
+            and self.tpu.telemetry_supported()
+        )
+
+    def check_once(self) -> CheckResult:
+        if not self.is_supported():
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.HEALTHY,
+                reason="no TPU telemetry on this host",
+            )
+        tel = self.sampler.telemetry()
+        ecc_pending = []
+        extra = {}
+        for cid, t in sorted(tel.items()):
+            labels = {"component": NAME, "chip": str(cid)}
+            _g_used.set(t.hbm_used_bytes, labels)
+            _g_total.set(t.hbm_total_bytes, labels)
+            _g_ecc_corr.set(t.hbm_ecc_correctable, labels)
+            _g_ecc_uncorr.set(t.hbm_ecc_uncorrectable, labels)
+            if t.hbm_total_bytes:
+                extra[f"chip{cid}_hbm_used_pct"] = (
+                    f"{100.0 * t.hbm_used_bytes / t.hbm_total_bytes:.1f}"
+                )
+            if t.hbm_ecc_pending or t.hbm_ecc_uncorrectable > 0:
+                ecc_pending.append(cid)
+
+        if ecc_pending:
+            # record an event so event-sourced health and the control plane
+            # see the occurrence even after the condition clears; dedupe on
+            # (name, message) against recent history — a still-pending
+            # condition must not insert a new event every poll
+            if self._event_bucket is not None:
+                msg = f"uncorrectable HBM ECC on chip(s) {ecc_pending}"
+                recent = self._event_bucket.get(self.time_now_fn() - 86400)
+                already = any(
+                    e.name == "hbm_ecc_uncorrectable" and e.message == msg
+                    for e in recent
+                )
+                if not already:
+                    self._event_bucket.insert(
+                        Event(
+                            component=NAME,
+                            name="hbm_ecc_uncorrectable",
+                            type=EventType.FATAL,
+                            message=msg,
+                        )
+                    )
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=f"uncorrectable HBM ECC pending on chip(s) {ecc_pending}",
+                suggested_actions=SuggestedActions(
+                    description=(
+                        "uncorrectable HBM ECC — reboot to re-map; if it "
+                        "persists, hardware inspection"
+                    ),
+                    repair_actions=[
+                        RepairActionType.REBOOT_SYSTEM,
+                        RepairActionType.HARDWARE_INSPECTION,
+                    ],
+                ),
+                extra_info=extra,
+            )
+        return CheckResult(
+            self.NAME,
+            reason=f"HBM healthy on {len(tel)} chips",
+            extra_info=extra,
+        )
+
+    def events(self, since: float):
+        if self._event_bucket is None:
+            return []
+        return self._event_bucket.get(since)

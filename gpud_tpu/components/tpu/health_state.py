@@ -1,0 +1,147 @@
+"""Event-sourced health evaluation for TPU errors.
+
+This ports the *shape* of the reference's subtlest logic
+(reference: components/accelerator/nvidia/xid/health_state.go:56-80 and
+component.go:400-650): walk the merged stream of error events, reboot
+events and set-healthy events oldest→newest and evolve the health state:
+
+- a critical error's first occurrence ⇒ Unhealthy, suggest REBOOT_SYSTEM;
+- if the same error recurs after ``reboot_threshold`` reboots, escalate the
+  suggestion to HARDWARE_INSPECTION (rebooting didn't fix it);
+- a SetHealthy event clears the slate (reference: xid/set_healthy.go,
+  component.go:636-650 trims history);
+- non-critical errors never push past Degraded.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from gpud_tpu.api.v1.types import (
+    Event,
+    EventType,
+    HealthStateType,
+    RepairActionType,
+    SuggestedActions,
+)
+from gpud_tpu.components.tpu.catalog import CatalogEntry, lookup
+
+EVENT_NAME_REBOOT = "reboot"
+EVENT_NAME_SET_HEALTHY = "SetHealthy"
+
+
+@dataclass
+class _ErrorTrack:
+    entry: CatalogEntry
+    occurrences: int = 0
+    reboots_since_first: int = 0
+    recurred_after_reboot: bool = False
+    last_event: Optional[Event] = None
+    pending_reboot_seen: bool = False  # a reboot happened after the last occurrence
+
+
+@dataclass
+class EvaluatedHealth:
+    health: str = HealthStateType.HEALTHY
+    reason: str = ""
+    suggested_actions: Optional[SuggestedActions] = None
+    active_errors: Dict[str, int] = field(default_factory=dict)
+
+
+def evolve_health(merged_events: List[Event]) -> EvaluatedHealth:
+    """``merged_events`` may arrive in any order; they are sorted
+    oldest→newest here (reference: health_state.go:60+ walks merged reboot
+    + xid events the same way). Error events must carry the catalog name in
+    ``Event.name``."""
+    events = sorted(merged_events, key=lambda e: e.time)
+    tracks: Dict[str, _ErrorTrack] = {}
+
+    for ev in events:
+        if ev.name == EVENT_NAME_SET_HEALTHY:
+            # operator cleared the slate: drop all accumulated state
+            tracks.clear()
+            continue
+        if ev.name == EVENT_NAME_REBOOT:
+            for tr in tracks.values():
+                tr.reboots_since_first += 1
+                tr.pending_reboot_seen = True
+            continue
+        entry = lookup(ev.name)
+        if entry is None:
+            continue
+        tr = tracks.get(ev.name)
+        if tr is None:
+            tr = _ErrorTrack(entry=entry)
+            tracks[ev.name] = tr
+        tr.occurrences += 1
+        tr.last_event = ev
+        if tr.pending_reboot_seen:
+            # the error came back after a reboot — reboot didn't fix it
+            tr.recurred_after_reboot = True
+            tr.pending_reboot_seen = False
+
+    if not tracks:
+        return EvaluatedHealth(reason="no TPU errors observed")
+
+    # Resolution semantics: an error with a reboot after its last occurrence
+    # and no recurrence is considered addressed (reference merges reboot
+    # events so a clean reboot clears the suggestion path).
+    active: Dict[str, _ErrorTrack] = {}
+    for name, tr in tracks.items():
+        if tr.pending_reboot_seen and not tr.recurred_after_reboot:
+            continue  # rebooted, hasn't recurred → resolved
+        active[name] = tr
+
+    if not active:
+        return EvaluatedHealth(
+            reason="previous TPU errors cleared by reboot",
+        )
+
+    worst = HealthStateType.DEGRADED
+    reasons: List[str] = []
+    repair: List[str] = []
+    descs: List[str] = []
+    counts: Dict[str, int] = {}
+    any_escalated = False
+    for name, tr in sorted(active.items(), key=lambda kv: -kv[1].entry.code):
+        counts[name] = tr.occurrences
+        if tr.entry.critical:
+            worst = HealthStateType.UNHEALTHY
+        escalate = (
+            tr.entry.reboot_threshold > 0
+            and tr.recurred_after_reboot
+            and tr.reboots_since_first >= tr.entry.reboot_threshold
+        )
+        if escalate:
+            any_escalated = True
+            reasons.append(
+                f"{name} recurred after {tr.reboots_since_first} reboot(s) "
+                f"(x{tr.occurrences})"
+            )
+            if RepairActionType.HARDWARE_INSPECTION not in repair:
+                repair.append(RepairActionType.HARDWARE_INSPECTION)
+        else:
+            reasons.append(f"{name} (x{tr.occurrences})")
+            for act in tr.entry.repair_actions:
+                if act not in repair:
+                    repair.append(act)
+        descs.append(tr.entry.description)
+
+    # once an error escalated, rebooting is known not to help: replace the
+    # reboot suggestion with inspection (reference: health_state.go
+    # escalation replaces reboot with inspection)
+    if any_escalated:
+        repair = [a for a in repair if a != RepairActionType.REBOOT_SYSTEM]
+        if RepairActionType.HARDWARE_INSPECTION not in repair:
+            repair.append(RepairActionType.HARDWARE_INSPECTION)
+
+    sa = None
+    if repair and repair != [RepairActionType.IGNORE_NO_ACTION_REQUIRED]:
+        sa = SuggestedActions(description="; ".join(descs), repair_actions=repair)
+    return EvaluatedHealth(
+        health=worst,
+        reason="; ".join(reasons),
+        suggested_actions=sa,
+        active_errors=counts,
+    )

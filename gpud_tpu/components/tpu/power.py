@@ -1,0 +1,63 @@
+"""TPU power + utilization component.
+
+Reference: components/accelerator/nvidia/power (493) + utilization (403) +
+gpm (733) — draw/limit gauges and duty-cycle/tensorcore utilization,
+collapsed into one TPU component since all values come from the same
+telemetry sample.
+"""
+
+from __future__ import annotations
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
+from gpud_tpu.components.tpu.shared import sampler_for
+from gpud_tpu.metrics.registry import gauge
+
+NAME = "accelerator-tpu-power"
+
+_g_power = gauge("tpud_tpu_power_watts", "TPU chip power draw")
+_g_duty = gauge("tpud_tpu_duty_cycle_percent", "TensorCore duty cycle")
+_g_util = gauge("tpud_tpu_tensorcore_util_percent", "TensorCore utilization")
+_g_clock = gauge("tpud_tpu_clock_mhz", "TPU core clock")
+
+
+class TPUPowerComponent(PollingComponent):
+    NAME = NAME
+    TAGS = ["accelerator", "tpu", "power"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self.tpu = instance.tpu_instance
+        self.sampler = sampler_for(self.tpu)
+
+    def is_supported(self) -> bool:
+        return (
+            self.tpu is not None
+            and self.tpu.tpu_lib_exists()
+            and self.tpu.telemetry_supported()
+        )
+
+    def check_once(self) -> CheckResult:
+        if not self.is_supported():
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.HEALTHY,
+                reason="no TPU telemetry on this host",
+            )
+        tel = self.sampler.telemetry()
+        total_w = 0.0
+        extra = {}
+        for cid, t in sorted(tel.items()):
+            labels = {"component": NAME, "chip": str(cid)}
+            _g_power.set(t.power_w, labels)
+            _g_duty.set(t.duty_cycle_pct, labels)
+            _g_util.set(t.tensorcore_util_pct, labels)
+            _g_clock.set(t.clock_mhz, labels)
+            total_w += t.power_w
+            extra[f"chip{cid}_power_w"] = f"{t.power_w:.1f}"
+            extra[f"chip{cid}_duty_pct"] = f"{t.duty_cycle_pct:.1f}"
+        return CheckResult(
+            self.NAME,
+            reason=f"total draw {total_w:.0f}W across {len(tel)} chips",
+            extra_info=extra,
+        )

@@ -1,0 +1,61 @@
+"""Shared telemetry sampler for TPU components.
+
+The reference's NVIDIA components each call NVML separately but NVML is a
+cheap side-band API; TPU telemetry reads can be costlier, so all TPU
+components share one cached sample with a short TTL (footprint discipline:
+"shared pollers", SURVEY §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from gpud_tpu.tpu.instance import ICILinkSnapshot, TPUChipTelemetry, TPUInstance
+
+DEFAULT_TTL = 10.0
+
+
+class TelemetrySampler:
+    def __init__(self, instance: TPUInstance, ttl_seconds: float = DEFAULT_TTL) -> None:
+        self.instance = instance
+        self.ttl = ttl_seconds
+        self._mu = threading.Lock()
+        self._tel: Dict[int, TPUChipTelemetry] = {}
+        self._tel_ts = 0.0
+        self._links: List[ICILinkSnapshot] = []
+        self._links_ts = 0.0
+        self.time_now_fn = time.time
+
+    def telemetry(self) -> Dict[int, TPUChipTelemetry]:
+        now = self.time_now_fn()
+        with self._mu:
+            if now - self._tel_ts >= self.ttl:
+                self._tel = self.instance.telemetry()
+                self._tel_ts = now
+            return dict(self._tel)
+
+    def ici_links(self) -> List[ICILinkSnapshot]:
+        now = self.time_now_fn()
+        with self._mu:
+            if now - self._links_ts >= self.ttl:
+                self._links = self.instance.ici_links()
+                self._links_ts = now
+            return list(self._links)
+
+
+_samplers_mu = threading.Lock()
+
+
+def sampler_for(instance: Optional[TPUInstance]) -> Optional[TelemetrySampler]:
+    """One sampler per TPUInstance, stored on the instance itself so its
+    lifetime matches the instance (no process-global cache to leak)."""
+    if instance is None:
+        return None
+    with _samplers_mu:
+        s = getattr(instance, "_tpud_sampler", None)
+        if s is None:
+            s = TelemetrySampler(instance)
+            instance._tpud_sampler = s  # type: ignore[attr-defined]
+        return s

@@ -1,0 +1,92 @@
+"""TPU temperature component.
+
+Reference: components/accelerator/nvidia/temperature (component.go:119-190,
+metrics.go:17-50) — per-chip temps with margin-to-slowdown degraded
+threshold and HBM temperature, re-targeted at TPU chip/HBM sensors.
+"""
+
+from __future__ import annotations
+
+from gpud_tpu.api.v1.types import (
+    HealthStateType,
+    RepairActionType,
+    SuggestedActions,
+)
+from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
+from gpud_tpu.components.tpu.shared import sampler_for
+from gpud_tpu.metrics.registry import gauge
+
+NAME = "accelerator-tpu-temperature"
+
+_g_temp = gauge("tpud_tpu_temperature_celsius", "TPU chip temperature")
+_g_hbm_temp = gauge("tpud_tpu_hbm_temperature_celsius", "TPU HBM temperature")
+
+# thermal design thresholds; slowdown flag from telemetry overrides
+DEFAULT_DEGRADED_C = 85.0
+DEFAULT_UNHEALTHY_C = 95.0
+
+
+class TPUTemperatureComponent(PollingComponent):
+    NAME = NAME
+    TAGS = ["accelerator", "tpu", "temperature"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self.tpu = instance.tpu_instance
+        self.sampler = sampler_for(self.tpu)
+        self.degraded_c = DEFAULT_DEGRADED_C
+        self.unhealthy_c = DEFAULT_UNHEALTHY_C
+
+    def is_supported(self) -> bool:
+        return (
+            self.tpu is not None
+            and self.tpu.tpu_lib_exists()
+            and self.tpu.telemetry_supported()
+        )
+
+    def check_once(self) -> CheckResult:
+        if not self.is_supported():
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.HEALTHY,
+                reason="no TPU telemetry on this host",
+            )
+        tel = self.sampler.telemetry()
+        worst = -1.0
+        slowdown_chips = []
+        extra = {}
+        for cid, t in sorted(tel.items()):
+            labels = {"component": NAME, "chip": str(cid)}
+            _g_temp.set(t.temperature_c, labels)
+            _g_hbm_temp.set(t.hbm_temperature_c, labels)
+            extra[f"chip{cid}_temp_c"] = f"{t.temperature_c:.1f}"
+            worst = max(worst, t.temperature_c)
+            if t.thermal_slowdown:
+                slowdown_chips.append(cid)
+
+        if slowdown_chips or worst >= self.unhealthy_c:
+            chips = slowdown_chips or [
+                cid for cid, t in tel.items() if t.temperature_c >= self.unhealthy_c
+            ]
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=f"thermal slowdown on chip(s) {chips}; max temp {worst:.1f}C",
+                suggested_actions=SuggestedActions(
+                    description="TPU thermal slowdown — check cooling / inspect hardware",
+                    repair_actions=[RepairActionType.HARDWARE_INSPECTION],
+                ),
+                extra_info=extra,
+            )
+        if worst >= self.degraded_c:
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.DEGRADED,
+                reason=f"high TPU temperature: max {worst:.1f}C",
+                extra_info=extra,
+            )
+        return CheckResult(
+            self.NAME,
+            reason=f"max temp {worst:.1f}C across {len(tel)} chips",
+            extra_info=extra,
+        )

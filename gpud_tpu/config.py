@@ -95,7 +95,8 @@ class Config:
         )
 
     def validate(self) -> Optional[str]:
-        if not (0 < self.port < 65536):
+        # port 0 = ephemeral (tests)
+        if not (0 <= self.port < 65536):
             return f"invalid port {self.port}"
         if self.metrics_retention_seconds < 60:
             return "metrics retention must be >= 60s"

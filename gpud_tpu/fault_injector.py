@@ -1,0 +1,69 @@
+"""Fault injector.
+
+Reference: pkg/fault-injector/fault_injector.go:12-69 — an ``Injector``
+wrapping the KmsgWriter; requests carry either a catalogued error name
+(the XID-id analog) or a raw kernel message. Injected lines flow through
+the real watcher→syncer→eventstore detection path, making injection both a
+product feature and the e2e test harness (SURVEY §4.7).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from gpud_tpu.components.tpu import catalog
+from gpud_tpu.kmsg.writer import KmsgWriter
+from gpud_tpu.log import audit, get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_PRIORITY = 2  # crit
+
+
+@dataclass
+class Request:
+    """Either ``tpu_error_name`` (catalogued) or ``kernel_message``
+    (reference: Request{XID|KernelMessage})."""
+
+    tpu_error_name: str = ""
+    chip_id: int = 0
+    detail: str = ""
+    kernel_message: str = ""
+    priority: int = DEFAULT_PRIORITY
+
+    def validate(self) -> Optional[str]:
+        if not self.tpu_error_name and not self.kernel_message:
+            return "one of tpu_error_name or kernel_message is required"
+        if self.tpu_error_name and catalog.lookup(self.tpu_error_name) is None:
+            known = ", ".join(sorted(e.name for e in catalog.CATALOG))
+            return f"unknown tpu_error_name {self.tpu_error_name!r}; known: {known}"
+        return None
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Request":
+        return cls(
+            tpu_error_name=d.get("tpu_error_name", "") or d.get("name", ""),
+            chip_id=int(d.get("chip_id", 0)),
+            detail=d.get("detail", ""),
+            kernel_message=d.get("kernel_message", ""),
+            priority=int(d.get("priority", DEFAULT_PRIORITY)),
+        )
+
+
+class Injector:
+    def __init__(self, writer: Optional[KmsgWriter] = None, kmsg_path: str = "") -> None:
+        self.writer = writer or KmsgWriter(path=kmsg_path)
+
+    def inject(self, req: Request) -> Optional[str]:
+        """Returns an error string or None."""
+        err = req.validate()
+        if err:
+            return err
+        if req.tpu_error_name:
+            line = catalog.injection_line(req.tpu_error_name, req.chip_id, req.detail)
+        else:
+            line = req.kernel_message
+        audit("inject_fault", line=line)
+        logger.info("injecting fault: %s", line)
+        return self.writer.write(line, priority=req.priority)

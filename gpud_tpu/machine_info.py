@@ -1,0 +1,139 @@
+"""MachineInfo assembly.
+
+Reference: pkg/machine-info/machine_info.go:45-434 — builds the
+apiv1.MachineInfo tree (CPU/mem/NIC/disk/accelerator) for login/gossip and
+the /machine-info endpoint. TPUInfo replaces GPUInfo and reports slice
+topology (SURVEY §5.8).
+"""
+
+from __future__ import annotations
+
+import socket
+from typing import Optional
+
+import psutil
+
+from gpud_tpu import host as pkghost
+from gpud_tpu.api.v1.types import (
+    DiskInfo,
+    MachineInfo,
+    NICInfo,
+    TPUChipInfo,
+    TPUInfo,
+)
+from gpud_tpu.tpu.instance import TPUInstance
+from gpud_tpu.version import __version__
+
+
+def _cpu_model() -> str:
+    try:
+        with open("/proc/cpuinfo", "r", encoding="utf-8") as f:
+            for ln in f:
+                if ln.lower().startswith("model name"):
+                    return ln.split(":", 1)[1].strip()
+    except OSError:
+        pass
+    return ""
+
+
+def get_tpu_info(tpu: Optional[TPUInstance]) -> Optional[TPUInfo]:
+    if tpu is None or not tpu.tpu_lib_exists():
+        return None
+    topo = tpu.topology()
+    chips = [
+        TPUChipInfo(
+            chip_id=c.chip_id,
+            device_path=c.device_path,
+            pci_address=c.pci_address,
+            serial=c.serial,
+            hbm_total_bytes=c.hbm_total_bytes,
+            cores_per_chip=c.cores,
+        )
+        for c in sorted(tpu.devices().values(), key=lambda c: c.chip_id)
+    ]
+    return TPUInfo(
+        product=tpu.product_name(),
+        accelerator_type=tpu.accelerator_type(),
+        topology=f"{topo.total_chips} chips / {topo.hosts} hosts" if topo else "",
+        generation=tpu.generation(),
+        chip_count=len(chips),
+        hosts_per_slice=topo.hosts if topo else 1,
+        worker_id=tpu.worker_id(),
+        runtime_version=tpu.runtime_version(),
+        driver_version=tpu.driver_version(),
+        chips=chips,
+    )
+
+
+def get_machine_info(
+    tpu: Optional[TPUInstance] = None,
+    machine_id: str = "",
+    provider: str = "",
+    region: str = "",
+    public_ip: str = "",
+    private_ip: str = "",
+) -> MachineInfo:
+    vm = psutil.virtual_memory()
+    disks = []
+    try:
+        for p in psutil.disk_partitions(all=False):
+            try:
+                u = psutil.disk_usage(p.mountpoint)
+            except OSError:
+                continue
+            disks.append(
+                DiskInfo(
+                    device=p.device,
+                    mount_point=p.mountpoint,
+                    fstype=p.fstype,
+                    total_bytes=u.total,
+                    used_bytes=u.used,
+                )
+            )
+    except OSError:
+        pass
+    nics = []
+    try:
+        stats = psutil.net_if_stats()
+        for name, addrs in psutil.net_if_addrs().items():
+            if name == "lo":
+                continue
+            mac = ""
+            ips = []
+            for a in addrs:
+                if a.family == psutil.AF_LINK:
+                    mac = a.address
+                elif a.family in (socket.AF_INET, socket.AF_INET6):
+                    ips.append(a.address)
+            st = stats.get(name)
+            nics.append(
+                NICInfo(
+                    name=name,
+                    mac=mac,
+                    addresses=ips,
+                    mtu=st.mtu if st else 0,
+                    speed_mbps=st.speed if st else 0,
+                )
+            )
+    except OSError:
+        pass
+
+    return MachineInfo(
+        machine_id=machine_id or pkghost.machine_id(),
+        hostname=socket.gethostname(),
+        os=pkghost.os_name(),
+        kernel_version=pkghost.kernel_version(),
+        boot_id=pkghost.boot_id(),
+        uptime_seconds=int(pkghost.uptime_seconds()),
+        cpu_model=_cpu_model(),
+        cpu_logical_cores=psutil.cpu_count(logical=True) or 0,
+        memory_total_bytes=vm.total,
+        provider=provider,
+        region=region,
+        public_ip=public_ip,
+        private_ip=private_ip,
+        tpud_version=__version__,
+        tpu_info=get_tpu_info(tpu),
+        disks=disks,
+        nics=nics,
+    )

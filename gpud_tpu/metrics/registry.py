@@ -1,0 +1,158 @@
+"""Metrics registry: Gauges/Counters all components register into.
+
+Reference: pkg/metrics/registry.go:5-23 — a package-global Prometheus
+registry. Here a small dependency-free implementation that renders the
+Prometheus text exposition format for the /metrics endpoint and feeds the
+scraper → SQLite pipeline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Optional[Dict[str, str]]) -> LabelKey:
+    if not labels:
+        return ()
+    return tuple(sorted(labels.items()))
+
+
+def _render_labels(key: LabelKey) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+class _Metric:
+    TYPE = "gauge"
+
+    def __init__(self, name: str, help_text: str, registry: "Registry") -> None:
+        self.name = name
+        self.help_text = help_text
+        self._mu = threading.Lock()
+        self._values: Dict[LabelKey, float] = {}
+        registry._register(self)
+
+    def labels_values(self) -> List[Tuple[LabelKey, float]]:
+        with self._mu:
+            return list(self._values.items())
+
+    def clear(self) -> None:
+        with self._mu:
+            self._values.clear()
+
+    def remove(self, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._mu:
+            self._values.pop(_label_key(labels), None)
+
+
+class Gauge(_Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._mu:
+            self._values[_label_key(labels)] = float(value)
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+        with self._mu:
+            return self._values.get(_label_key(labels))
+
+
+class Counter(_Metric):
+    TYPE = "counter"
+
+    def inc(self, amount: float = 1.0, labels: Optional[Dict[str, str]] = None) -> None:
+        k = _label_key(labels)
+        with self._mu:
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        with self._mu:
+            return self._values.get(_label_key(labels), 0.0)
+
+
+class Registry:
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, m: _Metric) -> None:
+        with self._mu:
+            if m.name in self._metrics:
+                raise ValueError(f"metric already registered: {m.name}")
+            self._metrics[m.name] = m
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        with self._mu:
+            existing = self._metrics.get(name)
+        if existing is not None:
+            if not isinstance(existing, Gauge):
+                raise TypeError(f"{name} is not a gauge")
+            return existing
+        return Gauge(name, help_text, self)
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        with self._mu:
+            existing = self._metrics.get(name)
+        if existing is not None:
+            if not isinstance(existing, Counter):
+                raise TypeError(f"{name} is not a counter")
+            return existing
+        return Counter(name, help_text, self)
+
+    def unregister(self, name: str) -> None:
+        with self._mu:
+            self._metrics.pop(name, None)
+
+    def all_metrics(self) -> List[_Metric]:
+        with self._mu:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    # -- exposition --------------------------------------------------------
+    def render_prometheus(self) -> str:
+        """Prometheus text format for /metrics
+        (reference: pkg/server/server.go:415-418)."""
+        lines: List[str] = []
+        for m in self.all_metrics():
+            if m.help_text:
+                lines.append(f"# HELP {m.name} {m.help_text}")
+            lines.append(f"# TYPE {m.name} {m.TYPE}")
+            for key, value in sorted(m.labels_values()):
+                lines.append(f"{m.name}{_render_labels(key)} {_format_value(value)}")
+        return "\n".join(lines) + "\n"
+
+    def gather(self, now: Optional[float] = None) -> List[Tuple[int, str, Dict[str, str], float]]:
+        """Snapshot for the scraper: (unix_seconds, name, labels, value)."""
+        ts = int(now if now is not None else time.time())
+        out = []
+        for m in self.all_metrics():
+            for key, value in m.labels_values():
+                out.append((ts, m.name, dict(key), value))
+        return out
+
+
+def _format_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+# package-global default registry (reference: pkg/metrics/registry.go:5)
+DEFAULT_REGISTRY = Registry()
+
+
+def gauge(name: str, help_text: str = "") -> Gauge:
+    return DEFAULT_REGISTRY.gauge(name, help_text)
+
+
+def counter(name: str, help_text: str = "") -> Counter:
+    return DEFAULT_REGISTRY.counter(name, help_text)

@@ -1,0 +1,211 @@
+"""Metrics SQLite time-series store + scraper + syncer + recorder.
+
+Reference: pkg/metrics/{scraper,store,syncer,recorder} — the three-stage
+pipeline (SURVEY §5.5): components set gauges in the registry → the syncer
+scrapes once a minute into SQLite with retention purge → /v1/metrics and
+the session serve history from the store.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from gpud_tpu.api.v1.types import Metric
+from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import Registry
+from gpud_tpu.sqlite import DB
+from gpud_tpu import sqlite as sqlite_mod
+
+logger = get_logger(__name__)
+
+TABLE = "tpud_metrics_v0_1"
+
+DEFAULT_RETENTION = 3 * 3600  # 3h (reference: pkg/config/default.go:26)
+SCRAPE_INTERVAL = 60.0        # 1m  (reference: pkg/server/server.go:231-239)
+RECORDER_INTERVAL = 15 * 60.0 # 15m (reference: pkg/server/server.go:241)
+
+# metric-name prefix → component attribution for /v1/metrics grouping
+COMPONENT_LABEL = "component"
+
+
+class MetricsStore:
+    """SQLite time-series table with Record/Read/Purge
+    (reference: pkg/metrics/store/sqlite.go:64)."""
+
+    def __init__(self, db: DB, retention_seconds: int = DEFAULT_RETENTION) -> None:
+        self.db = db
+        self.retention_seconds = retention_seconds
+        db.execute(
+            f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+                unix_seconds INTEGER NOT NULL,
+                name TEXT NOT NULL,
+                labels TEXT NOT NULL DEFAULT '',
+                value REAL NOT NULL
+            )"""
+        )
+        db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_ts ON {TABLE} (unix_seconds)"
+        )
+        db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_name_ts ON {TABLE} (name, unix_seconds)"
+        )
+
+    def record(self, rows: List[tuple]) -> None:
+        """rows: (unix_seconds, name, labels_dict, value) — batched insert
+        (footprint discipline: one transaction per scrape)."""
+        if not rows:
+            return
+        self.db.executemany(
+            f"INSERT INTO {TABLE} (unix_seconds, name, labels, value) VALUES (?, ?, ?, ?)",
+            [
+                (ts, name, json.dumps(labels, sort_keys=True) if labels else "", value)
+                for ts, name, labels, value in rows
+            ],
+        )
+
+    def read(
+        self,
+        since: float,
+        name: str = "",
+        components: Optional[List[str]] = None,
+    ) -> List[Metric]:
+        sql = f"SELECT unix_seconds, name, labels, value FROM {TABLE} WHERE unix_seconds>=?"
+        params: list = [int(since)]
+        if name:
+            sql += " AND name=?"
+            params.append(name)
+        sql += " ORDER BY unix_seconds ASC"
+        out: List[Metric] = []
+        comp_filter = set(components) if components else None
+        for ts, nm, labels_json, value in self.db.query(sql, params):
+            labels = json.loads(labels_json) if labels_json else {}
+            if comp_filter is not None and labels.get(COMPONENT_LABEL) not in comp_filter:
+                continue
+            out.append(Metric(unix_seconds=ts, name=nm, labels=labels, value=value))
+        return out
+
+    def purge(self, before: float) -> int:
+        return self.db.execute(
+            f"DELETE FROM {TABLE} WHERE unix_seconds<?", (int(before),)
+        ).rowcount
+
+
+class Syncer:
+    """Every minute: scrape registry → store, purge older than retention
+    (reference: pkg/metrics/syncer/syncer.go:22-50, wired at
+    pkg/server/server.go:231-239)."""
+
+    def __init__(
+        self,
+        registry: Registry,
+        store: MetricsStore,
+        interval_seconds: float = SCRAPE_INTERVAL,
+    ) -> None:
+        self.registry = registry
+        self.store = store
+        self.interval = interval_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.time_now_fn = time.time
+
+    def sync_once(self) -> int:
+        rows = self.registry.gather(self.time_now_fn())
+        self.store.record(rows)
+        self.store.purge(self.time_now_fn() - self.store.retention_seconds)
+        return len(rows)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="tpud-metrics-syncer", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sync_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("metrics sync failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+class SelfMetricsRecorder:
+    """tpud self-metrics: fd usage, DB size, sqlite op timings, vacuum
+    seconds, every 15m (reference: pkg/metrics/recorder/gpud_metrics.go:14-60)."""
+
+    def __init__(
+        self,
+        registry: Registry,
+        db: DB,
+        interval_seconds: float = RECORDER_INTERVAL,
+    ) -> None:
+        self.db = db
+        self.interval = interval_seconds
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.g_db_size = registry.gauge(
+            "tpud_sqlite_db_size_bytes", "state DB size in bytes"
+        )
+        self.g_fds = registry.gauge("tpud_file_descriptors", "open fd count of tpud")
+        self.g_select_secs = registry.gauge(
+            "tpud_sqlite_select_seconds_total", "cumulative sqlite select seconds"
+        )
+        self.g_write_secs = registry.gauge(
+            "tpud_sqlite_insert_update_delete_seconds_total",
+            "cumulative sqlite write seconds",
+        )
+        self.g_vacuum_secs = registry.gauge(
+            "tpud_sqlite_vacuum_seconds_total", "cumulative sqlite vacuum seconds"
+        )
+
+    def record_once(self) -> None:
+        try:
+            self.g_db_size.set(self.db.size_bytes())
+        except Exception:  # noqa: BLE001
+            pass
+        self.g_fds.set(_open_fd_count())
+        s = sqlite_mod.stats()
+        self.g_select_secs.set(s["select_seconds"])
+        self.g_write_secs.set(s["insert_update_delete_seconds"])
+        self.g_vacuum_secs.set(s["vacuum_seconds"])
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self.record_once()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpud-self-metrics", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.record_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("self-metrics record failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+
+def _open_fd_count() -> int:
+    try:
+        import os
+
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return -1

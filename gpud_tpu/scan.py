@@ -1,0 +1,88 @@
+"""One-shot diagnostic scan.
+
+Reference: pkg/scan/scan.go:33-118 — builds the accelerator instance and a
+GPUdInstance *without* an event store, runs Check() on every supported
+component and prints result tables. Check() implementations take their
+"read everything now" path when no event store is present (e.g. the error
+component reads the whole kmsg ring buffer).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional, TextIO
+
+from gpud_tpu.components.all import all_components
+from gpud_tpu.components.base import (
+    CheckResult,
+    FailureInjector,
+    Registry,
+    TpudInstance,
+)
+from gpud_tpu import host as pkghost
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.tpu.instance import new_instance
+
+
+_HEALTH_GLYPH = {
+    HealthStateType.HEALTHY: "✔",
+    HealthStateType.DEGRADED: "◐",
+    HealthStateType.UNHEALTHY: "✘",
+    HealthStateType.INITIALIZING: "…",
+}
+
+
+def scan(
+    accelerator_type: str = "",
+    failure_injector: Optional[FailureInjector] = None,
+    out: TextIO = sys.stdout,
+) -> List[CheckResult]:
+    """Run every supported component's check once and print a table.
+    Returns the check results (for tests / the CLI exit code)."""
+    tpu = new_instance(
+        failure_injector=failure_injector, accelerator_type=accelerator_type
+    )
+    inst = TpudInstance(
+        machine_id=pkghost.machine_id(),
+        tpu_instance=tpu,
+        event_store=None,  # scan mode: no persistence (reference: scan.go:83-100)
+        failure_injector=failure_injector,
+    )
+    registry = Registry(inst)
+    for init_func in all_components():
+        registry.must_register(init_func)
+
+    out.write(f"machine-id : {inst.machine_id}\n")
+    out.write(f"tpu        : {'present' if tpu.tpu_lib_exists() else 'absent'}")
+    if tpu.tpu_lib_exists():
+        out.write(
+            f" ({tpu.product_name()}, {tpu.accelerator_type() or 'type unknown'}, "
+            f"{len(tpu.devices())} chips)"
+        )
+    out.write("\n\n")
+
+    results: List[CheckResult] = []
+    name_w = max(len(c.name()) for c in registry.all())
+    for comp in registry.all():
+        if not comp.is_supported():
+            out.write(f"  {comp.name():<{name_w}}  -  not supported on this host\n")
+            continue
+        cr = comp.check()
+        results.append(cr)
+        glyph = _HEALTH_GLYPH.get(cr.health_state_type(), "?")
+        out.write(f"  {comp.name():<{name_w}}  {glyph}  {cr.summary()}\n")
+        for st in cr.health_states():
+            if st.suggested_actions:
+                out.write(
+                    f"  {'':<{name_w}}     ↳ suggested: "
+                    f"{st.suggested_actions.describe_actions()}\n"
+                )
+    out.write("\n")
+    unhealthy = [
+        r for r in results if r.health_state_type() != HealthStateType.HEALTHY
+    ]
+    out.write(
+        f"{len(results)} checks, {len(results) - len(unhealthy)} healthy, "
+        f"{len(unhealthy)} not healthy\n"
+    )
+    return results

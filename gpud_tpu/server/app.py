@@ -1,0 +1,264 @@
+"""HTTP API routes.
+
+Reference: pkg/server routes (handlers_components.go:20-31,
+handlers_plugins.go:14-17, handlers_healthz.go:10,
+handlers_machine_info.go:13, handlers_inject_fault.go:13,
+server.go:402-434):
+
+  GET  /healthz
+  GET  /v1/components            DELETE /v1/components?componentName=
+  GET  /v1/components/trigger-check?componentName=|tagName=
+  POST /v1/components/set-healthy?componentName=
+  GET  /v1/states[?components=]
+  GET  /v1/events[?startTime=&endTime=]
+  GET  /v1/metrics[?since=]
+  GET  /v1/info
+  GET  /metrics                  (Prometheus text)
+  GET  /machine-info
+  POST /inject-fault
+  GET  /admin/config
+  GET  /admin/packages
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import TYPE_CHECKING
+
+from aiohttp import web
+
+from gpud_tpu import machine_info as machineinfo
+from gpud_tpu.api.v1.types import (
+    ComponentEvents,
+    ComponentHealthStates,
+    ComponentInfo,
+    ComponentMetrics,
+)
+from gpud_tpu.fault_injector import Request as InjectRequest
+from gpud_tpu.log import get_logger
+
+if TYPE_CHECKING:
+    from gpud_tpu.server.server import Server
+
+logger = get_logger(__name__)
+
+DEFAULT_EVENTS_LOOKBACK = 3 * 3600  # /v1/events default window
+DEFAULT_METRICS_LOOKBACK = 3 * 3600
+
+
+def _json(data, status: int = 200) -> web.Response:
+    return web.Response(
+        text=json.dumps(data),
+        status=status,
+        content_type="application/json",
+    )
+
+
+def _components_filter(request: web.Request):
+    raw = request.query.get("components", "")
+    return [c for c in raw.split(",") if c] or None
+
+
+def build_app(srv: "Server") -> web.Application:
+    app = web.Application()
+    r = app.router
+
+    async def healthz(_req: web.Request) -> web.Response:
+        return _json({"status": "ok", "version": srv.version})
+
+    async def list_components(_req: web.Request) -> web.Response:
+        return _json(srv.registry.names())
+
+    async def deregister_component(req: web.Request) -> web.Response:
+        name = req.query.get("componentName", "")
+        comp = srv.registry.get(name)
+        if comp is None:
+            return _json({"error": f"component {name!r} not found"}, 404)
+        if not comp.can_deregister():
+            return _json({"error": f"component {name!r} is not deregisterable"}, 400)
+        comp = srv.registry.deregister(name)
+        if comp is not None:
+            comp.close()
+        return _json({"deregistered": name})
+
+    async def trigger_check(req: web.Request) -> web.Response:
+        name = req.query.get("componentName", "")
+        tag = req.query.get("tagName", "")
+        comps = []
+        if name:
+            c = srv.registry.get(name)
+            if c is None:
+                return _json({"error": f"component {name!r} not found"}, 404)
+            comps = [c]
+        elif tag:
+            comps = [c for c in srv.registry.all() if tag in c.tags()]
+            if not comps:
+                return _json({"error": f"no components with tag {tag!r}"}, 404)
+        else:
+            return _json({"error": "componentName or tagName required"}, 400)
+        out = []
+        for c in comps:
+            cr = await _run_blocking(srv, c.check)
+            out.append(
+                ComponentHealthStates(
+                    component=c.name(), states=cr.health_states()
+                ).to_dict()
+            )
+        return _json(out)
+
+    async def set_healthy(req: web.Request) -> web.Response:
+        name = req.query.get("componentName", "")
+        c = srv.registry.get(name)
+        if c is None:
+            return _json({"error": f"component {name!r} not found"}, 404)
+        fn = getattr(c, "set_healthy", None)
+        if fn is None:
+            return _json({"error": f"component {name!r} is not health-settable"}, 400)
+        await _run_blocking(srv, fn)
+        return _json({"set_healthy": name})
+
+    async def states(req: web.Request) -> web.Response:
+        comps = _components_filter(req)
+        out = []
+        for c in srv.registry.all():
+            if comps and c.name() not in comps:
+                continue
+            out.append(
+                ComponentHealthStates(
+                    component=c.name(), states=c.last_health_states()
+                ).to_dict()
+            )
+        return _json(out)
+
+    async def events(req: web.Request) -> web.Response:
+        now = time.time()
+        start = float(req.query.get("startTime", now - DEFAULT_EVENTS_LOOKBACK))
+        end = float(req.query.get("endTime", now))
+        comps = _components_filter(req)
+        out = []
+        for c in srv.registry.all():
+            if comps and c.name() not in comps:
+                continue
+            evs = [e for e in c.events(start) if e.time <= end]
+            out.append(
+                ComponentEvents(
+                    component=c.name(), start_time=start, end_time=end, events=evs
+                ).to_dict()
+            )
+        return _json(out)
+
+    async def metrics_v1(req: web.Request) -> web.Response:
+        now = time.time()
+        since = float(req.query.get("since", now - DEFAULT_METRICS_LOOKBACK))
+        comps = _components_filter(req)
+        ms = srv.metrics_store.read(since, components=comps)
+        by_comp = {}
+        for m in ms:
+            comp = m.labels.get("component", "")
+            by_comp.setdefault(comp, []).append(m)
+        return _json(
+            [
+                ComponentMetrics(component=k, metrics=v).to_dict()
+                for k, v in sorted(by_comp.items())
+            ]
+        )
+
+    async def info(req: web.Request) -> web.Response:
+        now = time.time()
+        start = float(req.query.get("startTime", now - DEFAULT_EVENTS_LOOKBACK))
+        comps = _components_filter(req)
+        ms = srv.metrics_store.read(start, components=comps)
+        metrics_by_comp = {}
+        for m in ms:
+            metrics_by_comp.setdefault(m.labels.get("component", ""), []).append(m)
+        out = []
+        for c in srv.registry.all():
+            if comps and c.name() not in comps:
+                continue
+            out.append(
+                ComponentInfo(
+                    component=c.name(),
+                    start_time=start,
+                    end_time=now,
+                    states=c.last_health_states(),
+                    events=c.events(start),
+                    metrics=metrics_by_comp.get(c.name(), []),
+                ).to_dict()
+            )
+        return _json(out)
+
+    async def prometheus(_req: web.Request) -> web.Response:
+        return web.Response(
+            text=srv.metrics_registry.render_prometheus(),
+            content_type="text/plain",
+        )
+
+    async def machine_info_handler(_req: web.Request) -> web.Response:
+        mi = await _run_blocking(
+            srv,
+            lambda: machineinfo.get_machine_info(
+                tpu=srv.tpu_instance, machine_id=srv.machine_id
+            ),
+        )
+        return _json(mi.to_dict())
+
+    async def inject_fault(req: web.Request) -> web.Response:
+        try:
+            body = await req.json()
+        except json.JSONDecodeError:
+            return _json({"error": "invalid JSON body"}, 400)
+        ir = InjectRequest.from_dict(body)
+        err = await _run_blocking(srv, lambda: srv.fault_injector.inject(ir))
+        if err:
+            return _json({"error": err}, 400)
+        return _json({"injected": True})
+
+    async def admin_config(_req: web.Request) -> web.Response:
+        cfg = srv.config
+        # the local API is unauthenticated — never serve credentials
+        redacted = {"token", "machine_proof"}
+        return _json(
+            {
+                k: ("<redacted>" if k in redacted and v else v)
+                for k, v in vars(cfg).items()
+                if isinstance(v, (str, int, float, bool, list))
+            }
+        )
+
+    async def admin_packages(_req: web.Request) -> web.Response:
+        if srv.package_manager is None:
+            return _json([])
+        sts = await _run_blocking(srv, srv.package_manager.status)
+        return _json([s.to_dict() for s in sts])
+
+    async def plugins(_req: web.Request) -> web.Response:
+        specs = srv.plugin_specs or []
+        return _json([s.to_dict() for s in specs])
+
+    r.add_get("/healthz", healthz)
+    r.add_get("/v1/components", list_components)
+    r.add_delete("/v1/components", deregister_component)
+    r.add_get("/v1/components/trigger-check", trigger_check)
+    r.add_post("/v1/components/set-healthy", set_healthy)
+    r.add_get("/v1/states", states)
+    r.add_get("/v1/events", events)
+    r.add_get("/v1/metrics", metrics_v1)
+    r.add_get("/v1/info", info)
+    r.add_get("/v1/plugins", plugins)
+    r.add_get("/metrics", prometheus)
+    r.add_get("/machine-info", machine_info_handler)
+    r.add_post("/inject-fault", inject_fault)
+    r.add_get("/admin/config", admin_config)
+    r.add_get("/admin/packages", admin_packages)
+    return app
+
+
+async def _run_blocking(srv: "Server", fn):
+    """Run a blocking check in the loop's default executor so slow checks
+    don't stall the API (reference rationale:
+    session_process_request.go:108-125 triggerComponent is async)."""
+    import asyncio
+
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, fn)

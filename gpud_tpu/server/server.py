@@ -1,0 +1,225 @@
+"""Daemon composition root.
+
+Reference: pkg/server/server.go:117 ``server.New`` (call stack in SURVEY
+§3.1): open DBs → metadata → eventstore + reboot store → metrics pipeline
+→ fault injector → TPU instance → TpudInstance DI → registry (all
+components) → component Start() → TLS → routes → listener; plus the
+session/token loop and the auto-update watcher (wired in later stages).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import List, Optional
+
+from aiohttp import web
+
+from gpud_tpu import host as pkghost
+from gpud_tpu.components.all import all_components
+from gpud_tpu.components.base import FailureInjector, Registry, TpudInstance
+from gpud_tpu.components.tpu.error_kmsg import TPUErrorKmsgComponent
+from gpud_tpu.config import Config, default_config
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.fault_injector import Injector
+from gpud_tpu.kmsg.syncer import SharedWatcher, Syncer
+from gpud_tpu.kmsg.watcher import kmsg_path
+from gpud_tpu.log import get_logger
+from gpud_tpu.metadata import Metadata
+from gpud_tpu.metrics.registry import DEFAULT_REGISTRY, Registry as MetricsRegistry
+from gpud_tpu.metrics.store import MetricsStore, SelfMetricsRecorder, Syncer as MetricsSyncer
+from gpud_tpu.server.app import build_app
+from gpud_tpu.server.tls import generate_self_signed, server_ssl_context
+from gpud_tpu.sqlite import open_rw_ro
+from gpud_tpu.tpu.instance import new_instance
+from gpud_tpu.version import __version__
+
+logger = get_logger(__name__)
+
+
+class Server:
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        failure_injector: Optional[FailureInjector] = None,
+        metrics_registry: Optional[MetricsRegistry] = None,
+    ) -> None:
+        self.config = config or default_config()
+        self.version = __version__
+        err = self.config.validate()
+        if err:
+            raise ValueError(err)
+
+        # persistence (reference: server.go:132-221)
+        self.db_rw, self.db_ro = open_rw_ro(self.config.state_file())
+        self.metadata = Metadata(self.db_rw)
+        self.event_store = EventStore(
+            self.db_rw, retention_seconds=self.config.events_retention_seconds
+        )
+        self.reboot_event_store = pkghost.RebootEventStore(self.event_store)
+        self.reboot_event_store.record_reboot()
+        self.machine_id = (
+            self.config.machine_id
+            or self.metadata.machine_id()
+            or pkghost.machine_id()
+        )
+
+        # metrics pipeline (reference: server.go:223-242)
+        self.metrics_registry = metrics_registry or DEFAULT_REGISTRY
+        self.metrics_store = MetricsStore(
+            self.db_rw, retention_seconds=self.config.metrics_retention_seconds
+        )
+        self.metrics_syncer = MetricsSyncer(
+            self.metrics_registry,
+            self.metrics_store,
+            interval_seconds=self.config.scrape_interval_seconds,
+        )
+        self.self_metrics = SelfMetricsRecorder(self.metrics_registry, self.db_rw)
+
+        # fault injection + accelerator (reference: server.go:274-296)
+        self._kmsg_path = kmsg_path(self.config.kmsg_path)
+        self.fault_injector = Injector(kmsg_path=self._kmsg_path)
+        self.tpu_instance = new_instance(
+            failure_injector=failure_injector,
+            accelerator_type=self.config.accelerator_type_override,
+        )
+
+        # DI + registry (reference: server.go:298-340)
+        self.tpud_instance = TpudInstance(
+            machine_id=self.machine_id,
+            tpu_instance=self.tpu_instance,
+            db_rw=self.db_rw,
+            db_ro=self.db_ro,
+            event_store=self.event_store,
+            reboot_event_store=self.reboot_event_store,
+            mount_points=list(self.config.mount_points),
+            mount_targets=list(self.config.mount_targets),
+            kernel_modules_to_check=list(self.config.kernel_modules_to_check),
+            kmsg_path=self._kmsg_path,
+            failure_injector=failure_injector,
+            config=self.config,
+        )
+        self.registry = Registry(self.tpud_instance)
+        enabled = set(self.config.components_enabled)
+        disabled = set(self.config.components_disabled)
+        for init_func in all_components():
+            name = getattr(init_func, "NAME", "")
+            if enabled and name not in enabled:
+                continue
+            if name in disabled:
+                continue
+            self.registry.must_register(init_func)
+
+        # shared kmsg watcher: one reader feeding every kmsg-consuming
+        # component (reference hot-loop #2, SURVEY §3.1)
+        self.kmsg_watcher = SharedWatcher(path=self._kmsg_path, from_now=True)
+        self._wire_kmsg_syncers()
+
+        # plugins/packages placeholders (stage 8 wires them)
+        self.plugin_specs = None
+        self.package_manager = None
+        self.session = None
+
+        # http plumbing
+        self._app = build_app(self)
+        self._runner: Optional[web.AppRunner] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._start_error: Optional[BaseException] = None
+        self.port = self.config.port
+
+    def _wire_kmsg_syncers(self) -> None:
+        from gpud_tpu.components.cpu import match_cpu_lockup
+        from gpud_tpu.components.memory import match_oom
+        from gpud_tpu.components.os_comp import match_kernel_panic
+
+        for comp_name, match_fn in (
+            ("cpu", match_cpu_lockup),
+            ("memory", match_oom),
+            ("os", match_kernel_panic),
+        ):
+            self.kmsg_watcher.register(
+                Syncer(match_fn, self.event_store.bucket(comp_name))
+            )
+        err_comp = self.registry.get(TPUErrorKmsgComponent.NAME)
+        if err_comp is not None and err_comp.syncer is not None:
+            self.kmsg_watcher.register(err_comp.syncer)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        """Start pollers + API listener (non-blocking; reference spawns
+        goroutines at server.go:390-450)."""
+        for comp in self.registry.all():
+            if comp.is_supported():
+                comp.start()
+        self.kmsg_watcher.start()
+        self.event_store.start_purger()
+        self.metrics_syncer.start()
+        self.self_metrics.start()
+
+        self._thread = threading.Thread(
+            target=self._serve, name="tpud-http", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=15.0):
+            raise RuntimeError("API listener failed to start in time")
+        if self._start_error is not None:
+            raise RuntimeError(f"API listener failed: {self._start_error}")
+
+    def _serve(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+
+        async def _run():
+            runner = web.AppRunner(self._app)
+            await runner.setup()
+            ssl_ctx = None
+            if self.config.tls:
+                cert, key = generate_self_signed()
+                ssl_ctx = server_ssl_context(cert, key)
+            site = web.TCPSite(runner, "0.0.0.0", self.config.port, ssl_context=ssl_ctx)
+            await site.start()
+            # pick up the ephemeral port if 0 was requested (tests)
+            for s in site._server.sockets:  # noqa: SLF001
+                self.port = s.getsockname()[1]
+                break
+            self._runner = runner
+            self._started.set()
+
+        try:
+            loop.run_until_complete(_run())
+            loop.run_forever()
+        except BaseException as e:  # noqa: BLE001
+            self._start_error = e
+            self._started.set()
+        finally:
+            try:
+                if self._runner is not None:
+                    loop.run_until_complete(self._runner.cleanup())
+            except Exception:  # noqa: BLE001
+                pass
+            loop.close()
+
+    def stop(self) -> None:
+        logger.info("stopping tpud server")
+        if self._loop is not None and self._loop.is_running():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.metrics_syncer.close()
+        self.self_metrics.close()
+        self.kmsg_watcher.close()
+        for comp in self.registry.all():
+            try:
+                comp.close()
+            except Exception:  # noqa: BLE001
+                logger.exception("component %s close failed", comp.name())
+        self.event_store.close()
+
+    # -- conveniences ------------------------------------------------------
+    def base_url(self) -> str:
+        scheme = "https" if self.config.tls else "http"
+        return f"{scheme}://localhost:{self.port}"

@@ -1,0 +1,587 @@
+"""The TPU accelerator adapter — tpud's native boundary.
+
+This is the analog of ``nvml.Instance`` (reference:
+pkg/nvidia/nvml/instance.go:43-97): one interface the rest of the daemon
+talks to, with interchangeable backends behind it:
+
+- ``MockBackend`` — full all-success fixture set, enabled with
+  ``TPUD_TPU_MOCK_ALL_SUCCESS`` so the entire daemon runs "with TPUs" on a
+  CPU-only box (reference: GPUD_NVML_MOCK_ALL_SUCCESS,
+  pkg/nvidia/nvml/lib/default.go:14-50); targeted injection envs
+  ``TPUD_TPU_INJECT_*`` mirror the reference's injection envs.
+- ``SysfsBackend`` — enumerates real /dev/accel* + /sys/class/accel (the
+  Google TPU driver's device nodes) and vfio devices; telemetry is read
+  from driver sysfs when exposed.
+- ``JaxBackend`` — enumerates through a live libtpu via ``jax.devices()``
+  (lazy import; opt-in with ``TPUD_TPU_USE_JAX=1`` since loading libtpu
+  grabs the chips, which a monitoring daemon must not do by default while
+  a training job owns them — the key TPU-vs-NVML design difference: NVML
+  is a side-band API, libtpu is exclusive-open).
+- ``with_failure_injector`` wraps any backend to simulate chip-lost /
+  requires-reset / enumeration failure / product override (reference:
+  nvml.NewWithFailureInjector, instance.go:18-38,115).
+"""
+
+from __future__ import annotations
+
+import glob
+import math
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from gpud_tpu.components.base import FailureInjector
+from gpud_tpu.log import get_logger
+from gpud_tpu.tpu.topology import (
+    GENERATIONS,
+    SliceTopology,
+    normalize_generation,
+    parse_accelerator_type,
+)
+
+logger = get_logger(__name__)
+
+ENV_MOCK_ALL_SUCCESS = "TPUD_TPU_MOCK_ALL_SUCCESS"
+ENV_MOCK_ACCEL_TYPE = "TPUD_TPU_MOCK_ACCELERATOR_TYPE"
+ENV_USE_JAX = "TPUD_TPU_USE_JAX"
+ENV_INJECT_HBM_ECC_PENDING = "TPUD_TPU_INJECT_HBM_ECC_PENDING"
+ENV_INJECT_THERMAL_SLOWDOWN = "TPUD_TPU_INJECT_THERMAL_SLOWDOWN"
+ENV_INJECT_ICI_LINK_DOWN = "TPUD_TPU_INJECT_ICI_LINK_DOWN"
+
+# Google TPU PCI vendor/device ids (accel driver)
+TPU_PCI_VENDOR = "0x1ae0"
+
+
+class LinkState:
+    UP = "up"
+    DOWN = "down"
+    UNKNOWN = "unknown"
+
+
+@dataclass
+class ICILinkSnapshot:
+    """One ICI port's state+counters at a point in time — the TPU analog of
+    an InfiniBand port snapshot (reference:
+    components/accelerator/nvidia/infiniband/class/class.go:14-34)."""
+
+    chip_id: int
+    link_id: int
+    state: str = LinkState.UP
+    tx_bytes: int = 0
+    rx_bytes: int = 0
+    tx_errors: int = 0
+    rx_errors: int = 0
+    crc_errors: int = 0
+    replays: int = 0
+    speed_gbps: float = 0.0
+
+    @property
+    def name(self) -> str:
+        return f"chip{self.chip_id}/ici{self.link_id}"
+
+
+@dataclass
+class TPUChipTelemetry:
+    chip_id: int
+    temperature_c: float = 0.0
+    hbm_temperature_c: float = 0.0
+    power_w: float = 0.0
+    hbm_used_bytes: int = 0
+    hbm_total_bytes: int = 0
+    duty_cycle_pct: float = 0.0      # tensorcore duty cycle
+    tensorcore_util_pct: float = 0.0
+    hbm_ecc_correctable: int = 0
+    hbm_ecc_uncorrectable: int = 0
+    hbm_ecc_pending: bool = False
+    thermal_slowdown: bool = False
+    clock_mhz: float = 0.0
+
+
+@dataclass
+class TPUChip:
+    chip_id: int
+    device_path: str = ""
+    pci_address: str = ""
+    serial: str = ""
+    generation: str = ""
+    cores: int = 2
+    hbm_total_bytes: int = 0
+    lost: bool = False
+    requires_reset: bool = False
+
+
+class TPUInstance:
+    """Top interface (reference: pkg/nvidia/nvml/instance.go:43-97)."""
+
+    # -- presence ----------------------------------------------------------
+    def tpu_lib_exists(self) -> bool:
+        raise NotImplementedError
+
+    def init_error(self) -> str:
+        return ""
+
+    # -- identity ----------------------------------------------------------
+    def product_name(self) -> str:
+        raise NotImplementedError
+
+    def accelerator_type(self) -> str:
+        raise NotImplementedError
+
+    def topology(self) -> Optional[SliceTopology]:
+        return parse_accelerator_type(self.accelerator_type())
+
+    def generation(self) -> str:
+        t = self.topology()
+        return t.generation if t else ""
+
+    def driver_version(self) -> str:
+        return ""
+
+    def runtime_version(self) -> str:
+        return ""
+
+    def worker_id(self) -> int:
+        return 0
+
+    # -- devices -----------------------------------------------------------
+    def devices(self) -> Dict[int, TPUChip]:
+        raise NotImplementedError
+
+    def telemetry(self) -> Dict[int, TPUChipTelemetry]:
+        return {}
+
+    def ici_links(self) -> List[ICILinkSnapshot]:
+        return []
+
+    # -- capabilities (reference: FabricStateSupported etc.,
+    #    nvml/instance.go:77-81) ------------------------------------------
+    def telemetry_supported(self) -> bool:
+        return False
+
+    def ici_supported(self) -> bool:
+        return False
+
+    def shutdown(self) -> None:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Mock backend
+# ---------------------------------------------------------------------------
+
+class MockBackend(TPUInstance):
+    """All-success fixture backend (reference:
+    pkg/nvidia/nvml/lib/mock_fixtures.go:12-149 allSuccessInterface).
+
+    Telemetry is deterministic-but-wobbling (sinusoid over a fake clock) so
+    metric pipelines see changing values; the fake clock is injectable.
+    """
+
+    def __init__(self, accelerator_type: str = "", worker_id: int = 0) -> None:
+        self._accel_type = (
+            accelerator_type
+            or os.environ.get(ENV_MOCK_ACCEL_TYPE, "")
+            or "v5e-8"
+        )
+        topo = parse_accelerator_type(self._accel_type)
+        if topo is None:
+            raise ValueError(f"unknown accelerator type {self._accel_type!r}")
+        self._topo = topo
+        self._worker_id = worker_id
+        self.time_now_fn = time.time
+        self._chips = {
+            i: TPUChip(
+                chip_id=i,
+                device_path=f"/dev/accel{i}",
+                pci_address=f"0000:{0x10 + i:02x}:00.0",
+                serial=f"mock-{self._topo.generation}-{worker_id}-{i}",
+                generation=self._topo.generation,
+                cores=GENERATIONS[self._topo.generation].cores_per_chip,
+                hbm_total_bytes=self._topo.hbm_bytes_per_chip,
+            )
+            for i in range(self._topo.chips_per_host)
+        }
+        # env-based targeted injections (reference: default.go:33-50)
+        self._ecc_pending_chips = _int_set(os.environ.get(ENV_INJECT_HBM_ECC_PENDING, ""))
+        self._thermal_chips = _int_set(os.environ.get(ENV_INJECT_THERMAL_SLOWDOWN, ""))
+        self._down_links = set(
+            x for x in os.environ.get(ENV_INJECT_ICI_LINK_DOWN, "").split(",") if x
+        )
+
+    def tpu_lib_exists(self) -> bool:
+        return True
+
+    def product_name(self) -> str:
+        return f"TPU {self._topo.generation}"
+
+    def accelerator_type(self) -> str:
+        return self._accel_type
+
+    def driver_version(self) -> str:
+        return "mock-driver-1.0"
+
+    def runtime_version(self) -> str:
+        return "mock-libtpu-0.1"
+
+    def worker_id(self) -> int:
+        return self._worker_id
+
+    def devices(self) -> Dict[int, TPUChip]:
+        return dict(self._chips)
+
+    def telemetry_supported(self) -> bool:
+        return True
+
+    def ici_supported(self) -> bool:
+        return True
+
+    def telemetry(self) -> Dict[int, TPUChipTelemetry]:
+        t = self.time_now_fn()
+        out: Dict[int, TPUChipTelemetry] = {}
+        for cid, chip in self._chips.items():
+            wobble = math.sin(t / 60.0 + cid)
+            tel = TPUChipTelemetry(
+                chip_id=cid,
+                temperature_c=45.0 + 5.0 * wobble,
+                hbm_temperature_c=52.0 + 6.0 * wobble,
+                power_w=120.0 + 30.0 * wobble,
+                hbm_used_bytes=int(chip.hbm_total_bytes * (0.3 + 0.1 * (wobble + 1) / 2)),
+                hbm_total_bytes=chip.hbm_total_bytes,
+                duty_cycle_pct=50.0 + 40.0 * (wobble + 1) / 2,
+                tensorcore_util_pct=40.0 + 30.0 * (wobble + 1) / 2,
+                clock_mhz=940.0,
+            )
+            if cid in self._ecc_pending_chips:
+                tel.hbm_ecc_uncorrectable = 1
+                tel.hbm_ecc_pending = True
+            if cid in self._thermal_chips:
+                tel.temperature_c = 95.0
+                tel.thermal_slowdown = True
+            out[cid] = tel
+        return out
+
+    def ici_links(self) -> List[ICILinkSnapshot]:
+        t = self.time_now_fn()
+        links: List[ICILinkSnapshot] = []
+        n_links = self._topo.ici_links_per_chip
+        for cid in self._chips:
+            for lid in range(n_links):
+                name = f"chip{cid}/ici{lid}"
+                down = name in self._down_links
+                links.append(
+                    ICILinkSnapshot(
+                        chip_id=cid,
+                        link_id=lid,
+                        state=LinkState.DOWN if down else LinkState.UP,
+                        tx_bytes=int(t * 1e6) + cid * 1000 + lid,
+                        rx_bytes=int(t * 1e6) + cid * 1000 + lid + 7,
+                        tx_errors=0,
+                        rx_errors=0,
+                        crc_errors=0,
+                        replays=0,
+                        speed_gbps=450.0 if self._topo.generation == "v5p" else 200.0,
+                    )
+                )
+        return links
+
+
+def _int_set(spec: str) -> set:
+    out = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if part.isdigit():
+            out.add(int(part))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Sysfs backend (real TPU VM, side-band — no libtpu open)
+# ---------------------------------------------------------------------------
+
+class SysfsBackend(TPUInstance):
+    """Enumerates the Google TPU driver's device nodes without opening
+    libtpu (side-band monitoring only). Roots are parameterized so sysfs
+    fixture trees drive tests (SURVEY §4.4 fixture-directory pattern)."""
+
+    def __init__(
+        self,
+        dev_root: str = "/dev",
+        sys_accel_root: str = "/sys/class/accel",
+        pci_root: str = "/sys/bus/pci/devices",
+        accelerator_type: str = "",
+        worker_id: int = 0,
+    ) -> None:
+        self.dev_root = dev_root
+        self.sys_accel_root = sys_accel_root
+        self.pci_root = pci_root
+        self._accel_type = accelerator_type or _gce_metadata_accel_type()
+        self._worker_id = worker_id
+        self._init_error = ""
+        self._chips = self._enumerate()
+
+    def _enumerate(self) -> Dict[int, TPUChip]:
+        chips: Dict[int, TPUChip] = {}
+        topo = parse_accelerator_type(self._accel_type) if self._accel_type else None
+        gen = topo.generation if topo else ""
+        hbm = topo.hbm_bytes_per_chip if topo else 0
+        for path in sorted(glob.glob(os.path.join(self.dev_root, "accel[0-9]*"))):
+            m = re.search(r"accel(\d+)$", path)
+            if not m:
+                continue
+            cid = int(m.group(1))
+            chip = TPUChip(
+                chip_id=cid,
+                device_path=path,
+                generation=gen,
+                hbm_total_bytes=hbm,
+            )
+            # PCI address via /sys/class/accel/accelN/device symlink
+            sys_dev = os.path.join(self.sys_accel_root, f"accel{cid}", "device")
+            try:
+                chip.pci_address = os.path.basename(os.readlink(sys_dev))
+            except OSError:
+                pass
+            chips[cid] = chip
+        if not chips:
+            # vfio-based runtimes expose chips as /dev/vfio/* instead
+            vfio = sorted(glob.glob(os.path.join(self.dev_root, "vfio", "[0-9]*")))
+            for i, path in enumerate(vfio):
+                chips[i] = TPUChip(chip_id=i, device_path=path, generation=gen,
+                                   hbm_total_bytes=hbm)
+        return chips
+
+    def tpu_lib_exists(self) -> bool:
+        return bool(self._chips)
+
+    def init_error(self) -> str:
+        return self._init_error
+
+    def product_name(self) -> str:
+        t = self.topology()
+        return f"TPU {t.generation}" if t else "TPU"
+
+    def accelerator_type(self) -> str:
+        return self._accel_type
+
+    def driver_version(self) -> str:
+        for name in ("google_tpu", "accel", "gasket"):
+            v = _read_file(f"/sys/module/{name}/version")
+            if v:
+                return v
+        return ""
+
+    def worker_id(self) -> int:
+        return self._worker_id
+
+    def devices(self) -> Dict[int, TPUChip]:
+        return dict(self._chips)
+
+    def telemetry_supported(self) -> bool:
+        return False  # sysfs telemetry not exposed by current drivers
+
+    def ici_supported(self) -> bool:
+        return False
+
+
+def _read_file(path: str) -> str:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def _gce_metadata_accel_type(timeout: float = 1.0) -> str:
+    """accelerator-type from the GCE TPU-VM metadata server; empty off-GCE."""
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            "http://metadata.google.internal/computeMetadata/v1/instance/attributes/accelerator-type",
+            headers={"Metadata-Flavor": "Google"},
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.read().decode().strip()
+    except Exception:  # noqa: BLE001 — any failure means "not a TPU VM"
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# JAX backend (opt-in: opening libtpu is exclusive)
+# ---------------------------------------------------------------------------
+
+class JaxBackend(TPUInstance):
+    """Enumerates chips and samples HBM telemetry through a live libtpu via
+    JAX. Opt-in (TPUD_TPU_USE_JAX=1): libtpu open is exclusive, so this
+    backend must only run where tpud owns the chips (e.g. dedicated health
+    probes), never side-band under a training job."""
+
+    def __init__(self, accelerator_type: str = "") -> None:
+        self._init_error = ""
+        self._accel_type = accelerator_type
+        self._devices: Dict[int, TPUChip] = {}
+        self._jax_devices = []
+        self._lock = threading.Lock()
+        try:
+            import jax
+
+            devs = [d for d in jax.devices() if d.platform in ("tpu", "axon")]
+            self._jax_devices = devs
+            for d in devs:
+                gen = normalize_generation(getattr(d, "device_kind", ""))
+                self._devices[d.id] = TPUChip(
+                    chip_id=d.id,
+                    device_path=f"jax:{d.id}",
+                    generation=gen,
+                    cores=getattr(d, "num_cores", 1) if hasattr(d, "num_cores") else 1,
+                )
+            if not self._accel_type and devs:
+                gen = normalize_generation(getattr(devs[0], "device_kind", ""))
+                n = len(devs)
+                spec = GENERATIONS.get(gen)
+                if spec is not None:
+                    count = n if spec.suffix_counts_chips else n * spec.cores_per_chip
+                    self._accel_type = f"{gen}-{count}"
+        except Exception as e:  # noqa: BLE001
+            self._init_error = str(e)
+
+    def tpu_lib_exists(self) -> bool:
+        return bool(self._devices)
+
+    def init_error(self) -> str:
+        return self._init_error
+
+    def product_name(self) -> str:
+        if self._jax_devices:
+            return getattr(self._jax_devices[0], "device_kind", "TPU")
+        return "TPU"
+
+    def accelerator_type(self) -> str:
+        return self._accel_type
+
+    def devices(self) -> Dict[int, TPUChip]:
+        return dict(self._devices)
+
+    def telemetry_supported(self) -> bool:
+        return bool(self._devices)
+
+    def telemetry(self) -> Dict[int, TPUChipTelemetry]:
+        out: Dict[int, TPUChipTelemetry] = {}
+        with self._lock:
+            for d in self._jax_devices:
+                tel = TPUChipTelemetry(chip_id=d.id)
+                try:
+                    stats = d.memory_stats() or {}
+                    tel.hbm_used_bytes = int(stats.get("bytes_in_use", 0))
+                    tel.hbm_total_bytes = int(stats.get("bytes_limit", 0))
+                except Exception:  # noqa: BLE001
+                    pass
+                out[d.id] = tel
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Failure-injector wrapper + factory
+# ---------------------------------------------------------------------------
+
+class InjectedInstance(TPUInstance):
+    """Wraps a real/mock backend and overlays simulated failures
+    (reference: nvml.NewWithFailureInjector, instance.go:18-38,115)."""
+
+    def __init__(self, inner: TPUInstance, injector: FailureInjector) -> None:
+        self.inner = inner
+        self.injector = injector
+
+    def tpu_lib_exists(self) -> bool:
+        if self.injector.tpu_enumeration_error:
+            return False
+        return self.inner.tpu_lib_exists()
+
+    def init_error(self) -> str:
+        if self.injector.tpu_enumeration_error:
+            return "injected: TPU enumeration failure"
+        return self.inner.init_error()
+
+    def product_name(self) -> str:
+        return self.injector.product_name_override or self.inner.product_name()
+
+    def accelerator_type(self) -> str:
+        return self.inner.accelerator_type()
+
+    def driver_version(self) -> str:
+        return self.inner.driver_version()
+
+    def runtime_version(self) -> str:
+        return self.inner.runtime_version()
+
+    def worker_id(self) -> int:
+        return self.inner.worker_id()
+
+    def devices(self) -> Dict[int, TPUChip]:
+        if self.injector.tpu_enumeration_error:
+            return {}
+        devs = self.inner.devices()
+        out: Dict[int, TPUChip] = {}
+        for cid, chip in devs.items():
+            if cid in self.injector.chip_ids_lost:
+                chip = TPUChip(**{**chip.__dict__, "lost": True})
+            if cid in self.injector.chip_ids_requires_reset:
+                chip = TPUChip(**{**chip.__dict__, "requires_reset": True})
+            out[cid] = chip
+        return out
+
+    def telemetry_supported(self) -> bool:
+        return self.inner.telemetry_supported()
+
+    def ici_supported(self) -> bool:
+        return self.inner.ici_supported()
+
+    def telemetry(self) -> Dict[int, TPUChipTelemetry]:
+        tel = self.inner.telemetry()
+        for cid in self.injector.chip_ids_hbm_ecc_pending:
+            if cid in tel:
+                tel[cid].hbm_ecc_uncorrectable += 1
+                tel[cid].hbm_ecc_pending = True
+        for cid in self.injector.chip_ids_thermal_slowdown:
+            if cid in tel:
+                tel[cid].temperature_c = max(tel[cid].temperature_c, 95.0)
+                tel[cid].thermal_slowdown = True
+        for cid in self.injector.chip_ids_lost:
+            tel.pop(cid, None)
+        return tel
+
+    def ici_links(self) -> List[ICILinkSnapshot]:
+        links = self.inner.ici_links()
+        down = set(self.injector.ici_links_down)
+        for ln in links:
+            if ln.name in down:
+                ln.state = LinkState.DOWN
+        return links
+
+    def shutdown(self) -> None:
+        self.inner.shutdown()
+
+
+def new_instance(
+    failure_injector: Optional[FailureInjector] = None,
+    accelerator_type: str = "",
+    worker_id: int = 0,
+) -> TPUInstance:
+    """Factory (reference: nvml.New / NewWithFailureInjector).
+
+    Order: mock env → JAX (opt-in) → sysfs. The returned instance is always
+    usable; absence of TPUs is reported through ``tpu_lib_exists()``.
+    """
+    inst: TPUInstance
+    if os.environ.get(ENV_MOCK_ALL_SUCCESS, "").lower() in ("1", "true", "yes"):
+        inst = MockBackend(accelerator_type=accelerator_type, worker_id=worker_id)
+    elif os.environ.get(ENV_USE_JAX, "").lower() in ("1", "true", "yes"):
+        inst = JaxBackend(accelerator_type=accelerator_type)
+    else:
+        inst = SysfsBackend(accelerator_type=accelerator_type, worker_id=worker_id)
+    if failure_injector is not None and not failure_injector.empty():
+        inst = InjectedInstance(inst, failure_injector)
+    return inst

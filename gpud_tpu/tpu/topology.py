@@ -1,0 +1,133 @@
+"""TPU topology knowledge: accelerator-type parsing and expectations.
+
+This is the analog of the reference's product→capabilities mapping
+(reference: pkg/nvidia/product — product name → memory-error-mgmt /
+row-remapping / fabric support). For TPUs the product string is the
+accelerator type (e.g. ``v5p-256``) and the derived facts are chip counts,
+chips-per-host, ICI link counts per chip, and HBM capacity.
+
+Conventions encoded here:
+- v2/v3/v4/v5p: the numeric suffix counts TensorCores; chips = N/2.
+- v5e (v5litepod) / v6e: the suffix counts chips directly.
+- chips per host: v4/v5p → 4; v5e/v6e → 8 (single-host slices may have
+  fewer, e.g. v5e-4).
+- ICI links per chip: 3D-torus generations (v4, v5p) → 6; 2D-torus
+  (v5e, v6e) → 4.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Optional
+
+_GiB = 1024**3
+
+
+@dataclass(frozen=True)
+class GenerationSpec:
+    name: str
+    cores_per_chip: int
+    suffix_counts_chips: bool   # else counts TensorCores
+    chips_per_host: int
+    ici_links_per_chip: int
+    hbm_bytes_per_chip: int
+    supports_ici_fabric: bool   # multi-chip ICI observable
+
+
+GENERATIONS = {
+    "v2": GenerationSpec("v2", 2, False, 4, 4, 8 * _GiB, True),
+    "v3": GenerationSpec("v3", 2, False, 4, 4, 16 * _GiB, True),
+    "v4": GenerationSpec("v4", 2, False, 4, 6, 32 * _GiB, True),
+    "v5e": GenerationSpec("v5e", 1, True, 8, 4, 16 * _GiB, True),
+    "v5p": GenerationSpec("v5p", 2, False, 4, 6, 95 * _GiB, True),
+    "v6e": GenerationSpec("v6e", 1, True, 8, 4, 32 * _GiB, True),
+}
+
+_ACCEL_RE = re.compile(r"^(v\d+(?:e|p|litepod)?)-(\d+)$")
+
+# aliases seen in GCE metadata / jax device kinds
+_ALIASES = {
+    "v5litepod": "v5e",
+    "v5lite": "v5e",
+    "tpu v2": "v2",
+    "tpu v3": "v3",
+    "tpu v4": "v4",
+    "tpu v5": "v5e",
+    "tpu v5 lite": "v5e",
+    "tpu v5e": "v5e",
+    "tpu v5 lite0": "v5e",
+    "tpu v5p": "v5p",
+    "tpu v6e": "v6e",
+    "tpu v6 lite": "v6e",
+}
+
+
+def normalize_generation(name: str) -> str:
+    n = name.strip().lower()
+    if n in GENERATIONS:
+        return n
+    if n in _ALIASES:
+        return _ALIASES[n]
+    # e.g. "TPU v5 lite0" (jax device kind) → strip trailing digits
+    base = re.sub(r"\d+$", "", n).strip()
+    if base in _ALIASES:
+        return _ALIASES[base]
+    return n
+
+
+@dataclass
+class SliceTopology:
+    accelerator_type: str
+    generation: str
+    total_chips: int
+    total_cores: int
+    hosts: int
+    chips_per_host: int
+    ici_links_per_chip: int
+    hbm_bytes_per_chip: int
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+
+def parse_accelerator_type(accel_type: str) -> Optional[SliceTopology]:
+    """``v5p-256`` → SliceTopology(generation=v5p, chips=128, hosts=32, ...).
+    Returns None for unknown formats."""
+    m = _ACCEL_RE.match(accel_type.strip().lower())
+    if not m:
+        return None
+    gen_name = normalize_generation(m.group(1))
+    spec = GENERATIONS.get(gen_name)
+    if spec is None:
+        return None
+    n = int(m.group(2))
+    if spec.suffix_counts_chips:
+        chips = n
+        cores = n * spec.cores_per_chip
+    else:
+        cores = n
+        chips = max(1, n // 2)
+    hosts = max(1, (chips + spec.chips_per_host - 1) // spec.chips_per_host)
+    chips_per_host = min(chips, spec.chips_per_host)
+    return SliceTopology(
+        accelerator_type=accel_type,
+        generation=gen_name,
+        total_chips=chips,
+        total_cores=cores,
+        hosts=hosts,
+        chips_per_host=chips_per_host,
+        ici_links_per_chip=spec.ici_links_per_chip,
+        hbm_bytes_per_chip=spec.hbm_bytes_per_chip,
+    )
+
+
+def expected_local_chips(accel_type: str) -> int:
+    """How many chips this host should see for the given accelerator type —
+    the TPU analog of expected GPU counts
+    (reference: components/accelerator/nvidia/gpu-counts)."""
+    topo = parse_accelerator_type(accel_type)
+    if topo is None:
+        return 0
+    return topo.chips_per_host

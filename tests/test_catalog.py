@@ -1,0 +1,69 @@
+import pytest
+
+from gpud_tpu.api.v1.types import EventType
+from gpud_tpu.components.tpu import catalog
+
+
+def test_match_driver_lines():
+    cases = {
+        "accel0: device lost after reset": "tpu_chip_lost",
+        "google_tpu: request timeout on queue 3": "tpu_driver_timeout",
+        "accel accel1: firmware crash detected": "tpu_driver_crash",
+        "uncorrectable HBM ECC error on channel 2": "tpu_hbm_ecc_uncorrectable",
+        "HBM correctable ecc count=3": "tpu_hbm_ecc_correctable",
+        "ICI link 4 down on chip 2": "tpu_ici_link_down",
+        "ICI port 1 retrain complete": "tpu_ici_link_flap",
+        "pcieport 0000:00:05.0: AER: uncorrectable error": "tpu_pcie_uncorrectable",
+        "libtpu.so: fatal: check failure in tpu_program": "tpu_runtime_fatal",
+        "megascale: DCN transport error to peer 12": "tpu_megascale_dcn_error",
+        "TPU thermal trip: chip 0 at 104C": "tpu_thermal_trip",
+    }
+    for line, want in cases.items():
+        m = catalog.match(line)
+        assert m is not None, line
+        assert m.entry.name == want, line
+
+
+def test_no_match_on_ordinary_lines():
+    for line in (
+        "systemd[1]: Started Daily apt upgrade.",
+        "EXT4-fs (sda1): mounted filesystem",
+        "audit: type=1400 apparmor",
+    ):
+        assert catalog.match(line) is None, line
+
+
+def test_chip_id_extraction():
+    m = catalog.match("ICI link 4 down on chip 2")
+    assert m.chip_id == 2
+    m = catalog.match("accel3: device lost")
+    assert m.chip_id == 3
+    m = catalog.match("uncorrectable HBM ECC error")
+    assert m.chip_id is None
+
+
+def test_injection_line_roundtrip():
+    for entry in catalog.CATALOG:
+        line = catalog.injection_line(entry.name, chip_id=5)
+        m = catalog.match(line)
+        assert m is not None, entry.name
+        assert m.entry.name == entry.name, f"{entry.name} matched {m.entry.name}"
+        assert m.chip_id == 5
+
+
+def test_injection_unknown_name():
+    with pytest.raises(KeyError):
+        catalog.injection_line("nope")
+
+
+def test_catalog_integrity():
+    names = [e.name for e in catalog.CATALOG]
+    assert len(names) == len(set(names))
+    codes = [e.code for e in catalog.CATALOG]
+    assert len(codes) == len(set(codes))
+    for e in catalog.CATALOG:
+        assert e.event_type in (
+            EventType.INFO, EventType.WARNING, EventType.CRITICAL, EventType.FATAL
+        )
+        assert catalog.lookup(e.name) is e
+        assert catalog.lookup_code(e.code) is e

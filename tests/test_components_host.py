@@ -1,0 +1,71 @@
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.components.cpu import CPUComponent, match_cpu_lockup
+from gpud_tpu.components.disk import DiskComponent
+from gpud_tpu.components.memory import MemoryComponent, match_oom
+from gpud_tpu.components.os_comp import OSComponent, match_kernel_panic
+
+
+def test_cpu_check_healthy():
+    c = CPUComponent(TpudInstance())
+    c.get_usage_fn = lambda: 12.5
+    c.get_load_fn = lambda: (0.5, 0.4, 0.3)
+    c.get_core_count_fn = lambda: 8
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert "12.5%" in cr.summary()
+
+
+def test_cpu_degraded_on_load():
+    c = CPUComponent(TpudInstance())
+    c.get_usage_fn = lambda: 99.0
+    c.get_load_fn = lambda: (50.0, 40.0, 30.0)
+    c.get_core_count_fn = lambda: 4
+    assert c.check().health_state_type() == HealthStateType.DEGRADED
+
+
+def test_cpu_lockup_matcher():
+    assert match_cpu_lockup("watchdog: BUG: soft lockup - CPU#2 stuck") is not None
+    assert match_cpu_lockup("normal boot line") is None
+
+
+def test_memory_check_and_matcher():
+    class VM:
+        total = 16 << 30
+        used = 8 << 30
+        available = 8 << 30
+        percent = 50.0
+
+    c = MemoryComponent(TpudInstance())
+    c.get_vm_fn = lambda: VM()
+    assert c.check().health_state_type() == HealthStateType.HEALTHY
+    VM.percent = 97.0
+    assert c.check().health_state_type() == HealthStateType.DEGRADED
+    assert match_oom("Out of memory: Killed process 1234 (python)") is not None
+    assert match_oom("plenty of memory") is None
+
+
+def test_disk_check_real_fs():
+    c = DiskComponent(TpudInstance())
+    cr = c.check()
+    assert cr.health_state_type() in (
+        HealthStateType.HEALTHY,
+        HealthStateType.DEGRADED,
+    )
+
+
+def test_disk_missing_mount_point():
+    c = DiskComponent(TpudInstance(mount_points=["/definitely/not/here"]))
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "missing" in cr.summary()
+
+
+def test_os_check_and_fd_threshold():
+    c = OSComponent(TpudInstance())
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert cr.extra_info["kernel_version"]
+    c.get_file_nr_fn = lambda: (95, 100)
+    assert c.check().health_state_type() == HealthStateType.DEGRADED
+    assert match_kernel_panic("Kernel panic - not syncing: Fatal exception") is not None

@@ -1,0 +1,86 @@
+from gpud_tpu.api.v1.types import HealthStateType, RepairActionType
+from gpud_tpu.components.base import FailureInjector, TpudInstance
+from gpud_tpu.components.tpu.chip_counts import TPUChipCountsComponent
+from gpud_tpu.components.tpu.hbm import TPUHbmComponent
+from gpud_tpu.components.tpu.power import TPUPowerComponent
+from gpud_tpu.components.tpu.temperature import TPUTemperatureComponent
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.tpu.instance import InjectedInstance, MockBackend
+
+
+def _inst(tmp_db=None, injector=None, accel="v5e-8"):
+    tpu = MockBackend(accelerator_type=accel)
+    if injector is not None:
+        tpu = InjectedInstance(tpu, injector)
+    es = EventStore(tmp_db) if tmp_db is not None else None
+    return TpudInstance(tpu_instance=tpu, event_store=es, failure_injector=injector)
+
+
+def test_temperature_healthy():
+    c = TPUTemperatureComponent(_inst())
+    assert c.is_supported()
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert "max temp" in cr.summary()
+
+
+def test_temperature_thermal_slowdown():
+    inj = FailureInjector(chip_ids_thermal_slowdown=[2])
+    c = TPUTemperatureComponent(_inst(injector=inj))
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "chip(s) [2]" in cr.summary()
+    assert RepairActionType.HARDWARE_INSPECTION in cr.suggested_actions.repair_actions
+
+
+def test_hbm_healthy_and_ecc(tmp_db):
+    c = TPUHbmComponent(_inst(tmp_db))
+    assert c.check().health_state_type() == HealthStateType.HEALTHY
+
+    inj = FailureInjector(chip_ids_hbm_ecc_pending=[0])
+    c2 = TPUHbmComponent(_inst(tmp_db, injector=inj))
+    cr = c2.check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    acts = cr.suggested_actions.repair_actions
+    assert RepairActionType.REBOOT_SYSTEM in acts
+    # ECC occurrence also recorded as an event
+    assert any(e.name == "hbm_ecc_uncorrectable" for e in c2.events(0))
+
+
+def test_power_metrics():
+    c = TPUPowerComponent(_inst())
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert "total draw" in cr.summary()
+
+
+def test_chip_counts_all_present():
+    c = TPUChipCountsComponent(_inst())
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert cr.extra_info["found"] == "8"
+    assert cr.extra_info["expected"] == "8"
+
+
+def test_chip_counts_lost_chip():
+    inj = FailureInjector(chip_ids_lost=[3])
+    c = TPUChipCountsComponent(_inst(injector=inj))
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "lost chip(s) [3]" in cr.summary()
+
+
+def test_chip_counts_requires_reset():
+    inj = FailureInjector(chip_ids_requires_reset=[1])
+    c = TPUChipCountsComponent(_inst(injector=inj))
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "require reset" in cr.summary()
+
+
+def test_chip_counts_enumeration_error():
+    inj = FailureInjector(tpu_enumeration_error=True)
+    c = TPUChipCountsComponent(_inst(injector=inj))
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "injected" in cr.summary()

@@ -1,0 +1,121 @@
+"""Evolve-state machine scenario tests (reference test style:
+components/accelerator/nvidia/xid health_state tests + infiniband
+component_production_scenarios_test.go)."""
+
+from gpud_tpu.api.v1.types import Event, EventType, HealthStateType, RepairActionType
+from gpud_tpu.components.tpu.health_state import evolve_health
+
+
+def _err(t, name):
+    return Event(time=t, name=name, type=EventType.FATAL, message=name)
+
+
+def _reboot(t):
+    return Event(time=t, name="reboot", type=EventType.WARNING, message="boot")
+
+
+def _set_healthy(t):
+    return Event(time=t, name="SetHealthy", type=EventType.INFO, message="op")
+
+
+def test_no_events_healthy():
+    ev = evolve_health([])
+    assert ev.health == HealthStateType.HEALTHY
+
+
+def test_first_occurrence_suggests_reboot():
+    ev = evolve_health([_err(10, "tpu_driver_timeout")])
+    assert ev.health == HealthStateType.UNHEALTHY
+    assert ev.suggested_actions.repair_actions == [RepairActionType.REBOOT_SYSTEM]
+    assert ev.active_errors == {"tpu_driver_timeout": 1}
+
+
+def test_reboot_clears_error():
+    ev = evolve_health([_err(10, "tpu_driver_timeout"), _reboot(20)])
+    assert ev.health == HealthStateType.HEALTHY
+    assert "cleared by reboot" in ev.reason
+
+
+def test_recurrence_below_threshold_still_suggests_reboot():
+    # tpu_driver_timeout threshold=2: one reboot then recurrence → still reboot
+    ev = evolve_health(
+        [_err(10, "tpu_driver_timeout"), _reboot(20), _err(30, "tpu_driver_timeout")]
+    )
+    assert ev.health == HealthStateType.UNHEALTHY
+    assert RepairActionType.REBOOT_SYSTEM in ev.suggested_actions.repair_actions
+
+
+def test_escalation_to_hw_inspection_after_threshold():
+    events = [
+        _err(10, "tpu_driver_timeout"),
+        _reboot(20),
+        _err(30, "tpu_driver_timeout"),
+        _reboot(40),
+        _err(50, "tpu_driver_timeout"),
+    ]
+    ev = evolve_health(events)
+    assert ev.health == HealthStateType.UNHEALTHY
+    assert ev.suggested_actions.repair_actions == [RepairActionType.HARDWARE_INSPECTION]
+    assert "recurred after 2 reboot(s)" in ev.reason
+
+
+def test_hbm_ecc_escalates_after_one_reboot():
+    # tpu_hbm_ecc_uncorrectable threshold=1
+    events = [
+        _err(10, "tpu_hbm_ecc_uncorrectable"),
+        _reboot(20),
+        _err(30, "tpu_hbm_ecc_uncorrectable"),
+    ]
+    ev = evolve_health(events)
+    assert ev.suggested_actions.repair_actions == [RepairActionType.HARDWARE_INSPECTION]
+
+
+def test_set_healthy_clears_slate():
+    events = [
+        _err(10, "tpu_hbm_ecc_uncorrectable"),
+        _reboot(20),
+        _err(30, "tpu_hbm_ecc_uncorrectable"),
+        _set_healthy(40),
+    ]
+    ev = evolve_health(events)
+    assert ev.health == HealthStateType.HEALTHY
+
+    # new error after set-healthy starts fresh (first occurrence → reboot)
+    ev2 = evolve_health(events + [_err(50, "tpu_hbm_ecc_uncorrectable")])
+    assert ev2.health == HealthStateType.UNHEALTHY
+    assert RepairActionType.REBOOT_SYSTEM in ev2.suggested_actions.repair_actions
+
+
+def test_non_critical_error_degraded_only():
+    ev = evolve_health(
+        [Event(time=10, name="tpu_hbm_ecc_correctable", type=EventType.WARNING)]
+    )
+    assert ev.health == HealthStateType.DEGRADED
+    assert ev.suggested_actions is None  # ignore-only action suppressed
+
+
+def test_multiple_errors_merge():
+    events = [
+        _err(10, "tpu_ici_link_down"),
+        _err(20, "tpu_hbm_ecc_uncorrectable"),
+    ]
+    ev = evolve_health(events)
+    assert ev.health == HealthStateType.UNHEALTHY
+    assert set(ev.active_errors) == {"tpu_ici_link_down", "tpu_hbm_ecc_uncorrectable"}
+
+
+def test_unknown_event_names_ignored():
+    ev = evolve_health([Event(time=10, name="not-in-catalog", type=EventType.FATAL)])
+    assert ev.health == HealthStateType.HEALTHY
+
+
+def test_out_of_order_events_sorted():
+    events = [
+        _err(50, "tpu_driver_timeout"),
+        _reboot(40),
+        _err(30, "tpu_driver_timeout"),
+        _reboot(20),
+        _err(10, "tpu_driver_timeout"),
+    ]
+    ev = evolve_health(events)
+    assert ev.suggested_actions.repair_actions == [RepairActionType.HARDWARE_INSPECTION]

@@ -1,0 +1,151 @@
+import time
+
+from gpud_tpu.api.v1.types import EventType
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.kmsg.deduper import Deduper
+from gpud_tpu.kmsg.syncer import SharedWatcher, Syncer
+from gpud_tpu.kmsg.watcher import Watcher, parse_line, read_all
+from gpud_tpu.kmsg.writer import KmsgWriter
+
+
+def test_parse_line():
+    m = parse_line("6,1234,5678901,-;hello world", boot_unix=1000.0)
+    assert m.priority == 6
+    assert m.facility == 0
+    assert m.sequence == 1234
+    assert m.timestamp_us == 5678901
+    assert m.message == "hello world"
+    assert abs(m.time - (1000.0 + 5.678901)) < 1e-6
+    assert m.priority_name == "info"
+
+
+def test_parse_line_facility_and_semicolons():
+    # facility 3 (daemon) → prefix = 3<<3 | 2 = 26
+    m = parse_line("26,1,10,-;msg;with;semis", boot_unix=0)
+    assert m.priority == 2 and m.facility == 3
+    assert m.message == "msg;with;semis"
+
+
+def test_parse_line_garbage():
+    assert parse_line(" SUBSYSTEM=pci") is None  # continuation
+    assert parse_line("no-separator") is None
+    assert parse_line("a,b,c;x") is None
+    assert parse_line("") is None
+
+
+def test_read_all_fixture(tmp_path):
+    p = tmp_path / "kmsg.fixture"
+    p.write_text("6,1,100,-;line one\n3,2,200,-;TPU error: bad\n SUBSYSTEM=x\n")
+    msgs = read_all(str(p))
+    assert [m.message for m in msgs] == ["line one", "TPU error: bad"]
+    assert msgs[1].priority == 3
+
+
+def test_read_all_env_override(tmp_path, monkeypatch):
+    p = tmp_path / "kmsg2"
+    p.write_text("4,9,50,-;via env\n")
+    monkeypatch.setenv("TPUD_KMSG_FILE_PATH", str(p))
+    msgs = read_all()
+    assert msgs[0].message == "via env"
+
+
+def test_watcher_follow_fixture(tmp_path):
+    p = tmp_path / "kmsg.follow"
+    p.write_text("6,1,100,-;old line\n")
+    got = []
+    w = Watcher(got.append, path=str(p), from_now=True, poll_timeout_ms=20)
+    w.start()
+    time.sleep(0.1)
+    with open(p, "a") as f:
+        f.write("3,2,200,-;new line\n")
+    deadline = time.time() + 3
+    while not got and time.time() < deadline:
+        time.sleep(0.02)
+    w.close()
+    assert [m.message for m in got] == ["new line"]  # from_now skips old
+
+
+def test_watcher_replay_mode(tmp_path):
+    p = tmp_path / "kmsg.replay"
+    p.write_text("6,1,100,-;old line\n")
+    got = []
+    w = Watcher(got.append, path=str(p), from_now=False, poll_timeout_ms=20)
+    w.start()
+    deadline = time.time() + 3
+    while not got and time.time() < deadline:
+        time.sleep(0.02)
+    w.close()
+    assert got and got[0].message == "old line"
+
+
+def test_deduper():
+    now = [1000.0]
+    d = Deduper(ttl_seconds=10.0, time_now_fn=lambda: now[0])
+    assert d.seen_before("msg", 5.0) is False
+    assert d.seen_before("msg", 5.0) is True
+    assert d.seen_before("msg", 6.0) is False  # different second bucket
+    now[0] += 20.0  # TTL expiry
+    assert d.seen_before("msg", 5.0) is False
+
+
+def test_deduper_max_entries():
+    d = Deduper(ttl_seconds=1e9, max_entries=10)
+    for i in range(50):
+        d.seen_before(f"m{i}", float(i))
+    assert len(d) <= 10
+
+
+def test_syncer_matches_into_bucket(tmp_db):
+    es = EventStore(tmp_db)
+    bucket = es.bucket("tpu-errors")
+
+    def match(line):
+        if "TPU" in line:
+            return ("tpu-err", EventType.CRITICAL, line)
+        return None
+
+    events_seen = []
+    s = Syncer(match, bucket, on_event=events_seen.append)
+    from gpud_tpu.kmsg.watcher import Message
+
+    s.process(Message(message="TPU fault on chip 3", time=10.0))
+    s.process(Message(message="irrelevant", time=11.0))
+    s.process(Message(message="TPU fault on chip 3", time=10.0))  # dup
+    evs = bucket.get(0)
+    assert len(evs) == 1
+    assert evs[0].type == EventType.CRITICAL
+    assert evs[0].extra_info["kmsg"] == "TPU fault on chip 3"
+    assert len(events_seen) == 1
+
+
+def test_shared_watcher_end_to_end(tmp_path, tmp_db):
+    p = tmp_path / "kmsg.e2e"
+    p.write_text("")
+    es = EventStore(tmp_db)
+    sw = SharedWatcher(path=str(p), from_now=False)
+    hits = []
+    sw.register(
+        Syncer(
+            lambda ln: ("hit", EventType.WARNING, ln) if "match-me" in ln else None,
+            es.bucket("c1"),
+            on_event=hits.append,
+        )
+    )
+    sw.start()
+    w = KmsgWriter(path=str(p))
+    assert w.write("match-me please", priority=2) is None
+    deadline = time.time() + 3
+    while not hits and time.time() < deadline:
+        time.sleep(0.02)
+    sw.close()
+    assert len(hits) == 1
+    assert es.bucket("c1").get(0)[0].name == "hit"
+
+
+def test_writer_fixture_format(tmp_path):
+    p = tmp_path / "w"
+    w = KmsgWriter(path=str(p))
+    w.write("hello\nworld", priority=1)
+    msgs = read_all(str(p))
+    assert msgs[0].priority == 1
+    assert msgs[0].message == "hello world"  # newline sanitized

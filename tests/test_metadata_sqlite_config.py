@@ -65,7 +65,7 @@ def test_config_defaults_and_paths(tmp_path, monkeypatch):
     assert c.packages_dir().endswith("packages")
     c2 = cfg.default_config(db_in_memory=True)
     assert c2.state_file() == ":memory:"
-    bad = cfg.default_config(port=0)
+    bad = cfg.default_config(port=-1)
     assert bad.validate() is not None
 
 

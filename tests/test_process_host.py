@@ -1,0 +1,62 @@
+from gpud_tpu import host
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.process import (
+    ExclusiveRunner,
+    run_bash_script,
+    run_command,
+    run_shell,
+)
+
+
+def test_run_command_ok():
+    r = run_command(["echo", "hi"])
+    assert r.ok and r.output.strip() == "hi"
+
+
+def test_run_command_combined_output():
+    r = run_shell("echo out; echo err 1>&2; exit 3")
+    assert r.exit_code == 3
+    assert "out" in r.output and "err" in r.output
+
+
+def test_run_command_missing_binary():
+    r = run_command(["definitely-not-a-binary-xyz"])
+    assert r.exit_code == -1 and r.error
+
+
+def test_run_command_timeout():
+    r = run_shell("sleep 5", timeout=0.2)
+    assert r.timed_out and "timed out" in r.error
+
+
+def test_run_bash_script_multiline():
+    r = run_bash_script("x=5\ny=7\necho $((x+y))\n")
+    assert r.ok and r.output.strip() == "12"
+
+
+def test_exclusive_runner_serializes():
+    runner = ExclusiveRunner()
+    r = runner.run_script("p1", "echo one")
+    assert r.ok
+    assert "p1" in runner.last_run
+
+
+def test_machine_and_boot_identity():
+    assert host.machine_id() != ""
+    assert host.uptime_seconds() > 0
+    assert host.boot_time() > 0
+    assert host.kernel_version() != ""
+
+
+def test_reboot_event_store_dedupes(tmp_db):
+    es = EventStore(tmp_db)
+    rbs = host.RebootEventStore(es)
+    rbs.record_reboot()
+    rbs.record_reboot()  # same boot → dedupe
+    evs = rbs.get_reboot_events(0)
+    assert len(evs) == 1
+    assert evs[0].name == "reboot"
+
+
+def test_reboot_dry_run():
+    assert host.reboot(dry_run=True) is None

@@ -1,0 +1,62 @@
+import io
+
+from gpud_tpu.cli import build_parser, main
+from gpud_tpu.components.base import FailureInjector
+from gpud_tpu.scan import scan
+
+
+def test_scan_mock_all_healthy(capsys):
+    results = scan()
+    # mock env on (conftest): tpu components run and pass
+    names = [r.component_name() for r in results]
+    assert "cpu" in names and "accelerator-tpu-temperature" in names
+    assert all(r.health_state_type() == "Healthy" for r in results)
+
+
+def test_scan_with_injected_failure():
+    out = io.StringIO()
+    results = scan(
+        failure_injector=FailureInjector(chip_ids_lost=[0]),
+        out=out,
+    )
+    text = out.getvalue()
+    assert "lost chip(s) [0]" in text
+    bad = [r for r in results if r.health_state_type() != "Healthy"]
+    assert bad
+
+
+def test_cli_scan_exit_codes():
+    assert main(["scan"]) == 0
+
+
+def test_cli_machine_info(capsys):
+    assert main(["machine-info"]) == 0
+    out = capsys.readouterr().out
+    assert '"machine_id"' in out
+    assert '"tpu_info"' in out
+
+
+def test_cli_inject_fault_fixture(tmp_path, capsys):
+    kmsg = tmp_path / "kmsg"
+    rc = main(
+        ["inject-fault", "--kmsg-path", str(kmsg), "--name", "tpu_ici_link_down",
+         "--chip-id", "2"]
+    )
+    assert rc == 0
+    assert "tpu_ici_link_down chip=2" in kmsg.read_text()
+
+
+def test_cli_inject_fault_unknown_name(tmp_path, capsys):
+    rc = main(["inject-fault", "--kmsg-path", str(tmp_path / "k"), "--name", "nope"])
+    assert rc == 1
+    assert "unknown tpu_error_name" in capsys.readouterr().err
+
+
+def test_parser_has_all_subcommands():
+    p = build_parser()
+    subs = next(
+        a for a in p._actions if isinstance(a, type(p._subparsers._group_actions[0]))
+    )
+    names = set(subs.choices)
+    assert {"scan", "run", "inject-fault", "status", "compact", "set-healthy",
+            "metadata", "machine-info"} <= names

@@ -1,0 +1,146 @@
+"""E2E: boot the real server (mock TPU backend + kmsg fixture), exercise the
+HTTP API with the typed client (reference: e2e/e2e_test.go:36-41 — build
+binary, boot with mock NVML + KMSG_FILE_PATH, drive client/v1)."""
+
+import time
+
+import pytest
+
+from gpud_tpu.client.v1 import Client, ClientError
+from gpud_tpu.config import default_config
+from gpud_tpu.server.server import Server
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("e2e")
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp / "data"),
+        port=0,  # ephemeral
+        tls=True,
+        kmsg_path=str(kmsg),
+        scrape_interval_seconds=1,
+    )
+    s = Server(config=cfg)
+    s.start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def client(srv):
+    return Client(base_url=srv.base_url())
+
+
+def test_healthz(client):
+    hz = client.healthz()
+    assert hz["status"] == "ok"
+
+
+def test_components_listed(client):
+    comps = client.get_components()
+    assert "cpu" in comps
+    assert "accelerator-tpu-temperature" in comps
+
+
+def test_states_all_healthy_on_boot(client):
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        states = client.get_health_states()
+        healths = {s.states[0].health for s in states if s.states}
+        if healths == {"Healthy"}:
+            return
+        time.sleep(0.3)
+    raise AssertionError(f"not all healthy: {[(s.component, s.states[0].health, s.states[0].reason) for s in states]}")
+
+
+def test_trigger_check(client):
+    res = client.trigger_check(component="cpu")
+    assert res[0].component == "cpu"
+    assert res[0].states[0].health == "Healthy"
+
+
+def test_trigger_check_by_tag(client):
+    res = client.trigger_check(tag="tpu")
+    assert len(res) >= 4
+
+
+def test_trigger_check_unknown_404(client):
+    with pytest.raises(ClientError) as ei:
+        client.trigger_check(component="nope")
+    assert ei.value.status == 404
+
+
+def test_prometheus_metrics(client):
+    text = client.get_prometheus_metrics()
+    assert "tpud_cpu_usage_percent" in text
+    assert "tpud_tpu_temperature_celsius" in text
+
+
+def test_metrics_v1_after_scrape(srv, client):
+    srv.metrics_syncer.sync_once()
+    ms = client.get_metrics(since=time.time() - 600)
+    comps = {m.component for m in ms}
+    assert "cpu" in comps
+
+
+def test_machine_info(client):
+    mi = client.get_machine_info()
+    assert mi.machine_id
+    assert mi.tpu_info is not None
+    assert mi.tpu_info.chip_count == 8  # mock v5e-8
+
+
+def test_inject_fault_detected_via_kmsg(srv, client):
+    """The heart of the product: injected fault → kmsg → watcher → event →
+    unhealthy state with suggested action."""
+    client.inject_fault(tpu_error_name="tpu_hbm_ecc_uncorrectable", chip_id=3)
+    comp = "accelerator-tpu-error-kmsg"
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        evs = client.get_events(components=[comp])
+        if evs and any(
+            e.name == "tpu_hbm_ecc_uncorrectable" for ce in evs for e in ce.events
+        ):
+            break
+        time.sleep(0.1)
+    else:
+        raise AssertionError("injected fault never appeared in events")
+
+    states = client.get_health_states(components=[comp])
+    st = states[0].states[0]
+    assert st.health == "Unhealthy"
+    assert "tpu_hbm_ecc_uncorrectable" in st.reason
+    assert "REBOOT_SYSTEM" in st.suggested_actions.repair_actions
+
+
+def test_set_healthy_clears(client):
+    comp = "accelerator-tpu-error-kmsg"
+    client.set_healthy(comp)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        st = client.get_health_states(components=[comp])[0].states[0]
+        if st.health == "Healthy":
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"still {st.health}: {st.reason}")
+
+
+def test_info_endpoint(client):
+    infos = client.get_info(components=["cpu"])
+    assert infos[0].component == "cpu"
+    assert infos[0].states
+
+
+def test_builtin_component_not_deregisterable(client):
+    with pytest.raises(ClientError) as ei:
+        client.deregister_component("cpu")
+    assert ei.value.status == 400
+
+
+def test_inject_fault_bad_name(client):
+    with pytest.raises(ClientError) as ei:
+        client.inject_fault(tpu_error_name="bogus")
+    assert ei.value.status == 400

@@ -1,0 +1,152 @@
+import os
+
+from gpud_tpu.components.base import FailureInjector
+from gpud_tpu.tpu.instance import (
+    InjectedInstance,
+    JaxBackend,
+    LinkState,
+    MockBackend,
+    SysfsBackend,
+    new_instance,
+)
+from gpud_tpu.tpu.topology import (
+    expected_local_chips,
+    normalize_generation,
+    parse_accelerator_type,
+)
+
+
+def test_parse_accelerator_types():
+    t = parse_accelerator_type("v5p-256")
+    assert t.generation == "v5p"
+    assert t.total_chips == 128
+    assert t.total_cores == 256
+    assert t.hosts == 32
+    assert t.chips_per_host == 4
+    assert t.ici_links_per_chip == 6
+    assert t.multi_host
+
+    t = parse_accelerator_type("v5e-64")
+    assert t.total_chips == 64 and t.hosts == 8 and t.chips_per_host == 8
+    assert t.ici_links_per_chip == 4
+
+    t = parse_accelerator_type("v4-8")
+    assert t.total_chips == 4 and t.hosts == 1 and not t.multi_host
+
+    t = parse_accelerator_type("v5litepod-16")
+    assert t.generation == "v5e" and t.total_chips == 16
+
+    assert parse_accelerator_type("h100-8") is None
+    assert parse_accelerator_type("") is None
+
+
+def test_normalize_generation():
+    assert normalize_generation("TPU v5 lite0") == "v5e"
+    assert normalize_generation("v5p") == "v5p"
+    assert normalize_generation("TPU v4") == "v4"
+
+
+def test_expected_local_chips():
+    assert expected_local_chips("v5e-8") == 8
+    assert expected_local_chips("v5e-4") == 4
+    assert expected_local_chips("v5p-256") == 4
+    assert expected_local_chips("unknown-1") == 0
+
+
+def test_mock_backend_v5e8():
+    b = MockBackend(accelerator_type="v5e-8")
+    assert b.tpu_lib_exists()
+    assert len(b.devices()) == 8
+    assert b.telemetry_supported() and b.ici_supported()
+    tel = b.telemetry()
+    assert len(tel) == 8
+    assert 30 < tel[0].temperature_c < 60
+    assert tel[0].hbm_total_bytes > 0
+    links = b.ici_links()
+    assert len(links) == 8 * 4
+    assert all(l.state == LinkState.UP for l in links)
+
+
+def test_mock_backend_v5p_host():
+    b = MockBackend(accelerator_type="v5p-256")
+    assert len(b.devices()) == 4  # per-host view
+    assert len(b.ici_links()) == 4 * 6
+
+
+def test_mock_env_injections(monkeypatch):
+    monkeypatch.setenv("TPUD_TPU_INJECT_HBM_ECC_PENDING", "1,2")
+    monkeypatch.setenv("TPUD_TPU_INJECT_ICI_LINK_DOWN", "chip0/ici1")
+    b = MockBackend(accelerator_type="v5e-8")
+    tel = b.telemetry()
+    assert tel[1].hbm_ecc_pending and tel[2].hbm_ecc_pending
+    assert not tel[0].hbm_ecc_pending
+    down = [l for l in b.ici_links() if l.state == LinkState.DOWN]
+    assert [l.name for l in down] == ["chip0/ici1"]
+
+
+def test_failure_injector_wrapper():
+    inj = FailureInjector(
+        chip_ids_lost=[0],
+        chip_ids_thermal_slowdown=[1],
+        ici_links_down=["chip2/ici0"],
+        product_name_override="TPU v6e",
+    )
+    b = InjectedInstance(MockBackend(accelerator_type="v5e-8"), inj)
+    assert b.product_name() == "TPU v6e"
+    devs = b.devices()
+    assert devs[0].lost and not devs[1].lost
+    tel = b.telemetry()
+    assert 0 not in tel  # lost chip drops out of telemetry
+    assert tel[1].thermal_slowdown
+    down = [l.name for l in b.ici_links() if l.state == LinkState.DOWN]
+    assert down == ["chip2/ici0"]
+
+
+def test_injector_enumeration_error():
+    inj = FailureInjector(tpu_enumeration_error=True)
+    b = InjectedInstance(MockBackend(accelerator_type="v5e-8"), inj)
+    assert not b.tpu_lib_exists()
+    assert b.devices() == {}
+    assert "injected" in b.init_error()
+
+
+def test_sysfs_backend_fixture(tmp_path):
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(4):
+        (dev / f"accel{i}").write_text("")
+    sys_accel = tmp_path / "sys_accel"
+    (sys_accel / "accel0").mkdir(parents=True)
+    os.symlink("/sys/devices/pci0000:00/0000:00:05.0", sys_accel / "accel0" / "device")
+    b = SysfsBackend(
+        dev_root=str(dev),
+        sys_accel_root=str(sys_accel),
+        accelerator_type="v4-8",
+    )
+    assert b.tpu_lib_exists()
+    devs = b.devices()
+    assert len(devs) == 4
+    assert devs[0].pci_address == "0000:00:05.0"
+    assert devs[0].generation == "v4"
+    assert b.generation() == "v4"
+
+
+def test_sysfs_backend_empty(tmp_path):
+    b = SysfsBackend(dev_root=str(tmp_path), accelerator_type="")
+    assert not b.tpu_lib_exists()
+
+
+def test_factory_mock_env(monkeypatch):
+    monkeypatch.setenv("TPUD_TPU_MOCK_ALL_SUCCESS", "1")
+    inst = new_instance()
+    assert isinstance(inst, MockBackend)
+    inst2 = new_instance(FailureInjector(chip_ids_lost=[0]))
+    assert isinstance(inst2, InjectedInstance)
+    inst3 = new_instance(FailureInjector())  # empty injector → no wrapper
+    assert isinstance(inst3, MockBackend)
+
+
+def test_jax_backend_cpu_only():
+    # under JAX_PLATFORMS=cpu there are no tpu/axon devices → clean absence
+    b = JaxBackend()
+    assert not b.tpu_lib_exists() or b.devices()
