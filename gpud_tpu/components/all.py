@@ -13,6 +13,7 @@ from gpud_tpu.components.os_comp import OSComponent
 from gpud_tpu.components.tpu.chip_counts import TPUChipCountsComponent
 from gpud_tpu.components.tpu.error_kmsg import TPUErrorKmsgComponent
 from gpud_tpu.components.tpu.hbm import TPUHbmComponent
+from gpud_tpu.components.tpu.ici import TPUICIComponent
 from gpud_tpu.components.tpu.power import TPUPowerComponent
 from gpud_tpu.components.tpu.temperature import TPUTemperatureComponent
 
@@ -29,5 +30,6 @@ def all_components() -> List[InitFunc]:
         TPUTemperatureComponent,
         TPUHbmComponent,
         TPUPowerComponent,
+        TPUICIComponent,
         TPUErrorKmsgComponent,
     ]

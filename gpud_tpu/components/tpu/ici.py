@@ -1,0 +1,254 @@
+"""ICI fabric component — the NVLink/InfiniBand analog.
+
+Reference: components/accelerator/nvidia/infiniband (SURVEY §2.4, "most
+complex check"): its own SQLite time-series of per-port snapshots; Scan
+marks drops/flaps; *sticky* unhealthy until ``set-healthy`` or an opt-in
+flap auto-clear window (flap_auto_clear_window.go); expected port counts by
+product (threshold_default.go); tombstone on admin action.
+
+TPU translation: ports are per-chip ICI links; expected counts come from
+the slice topology (v4/v5p: 6 links/chip 3D torus, v5e/v6e: 4 links/chip
+2D torus); counters come from the TPU instance backend.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from gpud_tpu.api.v1.types import (
+    Event,
+    EventType,
+    HealthStateType,
+    RepairActionType,
+    SuggestedActions,
+)
+from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
+from gpud_tpu.components.tpu.ici_store import ICIStore, ScanResult
+from gpud_tpu.components.tpu.shared import sampler_for
+from gpud_tpu.metrics.registry import gauge
+
+NAME = "accelerator-tpu-ici"
+
+_g_links_up = gauge("tpud_tpu_ici_links_up", "ICI links currently up")
+_g_links_expected = gauge("tpud_tpu_ici_links_expected", "expected ICI links")
+_g_link_state = gauge("tpud_tpu_ici_link_state", "per-link state (1=up)")
+_g_crc = gauge("tpud_tpu_ici_link_crc_errors_total", "per-link CRC errors")
+
+LABELS = {"component": NAME}
+
+DEFAULT_SCAN_WINDOW = 3600.0        # 1h drop/flap window
+DEFAULT_FLAP_THRESHOLD = 3          # flaps in window before Degraded
+DEFAULT_CRC_DELTA_DEGRADED = 100    # CRC-errors delta in window before Degraded
+# opt-in: clear sticky flap state after this much clean uptime; 0 = sticky
+# until set-healthy (reference: flap_auto_clear_window.go)
+DEFAULT_AUTO_CLEAR_WINDOW = 0.0
+
+
+class TPUICIComponent(PollingComponent):
+    NAME = NAME
+    TAGS = ["accelerator", "tpu", "ici", "fabric"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self.tpu = instance.tpu_instance
+        self.sampler = sampler_for(self.tpu)
+        self.store: Optional[ICIStore] = (
+            ICIStore(instance.db_rw) if instance.db_rw is not None else None
+        )
+        self._event_bucket = (
+            instance.event_store.bucket(NAME) if instance.event_store else None
+        )
+        self.scan_window = DEFAULT_SCAN_WINDOW
+        self.flap_threshold = DEFAULT_FLAP_THRESHOLD
+        self.crc_delta_degraded = DEFAULT_CRC_DELTA_DEGRADED
+        self.auto_clear_window = DEFAULT_AUTO_CLEAR_WINDOW
+        self.time_now_fn = time.time
+        self._last_purge = 0.0
+
+    def is_supported(self) -> bool:
+        return (
+            self.tpu is not None
+            and self.tpu.tpu_lib_exists()
+            and self.tpu.ici_supported()
+        )
+
+    def _expected_links(self) -> int:
+        topo = self.tpu.topology() if self.tpu else None
+        if topo is None:
+            return 0
+        return len(self.tpu.devices()) * topo.ici_links_per_chip
+
+    def _record_event(self, name: str, ev_type: str, message: str) -> None:
+        if self._event_bucket is None:
+            return
+        ev = Event(component=NAME, name=name, type=ev_type, message=message)
+        # dedupe identical message within the last scan window — but only
+        # back to the latest SetHealthy marker, so a recurrence after an
+        # operator clear is a fresh incident with its own event
+        recent = self._event_bucket.get(self.time_now_fn() - self.scan_window)
+        for e in recent:  # newest first
+            if e.name == "SetHealthy":
+                break
+            if e.name == name and e.message == message:
+                return
+        self._event_bucket.insert(ev)
+
+    def check_once(self) -> CheckResult:
+        if not self.is_supported():
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.HEALTHY,
+                reason="no ICI fabric on this host",
+            )
+        links = self.sampler.ici_links()
+        now = self.time_now_fn()
+
+        up = 0
+        for ln in links:
+            labels = {"component": NAME, "link": ln.name}
+            _g_link_state.set(1.0 if ln.state == "up" else 0.0, labels)
+            _g_crc.set(ln.crc_errors, labels)
+            if ln.state == "up":
+                up += 1
+        expected = self._expected_links()
+        _g_links_up.set(up, LABELS)
+        _g_links_expected.set(expected, LABELS)
+
+        scan: Optional[ScanResult] = None
+        if self.store is not None:
+            self.store.insert_snapshot(links, ts=now)
+            # purge at retention/5 cadence, not per poll (matches the
+            # eventstore purger; a per-poll DELETE would walk the table)
+            if now - self._last_purge >= self.store.retention_seconds / 5.0:
+                self.store.purge()
+                self._last_purge = now
+            scan = self.store.scan(self.scan_window)
+
+        extra = {
+            "links_up": str(up),
+            "links_expected": str(expected),
+        }
+
+        # 1. links currently down → Unhealthy (sticky by construction: the
+        #    condition persists until the link recovers, and history keeps
+        #    the drop visible via events)
+        down_now = sorted(ln.name for ln in links if ln.state != "up")
+        if down_now or (expected and up < expected):
+            missing = down_now or [f"{expected - up} link(s) unreported"]
+            for name in down_now:
+                self._record_event(
+                    "ici_link_down", EventType.CRITICAL, f"ICI link {name} down"
+                )
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=f"ICI link(s) down: {', '.join(missing)} ({up}/{expected} up)",
+                suggested_actions=SuggestedActions(
+                    description="ICI link down — reboot may retrain; persistent loss needs hardware inspection",
+                    repair_actions=[
+                        RepairActionType.REBOOT_SYSTEM,
+                        RepairActionType.HARDWARE_INSPECTION,
+                    ],
+                ),
+                extra_info=extra,
+            )
+
+        # 2. sticky history: drops/flaps in the window keep the component
+        #    not-healthy even after recovery, until set-healthy tombstones
+        #    the history or the auto-clear window elapses
+        if scan is not None:
+            flapped = [
+                s
+                for s in scan.links.values()
+                if s.drops > 0 or s.flaps > 0
+            ]
+            if flapped and self.auto_clear_window > 0:
+                # opt-in: clear sticky state once every link has been clean
+                # for the auto-clear window (reference: flap_auto_clear_window.go)
+                if self._all_clean_since(self.auto_clear_window):
+                    flapped = []
+            if flapped:
+                heavy = [
+                    s.link
+                    for s in flapped
+                    if s.flaps >= self.flap_threshold or s.drops >= self.flap_threshold
+                ]
+                names = sorted(s.link for s in flapped)
+                for s in flapped:
+                    self._record_event(
+                        "ici_link_flap",
+                        EventType.WARNING,
+                        f"ICI link {s.link} dropped {s.drops}x / recovered {s.flaps}x in window",
+                    )
+                health = (
+                    HealthStateType.UNHEALTHY if heavy else HealthStateType.DEGRADED
+                )
+                return CheckResult(
+                    self.NAME,
+                    health=health,
+                    reason=(
+                        f"ICI link(s) flapped in last {int(self.scan_window / 60)}m: "
+                        f"{', '.join(names)} (sticky until set-healthy)"
+                    ),
+                    suggested_actions=SuggestedActions(
+                        description="ICI links unstable — check cabling/seating",
+                        repair_actions=[RepairActionType.HARDWARE_INSPECTION],
+                    ),
+                    extra_info=extra,
+                )
+
+            # 3. counter health: CRC deltas in window
+            noisy = [
+                s.link
+                for s in scan.links.values()
+                if s.crc_delta >= self.crc_delta_degraded
+            ]
+            if noisy:
+                return CheckResult(
+                    self.NAME,
+                    health=HealthStateType.DEGRADED,
+                    reason=f"ICI CRC errors rising on: {', '.join(sorted(noisy))}",
+                    suggested_actions=SuggestedActions(
+                        description="ICI CRC errors — cable/connector suspect",
+                        repair_actions=[RepairActionType.HARDWARE_INSPECTION],
+                    ),
+                    extra_info=extra,
+                )
+
+        return CheckResult(
+            self.NAME,
+            reason=f"all {up}/{expected} ICI links up",
+            extra_info=extra,
+        )
+
+    def _all_clean_since(self, window: float) -> bool:
+        """True when no drop/flap transition occurred within ``window``."""
+        if self.store is None:
+            return False
+        recent = self.store.scan(window)
+        return not any(
+            s.drops > 0 or s.flaps > 0 or s.currently_down
+            for s in recent.links.values()
+        )
+
+    def events(self, since: float):
+        if self._event_bucket is None:
+            return []
+        return self._event_bucket.get(since)
+
+    def set_healthy(self) -> None:
+        """Tombstone all link history so the scan starts fresh
+        (reference: IB tombstone on admin action)."""
+        if self.store is not None:
+            self.store.set_tombstone("*", ts=self.time_now_fn())
+        if self._event_bucket is not None:
+            self._event_bucket.insert(
+                Event(
+                    component=NAME,
+                    name="SetHealthy",
+                    type=EventType.INFO,
+                    message="operator set-healthy; ICI history tombstoned",
+                )
+            )
+        self.check()

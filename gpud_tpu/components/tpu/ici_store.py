@@ -1,0 +1,196 @@
+"""ICI link time-series store.
+
+The TPU analog of the InfiniBand component's dedicated SQLite store
+(reference: components/accelerator/nvidia/infiniband/store/interface.go:9-36):
+per-port snapshots over a long horizon, scanned for link drops and flaps,
+with tombstones so an admin action (set-healthy) makes the scan ignore
+history before a point in time.
+
+Snapshot rows are (ts, link, state, counters...); the scan computes per-link:
+- ``currently_down``: latest snapshot has state down,
+- ``drops``: up→down transitions inside the window,
+- ``flaps``: down→up recoveries inside the window (a drop that recovers),
+- counter deltas (CRC errors etc.) across the window.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from gpud_tpu.sqlite import DB
+from gpud_tpu.tpu.instance import ICILinkSnapshot, LinkState
+
+TABLE = "tpud_ici_snapshots_v0_1"
+TOMBSTONE_TABLE = "tpud_ici_tombstones_v0_1"
+
+DEFAULT_RETENTION = 14 * 86400
+
+
+@dataclass
+class LinkScan:
+    link: str
+    currently_down: bool = False
+    drops: int = 0
+    flaps: int = 0
+    crc_delta: int = 0
+    error_delta: int = 0
+    last_state: str = LinkState.UNKNOWN
+    last_seen: float = 0.0
+    first_seen: float = 0.0
+    samples: int = 0
+
+
+@dataclass
+class ScanResult:
+    window_start: float
+    links: Dict[str, LinkScan] = field(default_factory=dict)
+
+    @property
+    def down_links(self) -> List[str]:
+        return sorted(k for k, v in self.links.items() if v.currently_down)
+
+    @property
+    def flapping_links(self) -> List[str]:
+        return sorted(k for k, v in self.links.items() if v.flaps > 0)
+
+    @property
+    def dropped_links(self) -> List[str]:
+        return sorted(k for k, v in self.links.items() if v.drops > 0)
+
+
+class ICIStore:
+    def __init__(self, db: DB, retention_seconds: int = DEFAULT_RETENTION) -> None:
+        self.db = db
+        self.retention_seconds = retention_seconds
+        self.time_now_fn = time.time
+        db.execute(
+            f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+                ts REAL NOT NULL,
+                link TEXT NOT NULL,
+                state INTEGER NOT NULL,
+                tx_bytes INTEGER NOT NULL DEFAULT 0,
+                rx_bytes INTEGER NOT NULL DEFAULT 0,
+                tx_errors INTEGER NOT NULL DEFAULT 0,
+                rx_errors INTEGER NOT NULL DEFAULT 0,
+                crc_errors INTEGER NOT NULL DEFAULT 0,
+                replays INTEGER NOT NULL DEFAULT 0
+            )"""
+        )
+        db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_link_ts ON {TABLE} (link, ts)"
+        )
+        # bare-ts index so purge's DELETE ... WHERE ts<? doesn't full-scan
+        db.execute(f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_ts ON {TABLE} (ts)")
+        db.execute(
+            f"CREATE TABLE IF NOT EXISTS {TOMBSTONE_TABLE} "
+            "(link TEXT PRIMARY KEY, ts REAL NOT NULL)"
+        )
+
+    # -- writes ------------------------------------------------------------
+    def insert_snapshot(
+        self, links: List[ICILinkSnapshot], ts: Optional[float] = None
+    ) -> None:
+        t = ts if ts is not None else self.time_now_fn()
+        self.db.executemany(
+            f"INSERT INTO {TABLE} (ts, link, state, tx_bytes, rx_bytes, "
+            "tx_errors, rx_errors, crc_errors, replays) VALUES (?,?,?,?,?,?,?,?,?)",
+            [
+                (
+                    t,
+                    ln.name,
+                    1 if ln.state == LinkState.UP else 0,
+                    ln.tx_bytes,
+                    ln.rx_bytes,
+                    ln.tx_errors,
+                    ln.rx_errors,
+                    ln.crc_errors,
+                    ln.replays,
+                )
+                for ln in links
+            ],
+        )
+
+    def purge(self, before: Optional[float] = None) -> int:
+        cutoff = (
+            before
+            if before is not None
+            else self.time_now_fn() - self.retention_seconds
+        )
+        return self.db.execute(f"DELETE FROM {TABLE} WHERE ts<?", (cutoff,)).rowcount
+
+    # -- tombstones (reference: IB store tombstone on admin action) --------
+    def set_tombstone(self, link: str = "*", ts: Optional[float] = None) -> None:
+        """``link='*'`` tombstones all links (set-healthy semantics)."""
+        t = ts if ts is not None else self.time_now_fn()
+        self.db.execute(
+            f"INSERT INTO {TOMBSTONE_TABLE} (link, ts) VALUES (?, ?) "
+            "ON CONFLICT(link) DO UPDATE SET ts=excluded.ts",
+            (link, t),
+        )
+
+    def tombstones(self) -> Dict[str, float]:
+        """All tombstones as link→ts (one query per scan, not per link)."""
+        return {
+            r[0]: r[1]
+            for r in self.db.query(f"SELECT link, ts FROM {TOMBSTONE_TABLE}")
+        }
+
+    def tombstone_for(self, link: str) -> float:
+        t = self.tombstones()
+        return max(t.get("*", 0.0), t.get(link, 0.0))
+
+    # -- scan --------------------------------------------------------------
+    def scan(self, window_seconds: float) -> ScanResult:
+        """Walk each link's snapshots in the window (post-tombstone) and
+        classify drops/flaps (reference: IB store Scan marks drops/flaps)."""
+        now = self.time_now_fn()
+        start = now - window_seconds
+        res = ScanResult(window_start=start)
+        rows = self.db.query(
+            f"SELECT link, ts, state, tx_errors, rx_errors, crc_errors "
+            f"FROM {TABLE} WHERE ts>=? ORDER BY link, ts ASC",
+            (start,),
+        )
+        cur: Optional[LinkScan] = None
+        prev_state: Optional[int] = None
+        prev_counters = None
+        tombstone = 0.0
+        all_tombstones = self.tombstones()
+        global_tombstone = all_tombstones.get("*", 0.0)
+
+        for link, ts, state, tx_err, rx_err, crc in rows:
+            if cur is None or link != cur.link:
+                cur = LinkScan(link=link, first_seen=ts)
+                res.links[link] = cur
+                prev_state = None
+                prev_counters = None
+                tombstone = max(global_tombstone, all_tombstones.get(link, 0.0))
+            if ts < tombstone:
+                continue
+            if cur.samples == 0:
+                cur.first_seen = ts
+            cur.samples += 1
+            cur.last_seen = ts
+            if prev_counters is not None:
+                # accumulate only positive steps: counters are monotonic in
+                # hardware but may reset on driver reload/reboot
+                cur.error_delta += max(0, (tx_err + rx_err) - (prev_counters[0] + prev_counters[1]))
+                cur.crc_delta += max(0, crc - prev_counters[2])
+            prev_counters = (tx_err, rx_err, crc)
+            if prev_state is not None:
+                if prev_state == 1 and state == 0:
+                    cur.drops += 1
+                elif prev_state == 0 and state == 1:
+                    cur.flaps += 1
+            prev_state = state
+            cur.last_state = LinkState.UP if state == 1 else LinkState.DOWN
+            cur.currently_down = state == 0
+        # links fully masked by a tombstone end up with zero samples — drop
+        # them so they don't read as "down since forever"
+        res.links = {k: v for k, v in res.links.items() if v.samples > 0}
+        return res
+
+    def link_names(self) -> List[str]:
+        return [r[0] for r in self.db.query(f"SELECT DISTINCT link FROM {TABLE}")]

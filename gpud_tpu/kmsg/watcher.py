@@ -242,8 +242,11 @@ class Watcher:
 
     def _follow_file(self, fd: int) -> None:
         """tail -f over a regular fixture file so fault-injection tests can
-        append lines and see them flow through the same code path."""
+        append lines and see them flow through the same code path. Unlike
+        the char device there is no poll() wakeup, so use a short fixed
+        sleep — detection latency in fixture mode is floored by this."""
         buf = b""
+        sleep_s = min(self.poll_timeout_ms, 50) / 1000.0
         if self.from_now:
             os.lseek(fd, 0, os.SEEK_END)
         while not self._stop.is_set():
@@ -259,7 +262,7 @@ class Watcher:
                     ln, buf = buf.split(b"\n", 1)
                     self._deliver(ln.decode("utf-8", "replace"))
             else:
-                if self._stop.wait(self.poll_timeout_ms / 1000.0):
+                if self._stop.wait(sleep_s):
                     return
                 # handle truncation/rotation
                 pos = os.lseek(fd, 0, os.SEEK_CUR)

@@ -1,0 +1,179 @@
+"""Telemetry anomaly models (JAX) — the daemon's on-accelerator analytics.
+
+Two models over per-chip telemetry windows ``[chips, T, F]`` (features:
+temp, hbm_temp, power, hbm_used_frac, duty_cycle, util, clock, ...):
+
+1. ``robust_scores`` — deterministic statistical scorer: EWMA forecast
+   residuals normalized by a median/MAD robust scale, reduced to a per-chip
+   anomaly score. No parameters, jittable, bfloat16-friendly.
+
+2. ``TelemetryAutoencoder`` — a small MLP autoencoder whose reconstruction
+   error flags multivariate anomalies. Written with pure jax (init/apply
+   functions returning pytrees) so the training step can be pjit-sharded:
+   batch axis → data parallelism, hidden axis → tensor parallelism (see
+   gpud_tpu/parallel/fleet.py). Matmuls run in bfloat16 on the MXU with
+   float32 accumulation.
+
+This is the analytics slot of the daemon (fleet-side trend detection,
+"which chip is drifting hot before it trips"), not its control path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+N_FEATURES = 8
+
+
+# ---------------------------------------------------------------------------
+# 1. Deterministic robust scorer
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("alpha",))
+def robust_scores(windows: jax.Array, alpha: float = 0.3) -> jax.Array:
+    """Per-chip anomaly score from telemetry windows.
+
+    Args:
+      windows: [C, T, F] float — per-chip, per-step feature matrix.
+    Returns:
+      [C] float32 — 0 ≈ nominal; >3 ≈ a feature is running away from its
+      own recent behavior.
+    """
+    x = windows.astype(jnp.float32)
+
+    # EWMA one-step forecast along time via an associative scan
+    # (lax.associative_scan keeps it a single fused pass on device)
+    def ewma_combine(a, b):
+        # elements are (decay, value): compose affine maps
+        da, va = a
+        db, vb = b
+        return da * db, vb + db * va
+
+    T = x.shape[1]
+    decays = jnp.full((T,), 1.0 - alpha, dtype=jnp.float32)
+    contribs = alpha * x
+    # initialize the filter at the first sample (decay_0=0, contrib_0=x_0):
+    # without this every chip shows a huge startup residual from s_0=0
+    decays = decays.at[0].set(0.0)
+    contribs = contribs.at[:, 0, :].set(x[:, 0, :])
+    d, sm = jax.lax.associative_scan(
+        ewma_combine,
+        (
+            jnp.broadcast_to(decays[None, :, None], x.shape),
+            contribs,
+        ),
+        axis=1,
+    )
+    ewma = sm  # [C, T, F]
+    resid = x[:, 1:, :] - ewma[:, :-1, :]  # one-step-ahead residuals
+
+    # robust scale per chip/feature: median absolute deviation
+    med = jnp.median(resid, axis=1, keepdims=True)
+    mad = jnp.median(jnp.abs(resid - med), axis=1, keepdims=True) + 1e-6
+    z = jnp.abs(resid - med) / (1.4826 * mad)
+
+    # score: mean of the top-k residuals per chip (persistent deviation,
+    # not single spikes)
+    k = max(1, resid.shape[1] // 8)
+    top = jax.lax.top_k(z.max(axis=2), k)[0]  # [C, k] worst steps
+    return jnp.mean(top, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# 2. MLP autoencoder (pure-jax, shardable)
+# ---------------------------------------------------------------------------
+
+class AEParams(NamedTuple):
+    w_enc: jax.Array  # [F*T, H]
+    b_enc: jax.Array  # [H]
+    w_lat: jax.Array  # [H, Z]
+    b_lat: jax.Array  # [Z]
+    w_dec1: jax.Array  # [Z, H]
+    b_dec1: jax.Array  # [H]
+    w_dec2: jax.Array  # [H, F*T]
+    b_dec2: jax.Array  # [F*T]
+
+
+class AEConfig(NamedTuple):
+    window: int = 16
+    features: int = N_FEATURES
+    hidden: int = 256
+    latent: int = 32
+
+    @property
+    def input_dim(self) -> int:
+        return self.window * self.features
+
+
+def ae_init(key: jax.Array, cfg: AEConfig) -> AEParams:
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    d, h, z = cfg.input_dim, cfg.hidden, cfg.latent
+
+    def glorot(k, shape):
+        fan_in, fan_out = shape
+        s = jnp.sqrt(2.0 / (fan_in + fan_out))
+        return jax.random.normal(k, shape, dtype=jnp.float32) * s
+
+    return AEParams(
+        w_enc=glorot(k1, (d, h)),
+        b_enc=jnp.zeros((h,), jnp.float32),
+        w_lat=glorot(k2, (h, z)),
+        b_lat=jnp.zeros((z,), jnp.float32),
+        w_dec1=glorot(k3, (z, h)),
+        b_dec1=jnp.zeros((h,), jnp.float32),
+        w_dec2=glorot(k4, (h, d)),
+        b_dec2=jnp.zeros((d,), jnp.float32),
+    )
+
+
+def ae_apply(params: AEParams, x: jax.Array) -> jax.Array:
+    """x: [B, F*T] → reconstruction [B, F*T]. Matmuls in bf16 on the MXU,
+    accumulation in f32 (preferred_element_type)."""
+
+    def mm(a, w):
+        return jax.lax.dot_general(
+            a.astype(jnp.bfloat16),
+            w.astype(jnp.bfloat16),
+            (((a.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    h = jax.nn.gelu(mm(x, params.w_enc) + params.b_enc)
+    zl = mm(h, params.w_lat) + params.b_lat
+    h2 = jax.nn.gelu(mm(zl, params.w_dec1) + params.b_dec1)
+    out = mm(h2, params.w_dec2) + params.b_dec2
+    return out
+
+
+def ae_loss(params: AEParams, batch: jax.Array) -> jax.Array:
+    recon = ae_apply(params, batch)
+    return jnp.mean(jnp.square(recon - batch))
+
+
+@jax.jit
+def ae_scores(params: AEParams, batch: jax.Array) -> jax.Array:
+    """Per-sample reconstruction error — the anomaly score."""
+    recon = ae_apply(params, batch)
+    return jnp.mean(jnp.square(recon - batch), axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("lr",))
+def ae_train_step(
+    params: AEParams, batch: jax.Array, lr: float = 1e-3
+) -> Tuple[AEParams, jax.Array]:
+    """One SGD step; grads are averaged implicitly when pjit shards the
+    batch axis (XLA inserts the psum from the sharding annotations — we do
+    not hand-write collectives, per the scaling-book recipe)."""
+    loss, grads = jax.value_and_grad(ae_loss)(params, batch)
+    new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+    return new_params, loss
+
+
+def windows_to_batch(windows: jax.Array) -> jax.Array:
+    """[C, T, F] → [C, T*F] flattened samples for the autoencoder."""
+    c = windows.shape[0]
+    return windows.reshape(c, -1).astype(jnp.float32)

@@ -1,0 +1,127 @@
+"""Vectorized ICI window-scan ops (JAX).
+
+The SQLite scan in ``ici_store`` is the correctness path for one host
+(tens of links). At fleet/pod scale the same scan runs over every link of a
+slice — v5p-256 ⇒ 128 chips × 6 links × 1440 samples/day — and the
+control-plane side wants it batched. These ops express the scan as pure
+array programs so XLA fuses the whole pass into a handful of kernels and it
+can be sharded over a device mesh (see gpud_tpu/parallel/fleet.py).
+
+Layout: ``states``  [L, T] int8/bool (1=up), ``counters`` [L, T] int32,
+time-major along the last axis (contiguous per link → coalesced loads and
+lane-wise reductions on the VPU; keeping L as the sublane axis lets XLA
+tile [8,128] natively).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class WindowScan(NamedTuple):
+    """Per-link scan results over the window (all [L])."""
+
+    drops: jax.Array          # up→down transitions
+    flaps: jax.Array          # down→up recoveries
+    currently_down: jax.Array # last sample is down
+    down_time_frac: jax.Array # fraction of window down
+    counter_delta: jax.Array  # sum of positive counter steps (reset-safe)
+
+
+@jax.jit
+def scan_links(states: jax.Array, counters: jax.Array, valid: jax.Array) -> WindowScan:
+    """Scan every link's window at once.
+
+    Args:
+      states:   [L, T] 1=up / 0=down.
+      counters: [L, T] monotonic error counters (may reset to 0).
+      valid:    [L, T] bool — sample present (ragged windows are padded).
+    """
+    states = states.astype(jnp.int8)
+    valid = valid.astype(jnp.bool_)
+
+    # Forward-fill: carry the last valid state across gaps so a transition
+    # spanning a missed sample still counts — matching ICIStore.scan, which
+    # compares consecutive *snapshots* regardless of time gaps.
+    def ff_combine(a, b):
+        a_has, a_val = a
+        b_has, b_val = b
+        return a_has | b_has, jnp.where(b_has, b_val, a_val)
+
+    has_ff, state_ff = jax.lax.associative_scan(
+        ff_combine, (valid, states), axis=1
+    )
+    prev = state_ff[:, :-1]
+    prev_has = has_ff[:, :-1]
+    nxt = states[:, 1:]
+    # a transition is counted at each valid sample that differs from the
+    # last valid state seen before it
+    v_pair = valid[:, 1:] & prev_has
+    drops = jnp.sum(((prev == 1) & (nxt == 0) & v_pair), axis=1)
+    flaps = jnp.sum(((prev == 0) & (nxt == 1) & v_pair), axis=1)
+
+    # last valid sample per link, without gather loops: index of the last
+    # True in `valid` via argmax over reversed cumulative mask
+    last_idx = states.shape[1] - 1 - jnp.argmax(valid[:, ::-1], axis=1)
+    has_any = jnp.any(valid, axis=1)
+    last_state = jnp.take_along_axis(states, last_idx[:, None], axis=1)[:, 0]
+    currently_down = has_any & (last_state == 0)
+
+    down_time = jnp.sum((states == 0) & valid, axis=1)
+    n_valid = jnp.maximum(1, jnp.sum(valid, axis=1))
+    down_time_frac = down_time / n_valid
+
+    _, counter_ff = jax.lax.associative_scan(
+        ff_combine, (valid, counters), axis=1
+    )
+    diffs = counters[:, 1:] - counter_ff[:, :-1]
+    counter_delta = jnp.sum(jnp.where(v_pair, jnp.maximum(diffs, 0), 0), axis=1)
+
+    return WindowScan(
+        drops=drops,
+        flaps=flaps,
+        currently_down=currently_down,
+        down_time_frac=down_time_frac,
+        counter_delta=counter_delta,
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("flap_threshold", "crc_threshold"))
+def classify_links(
+    scan: WindowScan,
+    flap_threshold: int = 3,
+    crc_threshold: int = 100,
+) -> jax.Array:
+    """Health class per link: 0=healthy, 1=degraded (flap/CRC), 2=unhealthy
+    (down or heavy flapping) — mirrors the ici component's rules so fleet
+    sweeps agree with per-host checks."""
+    heavy = (scan.drops >= flap_threshold) | (scan.flaps >= flap_threshold)
+    unhealthy = scan.currently_down | heavy
+    degraded = (
+        (scan.drops > 0)
+        | (scan.flaps > 0)
+        | (scan.counter_delta >= crc_threshold)
+    )
+    return jnp.where(unhealthy, 2, jnp.where(degraded, 1, 0)).astype(jnp.int32)
+
+
+def scan_numpy_bridge(rows, link_index, n_links: int, n_steps: int):
+    """Pack (link_id, step, state, counter) rows into dense arrays for
+    ``scan_links``; host-side helper for feeding SQLite history to the
+    device. Returns (states, counters, valid) as numpy arrays."""
+    import numpy as np
+
+    states = np.zeros((n_links, n_steps), dtype=np.int8)
+    counters = np.zeros((n_links, n_steps), dtype=np.int32)
+    valid = np.zeros((n_links, n_steps), dtype=bool)
+    for link, step, state, counter in rows:
+        li = link_index[link]
+        if 0 <= step < n_steps:
+            states[li, step] = state
+            counters[li, step] = counter
+            valid[li, step] = True
+    return states, counters, valid

@@ -1,0 +1,152 @@
+"""Fleet-scale sharded analytics over a device mesh.
+
+The daemon's multi-chip compute path: per-chip/per-link telemetry arrays
+are sharded over a ``jax.sharding.Mesh`` and the scan/score/train programs
+run SPMD with XLA-inserted collectives (psum over ICI) — the scaling-book
+recipe: pick a mesh, annotate shardings, let XLA insert collectives.
+
+Axes:
+- ``data``  — fleet/batch axis: chips, links, or telemetry windows.
+- ``model`` — tensor-parallel axis for the autoencoder's hidden dim.
+
+The reference daemon has no compute of its own (SURVEY §2.8: monitoring,
+not collectives); this module exists because on TPU the natural place to
+scan pod-scale ICI/telemetry history is the pod itself.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gpud_tpu.models.anomaly import (
+    AEConfig,
+    AEParams,
+    ae_init,
+    ae_loss,
+    ae_scores,
+    robust_scores,
+)
+from gpud_tpu.ops.window_scan import WindowScan, classify_links, scan_links
+
+
+def make_mesh(
+    n_devices: Optional[int] = None, model_parallel: int = 1
+) -> Mesh:
+    """Mesh over the first n devices with (data, model) axes. ``model_parallel``
+    must divide n."""
+    devs = jax.devices()[: n_devices or len(jax.devices())]
+    n = len(devs)
+    if n % model_parallel:
+        raise ValueError(f"model_parallel={model_parallel} does not divide {n}")
+    import numpy as np
+
+    arr = np.array(devs).reshape(n // model_parallel, model_parallel)
+    return Mesh(arr, axis_names=("data", "model"))
+
+
+# ---------------------------------------------------------------------------
+# sharded link scan
+# ---------------------------------------------------------------------------
+
+def sharded_link_scan(
+    mesh: Mesh,
+    states,
+    counters,
+    valid,
+    flap_threshold: int = 3,
+    crc_threshold: int = 100,
+) -> Tuple[WindowScan, jax.Array]:
+    """Scan [L, T] link history sharded along L over the ``data`` axis.
+    Each device scans its shard independently (no cross-link deps), so the
+    only communication is the final gather of per-link classes."""
+    link_sharding = NamedSharding(mesh, P("data", None))
+    states = jax.device_put(states, link_sharding)
+    counters = jax.device_put(counters, link_sharding)
+    valid = jax.device_put(valid, link_sharding)
+    scan = scan_links(states, counters, valid)
+    classes = classify_links(
+        scan, flap_threshold=flap_threshold, crc_threshold=crc_threshold
+    )
+    return scan, classes
+
+
+def fleet_health_summary(mesh: Mesh, classes: jax.Array) -> dict:
+    """Global counts per health class — a psum-style full reduction that
+    XLA lowers onto ICI allreduce."""
+
+    @jax.jit
+    def _summarize(c):
+        return jnp.stack(
+            [
+                jnp.sum(c == 0),
+                jnp.sum(c == 1),
+                jnp.sum(c == 2),
+            ]
+        )
+
+    healthy, degraded, unhealthy = [int(x) for x in _summarize(classes)]
+    return {"healthy": healthy, "degraded": degraded, "unhealthy": unhealthy}
+
+
+# ---------------------------------------------------------------------------
+# sharded anomaly scoring + autoencoder training
+# ---------------------------------------------------------------------------
+
+def sharded_robust_scores(mesh: Mesh, windows) -> jax.Array:
+    """[C, T, F] chip windows sharded along chips."""
+    sharding = NamedSharding(mesh, P("data", None, None))
+    windows = jax.device_put(windows, sharding)
+    return robust_scores(windows)
+
+
+def ae_param_sharding(mesh: Mesh) -> AEParams:
+    """Tensor-parallel layout: hidden dimension split over ``model``
+    (column-parallel encoder, row-parallel decoder — XLA inserts the
+    reduce-scatter/all-gather pair from these annotations)."""
+    s = lambda *spec: NamedSharding(mesh, P(*spec))  # noqa: E731
+    return AEParams(
+        w_enc=s(None, "model"),
+        b_enc=s("model"),
+        w_lat=s("model", None),
+        b_lat=s(None),
+        w_dec1=s(None, "model"),
+        b_dec1=s("model"),
+        w_dec2=s("model", None),
+        b_dec2=s(None),
+    )
+
+
+def make_sharded_train_step(mesh: Mesh, lr: float = 1e-3):
+    """jit-compiled dp+tp training step: batch over ``data``, hidden over
+    ``model``. Gradient averaging across data shards is XLA-inserted."""
+    batch_sharding = NamedSharding(mesh, P("data", None))
+    param_shardings = ae_param_sharding(mesh)
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=(param_shardings, batch_sharding),
+        out_shardings=(param_shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    def step(params: AEParams, batch: jax.Array):
+        loss, grads = jax.value_and_grad(ae_loss)(params, batch)
+        new_params = jax.tree_util.tree_map(lambda p, g: p - lr * g, params, grads)
+        return new_params, loss
+
+    return step
+
+
+def init_sharded_params(mesh: Mesh, cfg: AEConfig, seed: int = 0) -> AEParams:
+    params = ae_init(jax.random.PRNGKey(seed), cfg)
+    shardings = ae_param_sharding(mesh)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+
+
+def sharded_ae_scores(mesh: Mesh, params: AEParams, batch) -> jax.Array:
+    batch = jax.device_put(batch, NamedSharding(mesh, P("data", None)))
+    return ae_scores(params, batch)

@@ -9,12 +9,25 @@ strategy of running "with GPUs" on GPU-less CI
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force-set (not setdefault): the surrounding environment may preset
+# JAX_PLATFORMS to a live TPU platform, and tests must never grab real chips
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# a site hook may have imported jax before this file ran, capturing
+# JAX_PLATFORMS from the outer env; only then is a config-level override
+# needed (and only then is jax already paying its import cost anyway)
+import sys as _sys
+
+if "jax" in _sys.modules:
+    try:
+        _sys.modules["jax"].config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001
+        pass
 
 # the mock TPU backend by default so every test runs on a CPU-only box
 # (reference: GPUD_NVML_MOCK_ALL_SUCCESS, SURVEY §4.3)

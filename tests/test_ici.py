@@ -1,0 +1,210 @@
+"""ICI fabric scenario tests (reference test style:
+infiniband/component_production_scenarios_test.go, component_sticky_*_test.go)."""
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components.base import FailureInjector, TpudInstance
+from gpud_tpu.components.tpu.ici import TPUICIComponent
+from gpud_tpu.components.tpu.ici_store import ICIStore
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.tpu.instance import ICILinkSnapshot, InjectedInstance, LinkState, MockBackend
+
+
+def _links(n_down=(), crc=0, t_offset=0):
+    out = []
+    for cid in range(2):
+        for lid in range(4):
+            name = f"chip{cid}/ici{lid}"
+            out.append(
+                ICILinkSnapshot(
+                    chip_id=cid,
+                    link_id=lid,
+                    state=LinkState.DOWN if name in n_down else LinkState.UP,
+                    crc_errors=crc,
+                )
+            )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# store-level
+# ---------------------------------------------------------------------------
+
+def test_store_scan_detects_drop_and_flap(tmp_db):
+    store = ICIStore(tmp_db)
+    now = [1000.0]
+    store.time_now_fn = lambda: now[0]
+    store.insert_snapshot(_links(), ts=900.0)
+    store.insert_snapshot(_links(n_down=["chip0/ici1"]), ts=920.0)  # drop
+    store.insert_snapshot(_links(), ts=940.0)                       # recover (flap)
+    store.insert_snapshot(_links(n_down=["chip1/ici3"]), ts=960.0)  # another drop, stays down
+    res = store.scan(200.0)
+    assert res.links["chip0/ici1"].drops == 1
+    assert res.links["chip0/ici1"].flaps == 1
+    assert not res.links["chip0/ici1"].currently_down
+    assert res.links["chip1/ici3"].currently_down
+    assert res.down_links == ["chip1/ici3"]
+    assert "chip0/ici1" in res.dropped_links
+
+
+def test_store_tombstone_masks_history(tmp_db):
+    store = ICIStore(tmp_db)
+    now = [1000.0]
+    store.time_now_fn = lambda: now[0]
+    store.insert_snapshot(_links(n_down=["chip0/ici0"]), ts=910.0)
+    store.insert_snapshot(_links(), ts=930.0)
+    store.set_tombstone("*", ts=950.0)
+    store.insert_snapshot(_links(), ts=960.0)
+    res = store.scan(200.0)
+    # pre-tombstone drop/flap invisible
+    assert res.links["chip0/ici0"].drops == 0
+    assert res.links["chip0/ici0"].flaps == 0
+
+
+def test_store_counter_deltas(tmp_db):
+    store = ICIStore(tmp_db)
+    store.time_now_fn = lambda: 1000.0
+    store.insert_snapshot(_links(crc=10), ts=900.0)
+    store.insert_snapshot(_links(crc=250), ts=950.0)
+    res = store.scan(200.0)
+    assert res.links["chip0/ici0"].crc_delta == 240
+
+
+def test_store_purge(tmp_db):
+    store = ICIStore(tmp_db, retention_seconds=100)
+    store.time_now_fn = lambda: 1000.0
+    store.insert_snapshot(_links(), ts=800.0)
+    store.insert_snapshot(_links(), ts=950.0)
+    assert store.purge() == 8
+    assert len(store.link_names()) == 8
+
+
+# ---------------------------------------------------------------------------
+# component-level scenarios
+# ---------------------------------------------------------------------------
+
+def _comp(tmp_db, injector=None, accel="v5e-8"):
+    tpu = MockBackend(accelerator_type=accel)
+    if injector is not None:
+        tpu = InjectedInstance(tpu, injector)
+    inst = TpudInstance(
+        tpu_instance=tpu,
+        db_rw=tmp_db,
+        event_store=EventStore(tmp_db),
+    )
+    c = TPUICIComponent(inst)
+    c.sampler.ttl = 0.0  # no caching inside scenario steps
+    return c
+
+
+def test_all_links_up_healthy(tmp_db):
+    c = _comp(tmp_db)
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert "32/32" in cr.summary()  # 8 chips × 4 links
+
+
+def test_link_down_unhealthy_with_events(tmp_db):
+    inj = FailureInjector(ici_links_down=["chip1/ici2"])
+    c = _comp(tmp_db, injector=inj)
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "chip1/ici2" in cr.summary()
+    evs = c.events(0)
+    assert any(e.name == "ici_link_down" for e in evs)
+    # repeat check: event deduped
+    c.check()
+    assert sum(1 for e in c.events(0) if e.name == "ici_link_down") == 1
+
+
+def test_sticky_after_recovery_until_set_healthy(tmp_db):
+    inj = FailureInjector(ici_links_down=["chip0/ici0"])
+    tpu = InjectedInstance(MockBackend(accelerator_type="v5e-8"), inj)
+    inst = TpudInstance(tpu_instance=tpu, db_rw=tmp_db, event_store=EventStore(tmp_db))
+    c = TPUICIComponent(inst)
+    c.sampler.ttl = 0.0
+    assert c.check().health_state_type() == HealthStateType.UNHEALTHY
+
+    # link recovers
+    inj.ici_links_down.clear()
+    cr = c.check()
+    assert cr.health_state_type() in (
+        HealthStateType.DEGRADED,
+        HealthStateType.UNHEALTHY,
+    )
+    assert "sticky" in cr.summary()
+
+    # operator clears
+    c.set_healthy()
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.HEALTHY, cr.summary()
+
+
+def test_auto_clear_window(tmp_db):
+    inj = FailureInjector(ici_links_down=["chip0/ici0"])
+    tpu = InjectedInstance(MockBackend(accelerator_type="v5e-8"), inj)
+    inst = TpudInstance(tpu_instance=tpu, db_rw=tmp_db, event_store=EventStore(tmp_db))
+    c = TPUICIComponent(inst)
+    c.sampler.ttl = 0.0
+    now = [1000.0]
+    c.time_now_fn = lambda: now[0]
+    c.store.time_now_fn = lambda: now[0]
+    c.auto_clear_window = 300.0
+
+    c.check()  # down
+    inj.ici_links_down.clear()
+    now[0] += 60
+    assert c.check().health_state_type() != HealthStateType.HEALTHY  # sticky
+
+    # 400s of clean snapshots
+    for _ in range(5):
+        now[0] += 100
+        c.check()
+    assert c.check().health_state_type() == HealthStateType.HEALTHY
+
+
+def test_heavy_flapping_unhealthy(tmp_db):
+    inj = FailureInjector()
+    tpu = InjectedInstance(MockBackend(accelerator_type="v5e-8"), inj)
+    inst = TpudInstance(tpu_instance=tpu, db_rw=tmp_db, event_store=EventStore(tmp_db))
+    c = TPUICIComponent(inst)
+    c.sampler.ttl = 0.0
+    now = [1000.0]
+    c.time_now_fn = lambda: now[0]
+    c.store.time_now_fn = lambda: now[0]
+    # 3 drop/recover cycles
+    for _ in range(3):
+        inj.ici_links_down.append("chip0/ici0")
+        now[0] += 10
+        c.check()
+        inj.ici_links_down.clear()
+        now[0] += 10
+        c.check()
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "flapped" in cr.summary()
+
+
+def test_crc_degraded(tmp_db):
+    tpu = MockBackend(accelerator_type="v5e-8")
+    inst = TpudInstance(tpu_instance=tpu, db_rw=tmp_db, event_store=EventStore(tmp_db))
+    c = TPUICIComponent(inst)
+    c.sampler.ttl = 0.0
+    now = [1000.0]
+    c.time_now_fn = lambda: now[0]
+    c.store.time_now_fn = lambda: now[0]
+
+    # hand-inject snapshots with rising CRC on one link
+    c.store.insert_snapshot(_links(crc=0), ts=900.0)
+    rising = _links(crc=0)
+    rising[0].crc_errors = 500
+    c.store.insert_snapshot(rising, ts=950.0)
+    # the live sampler shows all-up; scan sees the CRC delta on chip0/ici0
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.DEGRADED
+    assert "CRC" in cr.summary()
+
+
+def test_v5p_expected_link_count(tmp_db):
+    c = _comp(tmp_db, accel="v5p-256")
+    cr = c.check()
+    assert cr.extra_info["links_expected"] == "24"  # 4 chips × 6 links

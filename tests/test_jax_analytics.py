@@ -1,0 +1,123 @@
+"""JAX analytics tests — run on the virtual 8-device CPU mesh (conftest)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from gpud_tpu.models.anomaly import (  # noqa: E402
+    AEConfig,
+    ae_init,
+    ae_scores,
+    ae_train_step,
+    robust_scores,
+    windows_to_batch,
+)
+from gpud_tpu.ops.window_scan import classify_links, scan_links, scan_numpy_bridge  # noqa: E402
+
+
+def test_scan_links_matches_reference_semantics():
+    # link 0: stable up; link 1: drop+recover+drop; link 2: down throughout
+    states = np.array(
+        [
+            [1, 1, 1, 1, 1, 1],
+            [1, 0, 1, 0, 0, 0],
+            [0, 0, 0, 0, 0, 0],
+        ],
+        dtype=np.int8,
+    )
+    counters = np.array(
+        [
+            [0, 0, 0, 0, 0, 0],
+            [0, 10, 20, 30, 40, 50],
+            [5, 4, 10, 10, 10, 10],  # reset at step 1
+        ],
+        dtype=np.int32,
+    )
+    valid = np.ones_like(states, dtype=bool)
+    s = scan_links(jnp.asarray(states), jnp.asarray(counters), jnp.asarray(valid))
+    assert s.drops.tolist() == [0, 2, 0]
+    assert s.flaps.tolist() == [0, 1, 0]
+    assert s.currently_down.tolist() == [False, True, True]
+    assert s.counter_delta.tolist() == [0, 50, 6]  # reset step ignored
+    classes = classify_links(s, flap_threshold=2, crc_threshold=100)
+    assert classes.tolist() == [0, 2, 2]
+
+
+def test_scan_links_transitions_span_gaps():
+    # up, <missing>, down, <missing>, up → 1 drop + 1 flap, matching the
+    # SQLite store which compares consecutive snapshots across time gaps
+    states = np.array([[1, 0, 0, 1, 1]], dtype=np.int8)
+    valid = np.array([[True, False, True, False, True]])
+    s = scan_links(jnp.asarray(states), jnp.zeros((1, 5), jnp.int32), jnp.asarray(valid))
+    assert s.drops.tolist() == [1]
+    assert s.flaps.tolist() == [1]
+    assert s.currently_down.tolist() == [False]
+
+
+def test_scan_links_counter_delta_spans_gaps():
+    states = np.ones((1, 4), dtype=np.int8)
+    counters = np.array([[10, 0, 30, 35]], dtype=np.int32)
+    valid = np.array([[True, False, True, True]])
+    s = scan_links(jnp.asarray(states), jnp.asarray(counters), jnp.asarray(valid))
+    assert s.counter_delta.tolist() == [25]  # 30-10 across gap + 35-30
+
+
+def test_scan_links_ragged_validity():
+    states = np.array([[1, 0, 1, 1]], dtype=np.int8)
+    valid = np.array([[True, True, False, False]])
+    s = scan_links(jnp.asarray(states), jnp.zeros((1, 4), jnp.int32), jnp.asarray(valid))
+    assert s.drops.tolist() == [1]
+    assert s.currently_down.tolist() == [True]  # last VALID sample is down
+
+
+def test_scan_numpy_bridge():
+    rows = [("a", 0, 1, 0), ("a", 1, 0, 5), ("b", 0, 1, 2)]
+    states, counters, valid = scan_numpy_bridge(rows, {"a": 0, "b": 1}, 2, 3)
+    assert states[0, 1] == 0 and counters[0, 1] == 5
+    assert valid[1, 0] and not valid[1, 2]
+
+
+def test_robust_scores_flags_drifting_chip():
+    rng = np.random.default_rng(0)
+    windows = rng.normal(50.0, 0.5, size=(4, 64, 8)).astype(np.float32)
+    # chip 2 temperature ramps away hard in the last quarter
+    windows[2, 48:, 0] += np.linspace(0, 40, 16)
+    scores = np.asarray(robust_scores(jnp.asarray(windows)))
+    assert scores[2] == max(scores)
+    assert scores[2] > 3 * max(scores[0], scores[1], scores[3])
+
+
+def test_autoencoder_trains_and_scores():
+    cfg = AEConfig(window=8, features=8, hidden=32, latent=8)
+    params = ae_init(jax.random.PRNGKey(0), cfg)
+    rng = np.random.default_rng(1)
+    nominal = windows_to_batch(
+        jnp.asarray(rng.normal(0, 1, size=(128, cfg.window, cfg.features)), jnp.float32)
+    )
+    loss0 = None
+    for _ in range(60):
+        params, loss = ae_train_step(params, nominal, lr=1e-2)
+        if loss0 is None:
+            loss0 = float(loss)
+    assert float(loss) < loss0  # learning happened
+
+    anomalous = nominal.at[0].mul(8.0)
+    scores = np.asarray(ae_scores(params, anomalous))
+    assert scores[0] > 2 * np.median(scores)
+
+
+def test_dryrun_multichip_8_devices():
+    import __graft_entry__ as ge
+
+    assert len(jax.devices()) >= 8
+    ge.dryrun_multichip(8)
+
+
+def test_entry_compiles():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (64,)
