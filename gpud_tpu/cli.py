@@ -43,6 +43,16 @@ def _build_config(args) -> "cfgmod.Config":
         cfg.accelerator_type_override = args.accelerator_type
     if getattr(args, "expected_chip_count", 0):
         cfg.expected_chip_count = args.expected_chip_count
+    if getattr(args, "plugin_specs", ""):
+        cfg.plugin_specs_file = args.plugin_specs
+    if getattr(args, "endpoint", ""):
+        cfg.endpoint = args.endpoint
+    if getattr(args, "token", ""):
+        cfg.token = args.token
+    if getattr(args, "disable_components", ""):
+        cfg.components_disabled = [
+            c.strip() for c in args.disable_components.split(",") if c.strip()
+        ]
     cfg.log_level = getattr(args, "log_level", "info")
     return cfg
 
@@ -180,12 +190,117 @@ def cmd_machine_info(args) -> int:
     return 0
 
 
+def cmd_up(args) -> int:
+    """Install + enroll (reference: cmd/gpud/up/command.go:25, SURVEY §3.5):
+    optional login, systemd unit install, token hand-off via FIFO."""
+    import os
+
+    cfg = _build_config(args)
+    if args.token and args.endpoint:
+        from gpud_tpu.login import login as do_login
+        from gpud_tpu.metadata import Metadata
+        from gpud_tpu.sqlite import DB
+        from gpud_tpu.tpu.instance import new_instance
+        from gpud_tpu.providers.detect import detect
+
+        prov = detect(timeout=3.0)
+        md = Metadata(DB(cfg.state_file()))
+        try:
+            do_login(
+                args.endpoint, args.token, md,
+                tpu_instance=new_instance(),
+                provider=prov.provider, region=prov.region,
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"login failed: {e}", file=sys.stderr)
+            return 1
+        print("login ok")
+    if args.no_systemd:
+        print("skipping systemd install (--no-systemd)")
+        return 0
+    if os.geteuid() != 0:
+        print("error: tpud up requires root for systemd install "
+              "(use --no-systemd to skip)", file=sys.stderr)
+        return 1
+    from gpud_tpu.manager.systemd import install_unit
+    from gpud_tpu.server.server import Server
+
+    flags = []
+    if cfg.data_dir:
+        flags.append(f"--data-dir {cfg.data_dir}")
+    err = install_unit(flags=" ".join(flags))
+    if err:
+        print(f"error: {err}", file=sys.stderr)
+        return 1
+    # hand a fresh token to the (possibly already-running) daemon; the
+    # daemon creates the FIFO at boot, so retry briefly
+    if args.token:
+        import time as _time
+
+        err = "daemon fifo not ready"
+        for _ in range(10):
+            err = Server.write_token(args.token, cfg.fifo_file())
+            if err is None:
+                break
+            _time.sleep(1.0)
+        if err is not None:
+            print(f"warning: token hand-off failed: {err} — "
+                  "run `tpud up --token ... --endpoint ...` to enroll",
+                  file=sys.stderr)
+            return 1
+    print("tpud installed and started (systemd)")
+    return 0
+
+
+def cmd_down(args) -> int:
+    """Reference: cmd/gpud down — stop + disable the unit."""
+    from gpud_tpu.manager.systemd import uninstall_unit
+
+    err = uninstall_unit()
+    if err:
+        print(f"warning: {err}", file=sys.stderr)
+    print("tpud stopped")
+    return 0
+
+
+def cmd_list_plugins(args) -> int:
+    """Reference: cmd/gpud list-plugins."""
+    import os
+
+    from gpud_tpu.plugins.spec import load_specs
+
+    cfg = _build_config(args)
+    path = cfg.resolved_plugin_specs_file()
+    if not os.path.isfile(path):
+        print(f"no plugin specs at {path}")
+        return 0
+    for s in load_specs(path):
+        print(f"{s.name}\t{s.plugin_type}\t{s.run_mode}\t"
+              f"every {s.interval_seconds:.0f}s\t{len(s.steps)} step(s)")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpud", description="TPU fleet-health monitoring daemon"
     )
     p.add_argument("--version", action="version", version=f"tpud {__version__}")
     sub = p.add_subparsers(dest="cmd", required=True)
+
+    pu = sub.add_parser("up", help="install as systemd service + enroll")
+    _add_common_flags(pu)
+    pu.add_argument("--token", default="", help="control-plane join token")
+    pu.add_argument("--endpoint", default="", help="control-plane endpoint URL")
+    pu.add_argument("--no-systemd", action="store_true")
+    pu.set_defaults(fn=cmd_up)
+
+    pd = sub.add_parser("down", help="stop and disable the systemd service")
+    _add_common_flags(pd)
+    pd.set_defaults(fn=cmd_down)
+
+    plp = sub.add_parser("list-plugins", help="list configured plugin specs")
+    _add_common_flags(plp)
+    plp.set_defaults(fn=cmd_list_plugins)
 
     ps = sub.add_parser("scan", help="one-shot health scan (no daemon)")
     _add_common_flags(ps)
@@ -200,6 +315,11 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("--no-tls", action="store_true")
     pr.add_argument("--accelerator-type", default="")
     pr.add_argument("--expected-chip-count", type=int, default=0)
+    pr.add_argument("--plugin-specs", default="", help="path to plugins.yaml")
+    pr.add_argument("--endpoint", default="", help="control-plane endpoint")
+    pr.add_argument("--token", default="", help="control-plane token")
+    pr.add_argument("--disable-components", default="",
+                    help="comma-separated component names to disable")
     pr.set_defaults(fn=cmd_run)
 
     pi = sub.add_parser("inject-fault", help="inject a synthetic fault via kmsg")

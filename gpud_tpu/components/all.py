@@ -8,6 +8,17 @@ from typing import List
 from gpud_tpu.components.base import InitFunc
 from gpud_tpu.components.cpu import CPUComponent
 from gpud_tpu.components.disk import DiskComponent
+from gpud_tpu.components.host_extra import (
+    ContainerdComponent,
+    DockerComponent,
+    FuseComponent,
+    KernelModuleComponent,
+    KubeletComponent,
+    LibraryComponent,
+    NetworkLatencyComponent,
+    NFSComponent,
+    PCIComponent,
+)
 from gpud_tpu.components.memory import MemoryComponent
 from gpud_tpu.components.os_comp import OSComponent
 from gpud_tpu.components.tpu.chip_counts import TPUChipCountsComponent
@@ -26,6 +37,15 @@ def all_components() -> List[InitFunc]:
         CPUComponent,
         MemoryComponent,
         DiskComponent,
+        FuseComponent,
+        KernelModuleComponent,
+        LibraryComponent,
+        NetworkLatencyComponent,
+        NFSComponent,
+        PCIComponent,
+        ContainerdComponent,
+        DockerComponent,
+        KubeletComponent,
         TPUChipCountsComponent,
         TPUTemperatureComponent,
         TPUHbmComponent,

@@ -58,6 +58,7 @@ class Config:
     components_enabled: List[str] = field(default_factory=list)   # empty = all
     components_disabled: List[str] = field(default_factory=list)
     kernel_modules_to_check: List[str] = field(default_factory=list)
+    nfs_group_dirs: List[str] = field(default_factory=list)
     mount_points: List[str] = field(default_factory=list)
     mount_targets: List[str] = field(default_factory=list)
     expected_chip_count: int = 0         # 0 = derive from accelerator type

@@ -1,0 +1,161 @@
+"""Package manager — control-plane-pushed add-on packages.
+
+Reference: pkg/gpud-manager — a file informer watches
+``<dataDir>/packages/*/init.sh`` dirs (informer/file_informer.go:22-34) and
+a PackageController runs reconcile/update/install/status/delete loops
+(controllers/package_controller.go:46-52). Status is reported as
+``PackageStatus{IsInstalled, Installing, Progress, Target/CurrentVersion}``
+(packages/packages.go:13-35).
+
+Contract per package dir ``<packages>/<name>/``:
+- ``init.sh``      — installer; receives TARGET_VERSION env; writes
+                     ``installed_version`` on success.
+- ``version``      — target version (pushed by the control plane).
+- ``status.sh``    — optional health probe; exit 0 = running.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+from gpud_tpu.api.v1.types import PackagePhase, PackageStatus
+from gpud_tpu.log import get_logger
+from gpud_tpu.process import run_command
+
+logger = get_logger(__name__)
+
+RECONCILE_INTERVAL = 60.0
+INSTALL_TIMEOUT = 15 * 60.0
+
+
+def _read(path: str) -> str:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+class PackageManager:
+    """Reference: gpudmanager.Manager Start/Status (manager.go:24-46);
+    the five controller loops are collapsed into one reconcile thread."""
+
+    def __init__(self, packages_dir: str) -> None:
+        self.packages_dir = packages_dir
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._mu = threading.Lock()
+        self._progress: Dict[str, int] = {}
+        self._installing: Dict[str, bool] = {}
+
+    # -- discovery ---------------------------------------------------------
+    def package_names(self) -> List[str]:
+        if not os.path.isdir(self.packages_dir):
+            return []
+        out = []
+        for name in sorted(os.listdir(self.packages_dir)):
+            d = os.path.join(self.packages_dir, name)
+            if os.path.isdir(d) and os.path.isfile(os.path.join(d, "init.sh")):
+                out.append(name)
+        return out
+
+    # -- status ------------------------------------------------------------
+    def status(self) -> List[PackageStatus]:
+        out = []
+        for name in self.package_names():
+            d = os.path.join(self.packages_dir, name)
+            target = _read(os.path.join(d, "version"))
+            current = _read(os.path.join(d, "installed_version"))
+            with self._mu:
+                installing = self._installing.get(name, False)
+                progress = self._progress.get(name, 0)
+            if installing:
+                phase = PackagePhase.INSTALLING
+            elif current and (not target or current == target):
+                phase = PackagePhase.INSTALLED
+            elif not target:
+                phase = PackagePhase.SKIPPED
+            else:
+                phase = PackagePhase.UNKNOWN
+            out.append(
+                PackageStatus(
+                    name=name,
+                    phase=phase,
+                    status="running" if self._probe(d) else "",
+                    current_version=current,
+                    target_version=target,
+                    progress=100 if phase == PackagePhase.INSTALLED else progress,
+                    is_installed=phase == PackagePhase.INSTALLED,
+                    installing=installing,
+                )
+            )
+        return out
+
+    def _probe(self, pkg_dir: str) -> bool:
+        probe = os.path.join(pkg_dir, "status.sh")
+        if not os.path.isfile(probe):
+            return False
+        return run_command(["bash", probe], timeout=30.0).exit_code == 0
+
+    # -- reconcile ---------------------------------------------------------
+    def reconcile_once(self) -> None:
+        for name in self.package_names():
+            d = os.path.join(self.packages_dir, name)
+            target = _read(os.path.join(d, "version"))
+            current = _read(os.path.join(d, "installed_version"))
+            if not target or target == current:
+                continue
+            self._install(name, d, target)
+
+    def _install(self, name: str, pkg_dir: str, target: str) -> None:
+        with self._mu:
+            if self._installing.get(name):
+                return
+            self._installing[name] = True
+            self._progress[name] = 10
+        logger.info("installing package %s version %s", name, target)
+        try:
+            r = run_command(
+                ["bash", os.path.join(pkg_dir, "init.sh")],
+                timeout=INSTALL_TIMEOUT,
+                env={"TARGET_VERSION": target, "PACKAGE_DIR": pkg_dir},
+            )
+            if r.exit_code == 0:
+                with open(
+                    os.path.join(pkg_dir, "installed_version"), "w", encoding="utf-8"
+                ) as f:
+                    f.write(target)
+                logger.info("package %s installed at %s", name, target)
+            else:
+                logger.warning(
+                    "package %s install failed (exit %d): %s",
+                    name, r.exit_code, r.output[-500:],
+                )
+        finally:
+            with self._mu:
+                self._installing[name] = False
+                self._progress[name] = 0
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="tpud-package-manager", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(RECONCILE_INTERVAL):
+            try:
+                self.reconcile_once()
+            except Exception:  # noqa: BLE001
+                logger.exception("package reconcile failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

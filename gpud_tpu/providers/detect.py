@@ -1,0 +1,141 @@
+"""Cloud provider detection.
+
+Reference: pkg/providers — per-cloud IMDS fetchers (aws/azure/gcp/nebius/
+nscale/oci subdirs) behind a generic ``Detector``/``RegionDetector``
+(detect.go:13-51), with an ASN fallback (pkg/asn) when no IMDS answers.
+TPU fleets are overwhelmingly GCE, so GCP is first and richest (it also
+yields the TPU accelerator-type/topology metadata).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+IMDS_TIMEOUT = 1.5
+
+
+@dataclass
+class DetectResult:
+    provider: str = ""
+    region: str = ""
+    zone: str = ""
+    instance_type: str = ""
+    accelerator_type: str = ""   # GCP TPU VMs only
+    raw: Dict[str, str] = field(default_factory=dict)
+
+
+def _http_get(url: str, headers: Dict[str, str], timeout: float = IMDS_TIMEOUT) -> str:
+    import urllib.request
+
+    req = urllib.request.Request(url, headers=headers)
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode("utf-8", "replace").strip()
+
+
+def detect_gcp(get_fn: Callable = _http_get) -> Optional[DetectResult]:
+    base = "http://metadata.google.internal/computeMetadata/v1"
+    h = {"Metadata-Flavor": "Google"}
+    try:
+        zone_path = get_fn(f"{base}/instance/zone", h)
+    except Exception:  # noqa: BLE001
+        return None
+    zone = zone_path.rsplit("/", 1)[-1]
+    region = zone.rsplit("-", 1)[0] if "-" in zone else zone
+    res = DetectResult(provider="gcp", region=region, zone=zone)
+    try:
+        res.instance_type = get_fn(
+            f"{base}/instance/machine-type", h
+        ).rsplit("/", 1)[-1]
+    except Exception:  # noqa: BLE001
+        pass
+    for attr in ("accelerator-type", "tpu-env"):
+        try:
+            v = get_fn(f"{base}/instance/attributes/{attr}", h)
+            res.raw[attr] = v
+            if attr == "accelerator-type":
+                res.accelerator_type = v
+        except Exception:  # noqa: BLE001
+            pass
+    return res
+
+
+def detect_aws(get_fn: Callable = _http_get) -> Optional[DetectResult]:
+    base = "http://169.254.169.254/latest"
+    try:
+        token = _imds_v2_token()
+        h = {"X-aws-ec2-metadata-token": token} if token else {}
+        doc = get_fn(f"{base}/dynamic/instance-identity/document", h)
+        d = json.loads(doc)
+        return DetectResult(
+            provider="aws",
+            region=d.get("region", ""),
+            zone=d.get("availabilityZone", ""),
+            instance_type=d.get("instanceType", ""),
+        )
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _imds_v2_token() -> str:
+    import urllib.request
+
+    try:
+        req = urllib.request.Request(
+            "http://169.254.169.254/latest/api/token",
+            method="PUT",
+            headers={"X-aws-ec2-metadata-token-ttl-seconds": "60"},
+        )
+        with urllib.request.urlopen(req, timeout=IMDS_TIMEOUT) as resp:
+            return resp.read().decode()
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def detect_azure(get_fn: Callable = _http_get) -> Optional[DetectResult]:
+    try:
+        doc = get_fn(
+            "http://169.254.169.254/metadata/instance/compute?api-version=2021-02-01",
+            {"Metadata": "true"},
+        )
+        d = json.loads(doc)
+        return DetectResult(
+            provider="azure",
+            region=d.get("location", ""),
+            zone=d.get("zone", ""),
+            instance_type=d.get("vmSize", ""),
+        )
+    except Exception:  # noqa: BLE001
+        return None
+
+
+DETECTORS: List[Callable[[], Optional[DetectResult]]] = [
+    detect_gcp,
+    detect_aws,
+    detect_azure,
+]
+
+
+def detect(timeout: float = 5.0) -> DetectResult:
+    """Try all detectors concurrently; first hit wins, GCP preferred
+    (reference: detect.go runs per-cloud fetchers and falls back to ASN)."""
+    with concurrent.futures.ThreadPoolExecutor(max_workers=len(DETECTORS)) as ex:
+        futures = {ex.submit(d): d.__name__ for d in DETECTORS}
+        results: Dict[str, DetectResult] = {}
+        try:
+            for fut in concurrent.futures.as_completed(futures, timeout=timeout):
+                r = fut.result()
+                if r is not None:
+                    results[r.provider] = r
+        except concurrent.futures.TimeoutError:
+            pass
+    for preferred in ("gcp", "aws", "azure"):
+        if preferred in results:
+            return results[preferred]
+    return DetectResult(provider="unknown")

@@ -124,6 +124,8 @@ def build_app(srv: "Server") -> web.Application:
         for c in srv.registry.all():
             if comps and c.name() not in comps:
                 continue
+            if not comps and c.name() not in srv.supported_names:
+                continue  # unsupported components are skipped unless asked for
             out.append(
                 ComponentHealthStates(
                     component=c.name(), states=c.last_health_states()
@@ -139,6 +141,8 @@ def build_app(srv: "Server") -> web.Application:
         out = []
         for c in srv.registry.all():
             if comps and c.name() not in comps:
+                continue
+            if not comps and c.name() not in srv.supported_names:
                 continue
             evs = [e for e in c.events(start) if e.time <= end]
             out.append(
@@ -175,6 +179,8 @@ def build_app(srv: "Server") -> web.Application:
         out = []
         for c in srv.registry.all():
             if comps and c.name() not in comps:
+                continue
+            if not comps and c.name() not in srv.supported_names:
                 continue
             out.append(
                 ComponentInfo(

@@ -10,6 +10,7 @@ session/token loop and the auto-update watcher (wired in later stages).
 from __future__ import annotations
 
 import asyncio
+import os
 import threading
 from typing import List, Optional
 
@@ -115,10 +116,57 @@ class Server:
         self.kmsg_watcher = SharedWatcher(path=self._kmsg_path, from_now=True)
         self._wire_kmsg_syncers()
 
-        # plugins/packages placeholders (stage 8 wires them)
-        self.plugin_specs = None
-        self.package_manager = None
+        # plugins (reference: server.go:343-387 init + component registries)
+        self.plugin_specs = []
+        specs_file = self.config.resolved_plugin_specs_file()
+        if os.path.isfile(specs_file):
+            from gpud_tpu.plugins.component import (
+                build_components,
+                run_init_plugins,
+            )
+            from gpud_tpu.plugins.spec import load_specs
+
+            self.plugin_specs = load_specs(specs_file)
+            init_err = run_init_plugins(self.tpud_instance, self.plugin_specs)
+            if init_err:
+                raise RuntimeError(init_err)  # fail boot (reference: 343-387)
+            for comp in build_components(self.tpud_instance, self.plugin_specs):
+                # a name clash with a built-in must not crash-loop the boot;
+                # skip and log (dispatch rejects such specs upfront, but an
+                # older or hand-edited plugins.yaml can still contain one)
+                _, reg_err = self.registry.register(lambda _inst, c=comp: c)
+                if reg_err is not None:
+                    logger.error(
+                        "skipping plugin %r: %s", comp.name(), reg_err
+                    )
+
+        # package manager (reference: gpudmanager.New + Start)
+        from gpud_tpu.manager.packages import PackageManager
+
+        self.package_manager = PackageManager(self.config.packages_dir())
+
+        # auto-update watcher (reference: server.go:814-832)
+        from gpud_tpu.update import VersionFileWatcher
+
+        self.update_watcher = (
+            VersionFileWatcher(self.config.target_version_file())
+            if self.config.enable_auto_update
+            else None
+        )
+
+        # control-plane session (reference: server.go:448 updateToken loop)
         self.session = None
+        self.dispatcher = None
+        self.last_gossip = None
+        self._session_mu = threading.Lock()
+        self._closed = False
+
+        # supportedness is evaluated once off the event loop: probes like
+        # docker/kubelet shell out or open sockets, which must never run
+        # inside async handlers
+        self.supported_names = {
+            c.name() for c in self.registry.all() if c.is_supported()
+        }
 
         # http plumbing
         self._app = build_app(self)
@@ -151,12 +199,17 @@ class Server:
         """Start pollers + API listener (non-blocking; reference spawns
         goroutines at server.go:390-450)."""
         for comp in self.registry.all():
-            if comp.is_supported():
+            if comp.name() in self.supported_names:
                 comp.start()
         self.kmsg_watcher.start()
         self.event_store.start_purger()
         self.metrics_syncer.start()
         self.self_metrics.start()
+        self.package_manager.start()
+        if self.update_watcher is not None:
+            self.update_watcher.start()
+        self._maybe_start_session()
+        self._start_token_fifo()
 
         self._thread = threading.Thread(
             target=self._serve, name="tpud-http", daemon=True
@@ -204,6 +257,22 @@ class Server:
 
     def stop(self) -> None:
         logger.info("stopping tpud server")
+        with self._session_mu:
+            self._closed = True  # bars the fifo watcher from new sessions
+        if getattr(self, "_fifo_stop", None) is not None:
+            self._fifo_stop.set()
+            # unblock the fifo reader's blocking open
+            err = self.write_token("", self.config.fifo_file())
+            del err
+            if getattr(self, "_fifo_thread", None) is not None:
+                self._fifo_thread.join(timeout=3.0)
+        with self._session_mu:
+            if self.session is not None:
+                self.session.stop()
+                self.session = None
+        self.package_manager.close()
+        if self.update_watcher is not None:
+            self.update_watcher.close()
         if self._loop is not None and self._loop.is_running():
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
@@ -218,6 +287,88 @@ class Server:
             except Exception:  # noqa: BLE001
                 logger.exception("component %s close failed", comp.name())
         self.event_store.close()
+
+    # -- session wiring ----------------------------------------------------
+    def _maybe_start_session(self) -> None:
+        """Create the control-plane session when an endpoint + token exist
+        (reference: server.updateToken → session.NewSession, server.go:590)."""
+        from gpud_tpu import metadata as md
+
+        with self._session_mu:
+            if self._closed:
+                return
+            endpoint = self.config.endpoint or self.metadata.get(md.KEY_ENDPOINT)
+            token = self.config.token or self.metadata.get(md.KEY_TOKEN)
+            if not endpoint or not token:
+                return
+            from gpud_tpu.session.dispatch import Dispatcher
+            from gpud_tpu.session.session import Session
+
+            self.dispatcher = Dispatcher(self)
+            self.session = Session(
+                endpoint=endpoint,
+                machine_id=self.machine_id,
+                token=token,
+                machine_proof=self.metadata.get(md.KEY_MACHINE_PROOF),
+                dispatch_fn=self.dispatcher,
+            )
+            self.session.start()
+            logger.info("control-plane session started to %s", endpoint)
+
+    def _start_token_fifo(self) -> None:
+        """FIFO so `tpud up`'s login can hand a fresh token to a running
+        daemon (reference: server.go:638-713 gpud.fifo + WriteToken
+        727-756)."""
+        if self.config.db_in_memory:
+            return
+        fifo_path = self.config.fifo_file()
+        try:
+            if not os.path.exists(fifo_path):
+                os.mkfifo(fifo_path)
+        except OSError as e:
+            logger.warning("token fifo unavailable: %s", e)
+            return
+
+        def watch():
+            from gpud_tpu import metadata as md
+
+            while not self._fifo_stop.is_set():
+                try:
+                    # blocking open until a writer appears
+                    with open(fifo_path, "r", encoding="utf-8") as f:
+                        token = f.read().strip()
+                    if self._fifo_stop.is_set():
+                        return
+                    if token:
+                        self.metadata.set(md.KEY_TOKEN, token)
+                        logger.info("received new token via fifo; (re)starting session")
+                        with self._session_mu:
+                            if self.session is not None:
+                                self.session.stop()
+                                self.session = None
+                        self._maybe_start_session()
+                except OSError:
+                    if self._fifo_stop.wait(1.0):
+                        return
+
+        self._fifo_stop = threading.Event()
+        self._fifo_thread = threading.Thread(
+            target=watch, name="tpud-token-fifo", daemon=True
+        )
+        self._fifo_thread.start()
+
+    @staticmethod
+    def write_token(token: str, fifo_path: str) -> Optional[str]:
+        """Reference: server.WriteToken (server.go:727-756)."""
+        try:
+            fd = os.open(fifo_path, os.O_WRONLY | os.O_NONBLOCK)
+            try:
+                os.write(fd, (token + "\n").encode())
+            finally:
+                os.close(fd)
+            return None
+        except OSError as e:
+            return str(e)
 
     # -- conveniences ------------------------------------------------------
     def base_url(self) -> str:

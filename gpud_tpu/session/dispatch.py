@@ -1,0 +1,285 @@
+"""Control-plane request dispatch.
+
+Reference: pkg/session/session_process_request.go:24-157 — the method set a
+session must answer: reboot | metrics | states | events | delete | logout |
+setHealthy | gossip | packageStatus | update | updateConfig | bootstrap |
+injectFault | triggerComponent | deregisterComponent | setPluginSpecs |
+getPluginSpecs | updateToken | getToken.
+
+Requests arrive as ``{"method": "...", ...params}``; responses are plain
+dicts. Slow operations (gossip: NFS can hang; triggerComponent: slow
+checks) run asynchronously and return immediately (reference rationale:
+session_process_request.go:64-84, 108-125).
+"""
+
+from __future__ import annotations
+
+import base64
+import threading
+import time
+from typing import TYPE_CHECKING, Callable, Dict
+
+from gpud_tpu import host as pkghost
+from gpud_tpu import machine_info as machineinfo
+from gpud_tpu.fault_injector import Request as InjectRequest
+from gpud_tpu.log import audit, get_logger
+from gpud_tpu.metadata import KEY_TOKEN
+from gpud_tpu.process import run_bash_script
+
+if TYPE_CHECKING:
+    from gpud_tpu.server.server import Server
+
+logger = get_logger(__name__)
+
+DEFAULT_BOOTSTRAP_TIMEOUT = 10 * 60.0
+# exit code asking the supervisor (systemd/DaemonSet) to restart us with
+# new plugin specs (reference: session_process_request.go:137-141)
+RESTART_EXIT_CODE = 245
+
+
+class Dispatcher:
+    def __init__(self, server: "Server") -> None:
+        self.server = server
+        self.reboot_fn: Callable = pkghost.reboot
+        self.exit_fn: Callable[[int], None] = None  # set by server run loop
+
+    def __call__(self, req: Dict) -> Dict:
+        method = req.get("method", "")
+        handler = getattr(self, f"_m_{method.replace('-', '_')}", None)
+        if handler is None:
+            return {"error": f"unknown method {method!r}"}
+        audit("session_request", method=method)
+        try:
+            return handler(req)
+        except Exception as e:  # noqa: BLE001
+            logger.exception("session method %s failed", method)
+            return {"error": str(e)}
+
+    # -- state/introspection ----------------------------------------------
+    def _m_states(self, req: Dict) -> Dict:
+        comps = req.get("components") or None
+        out = []
+        for c in self.server.registry.all():
+            if comps and c.name() not in comps:
+                continue
+            if not comps and c.name() not in self.server.supported_names:
+                continue
+            out.append(
+                {
+                    "component": c.name(),
+                    "states": [s.to_dict() for s in c.last_health_states()],
+                }
+            )
+        return {"states": out}
+
+    def _m_events(self, req: Dict) -> Dict:
+        since = float(req.get("since", time.time() - 3 * 3600))
+        out = []
+        for c in self.server.registry.all():
+            evs = c.events(since)
+            out.append(
+                {"component": c.name(), "events": [e.to_dict() for e in evs]}
+            )
+        return {"events": out}
+
+    def _m_metrics(self, req: Dict) -> Dict:
+        since = float(req.get("since", time.time() - 3 * 3600))
+        ms = self.server.metrics_store.read(since)
+        return {"metrics": [m.to_dict() for m in ms]}
+
+    def _m_gossip(self, req: Dict) -> Dict:
+        # async: machine info can hang on NFS stat (reference:
+        # session_process_request.go:64-84) — compute in a thread and
+        # return immediately; the control plane polls again
+        result: Dict = {"status": "started"}
+
+        def work():
+            try:
+                mi = machineinfo.get_machine_info(
+                    tpu=self.server.tpu_instance,
+                    machine_id=self.server.machine_id,
+                )
+                self.server.last_gossip = mi.to_dict()
+            except Exception:  # noqa: BLE001
+                logger.exception("gossip failed")
+
+        threading.Thread(target=work, daemon=True).start()
+        if getattr(self.server, "last_gossip", None):
+            result["machine_info"] = self.server.last_gossip
+            result["status"] = "ok"
+        return result
+
+    # -- actions -----------------------------------------------------------
+    def _m_reboot(self, req: Dict) -> Dict:
+        delay = float(req.get("delay_seconds", 0))
+        audit("session_reboot", delay=delay)
+
+        def work():
+            if delay:
+                time.sleep(delay)
+            err = self.reboot_fn()
+            if err:
+                logger.error("reboot failed: %s", err)
+
+        threading.Thread(target=work, daemon=True).start()
+        return {"status": "rebooting"}
+
+    def _m_setHealthy(self, req: Dict) -> Dict:
+        name = req.get("component", "")
+        c = self.server.registry.get(name)
+        if c is None:
+            return {"error": f"component {name!r} not found"}
+        fn = getattr(c, "set_healthy", None)
+        if fn is None:
+            return {"error": f"component {name!r} is not health-settable"}
+        fn()
+        return {"status": "ok"}
+
+    def _m_triggerComponent(self, req: Dict) -> Dict:
+        # async: checks can be slow (reference: 108-125)
+        name = req.get("component", "")
+        tag = req.get("tag", "")
+        comps = []
+        if name:
+            c = self.server.registry.get(name)
+            if c is None:
+                return {"error": f"component {name!r} not found"}
+            comps = [c]
+        elif tag:
+            comps = [c for c in self.server.registry.all() if tag in c.tags()]
+        for c in comps:
+            threading.Thread(target=c.check, daemon=True).start()
+        return {"status": "triggered", "components": [c.name() for c in comps]}
+
+    def _m_deregisterComponent(self, req: Dict) -> Dict:
+        name = req.get("component", "")
+        c = self.server.registry.get(name)
+        if c is None:
+            return {"error": f"component {name!r} not found"}
+        if not c.can_deregister():
+            return {"error": f"component {name!r} is not deregisterable"}
+        self.server.registry.deregister(name)
+        c.close()
+        return {"status": "ok"}
+
+    def _m_injectFault(self, req: Dict) -> Dict:
+        ir = InjectRequest.from_dict(req)
+        err = self.server.fault_injector.inject(ir)
+        return {"error": err} if err else {"status": "ok"}
+
+    def _m_bootstrap(self, req: Dict) -> Dict:
+        """base64 script exec (reference: session bootstrap)."""
+        b64 = req.get("script_base64", "")
+        try:
+            script = base64.b64decode(b64, validate=True).decode("utf-8")
+        except Exception:  # noqa: BLE001
+            return {"error": "invalid base64 script"}
+        if not script.strip():
+            return {"error": "empty script"}
+        timeout = float(req.get("timeout_seconds", DEFAULT_BOOTSTRAP_TIMEOUT))
+        audit("bootstrap_script", length=len(script))
+        r = run_bash_script(script, timeout=timeout)
+        return {
+            "exit_code": r.exit_code,
+            "output": r.output[-4096:],
+            "error": r.error,
+        }
+
+    # -- config/token ------------------------------------------------------
+    def _m_updateConfig(self, req: Dict) -> Dict:
+        """Runtime re-config pushed by the control plane (reference:
+        session/update_config.go:19 → setters, session.go:222-227)."""
+        updated = []
+        cfgs = req.get("configs", {})
+        if "expected_chip_count" in cfgs:
+            n = int(cfgs["expected_chip_count"])
+            comp = self.server.registry.get("accelerator-tpu-chip-counts")
+            if comp is not None:
+                comp.expected_count = n
+                updated.append("expected_chip_count")
+        if "ici" in cfgs:
+            ici_cfg = cfgs["ici"]
+            comp = self.server.registry.get("accelerator-tpu-ici")
+            if comp is not None:
+                for key in ("flap_threshold", "crc_delta_degraded",
+                            "auto_clear_window", "scan_window"):
+                    if key in ici_cfg:
+                        setattr(comp, key, type(getattr(comp, key))(ici_cfg[key]))
+                        updated.append(f"ici.{key}")
+        if "temperature" in cfgs:
+            t_cfg = cfgs["temperature"]
+            comp = self.server.registry.get("accelerator-tpu-temperature")
+            if comp is not None:
+                for key in ("degraded_c", "unhealthy_c"):
+                    if key in t_cfg:
+                        setattr(comp, key, float(t_cfg[key]))
+                        updated.append(f"temperature.{key}")
+        return {"status": "ok", "updated": updated}
+
+    def _m_updateToken(self, req: Dict) -> Dict:
+        token = req.get("token", "")
+        if not token:
+            return {"error": "token required"}
+        self.server.metadata.set(KEY_TOKEN, token)
+        if self.server.session is not None:
+            self.server.session.token = token
+        return {"status": "ok"}
+
+    def _m_getToken(self, req: Dict) -> Dict:
+        return {"token": self.server.metadata.get(KEY_TOKEN)}
+
+    def _m_logout(self, req: Dict) -> Dict:
+        """Deregister from the control plane (reference: delete/logout)."""
+        from gpud_tpu import metadata as md
+
+        for key in (md.KEY_TOKEN, md.KEY_MACHINE_PROOF, md.KEY_MACHINE_ID):
+            self.server.metadata.delete(key)
+        return {"status": "ok"}
+
+    _m_delete = _m_logout
+
+    # -- packages / update / plugins --------------------------------------
+    def _m_packageStatus(self, req: Dict) -> Dict:
+        if self.server.package_manager is None:
+            return {"packages": []}
+        return {
+            "packages": [s.to_dict() for s in self.server.package_manager.status()]
+        }
+
+    def _m_update(self, req: Dict) -> Dict:
+        """Write the target-version file; the update watcher acts on it
+        (reference: pkg/update/version_file.go:16)."""
+        version = req.get("version", "")
+        if not version:
+            return {"error": "version required"}
+        from gpud_tpu.update import write_target_version
+
+        write_target_version(self.server.config.target_version_file(), version)
+        return {"status": "ok", "target_version": version}
+
+    def _m_getPluginSpecs(self, req: Dict) -> Dict:
+        specs = self.server.plugin_specs or []
+        return {"specs": [s.to_dict() for s in specs]}
+
+    def _m_setPluginSpecs(self, req: Dict) -> Dict:
+        """Persist new specs; ask the supervisor for a restart so the new
+        plugin set takes effect (reference: 137-141 exit-code restart)."""
+        from gpud_tpu.plugins.spec import save_specs, specs_from_list
+
+        try:
+            specs = specs_from_list(req.get("specs", []))
+        except (ValueError, KeyError) as e:
+            return {"error": f"invalid specs: {e}"}
+        # a spec named like a built-in component would crash-loop the next
+        # boot at registration time — reject before persisting
+        from gpud_tpu.components.all import all_components
+
+        builtin = {getattr(f, "NAME", "") for f in all_components()}
+        clashes = [s.name for s in specs if s.name in builtin]
+        if clashes:
+            return {"error": f"plugin name(s) clash with built-in components: {clashes}"}
+        save_specs(self.server.config.resolved_plugin_specs_file(), specs)
+        needs_restart = True
+        if needs_restart and self.exit_fn is not None:
+            threading.Timer(1.0, lambda: self.exit_fn(RESTART_EXIT_CODE)).start()
+        return {"status": "ok", "restarting": needs_restart}

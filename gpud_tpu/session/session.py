@@ -1,0 +1,295 @@
+"""Control-plane session — bidirectional channel to the fleet manager.
+
+Reference: pkg/session (SURVEY §3.3). Protocol v1: two long-lived chunked
+HTTP streams against ``<endpoint>/api/v1/session`` —
+- writer: POST with ``X-TPUD-Session-Type: write``, chunked request body
+  carrying newline-delimited JSON responses up (reference:
+  session.go:525-575),
+- reader: POST with type ``read``, streaming newline-delimited JSON
+  requests down (reference: session.go:619+).
+
+Each frame is ``{"req_id": str, "data": {...}}``. The keep-alive loop
+reconnects both streams with exponential backoff + jitter, and drains the
+reader channel on reconnect (reference: session_keepalive.go:11,
+session_reconnect.go). Auth rides headers: machine id, token, machine
+proof (reference: session.go:486-510).
+
+Every network-touching function is injectable for tests
+(reference pattern: session.go:262-296 timeAfterFunc/jitterFunc/
+startReaderFunc).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import random
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+from gpud_tpu.log import get_logger
+from gpud_tpu.version import __version__
+
+logger = get_logger(__name__)
+
+CHANNEL_CAP = 20          # reference: session.go:420-423
+PIPE_INTERVAL = 3.0       # reference: server.go:616
+BACKOFF_INITIAL = 1.0
+BACKOFF_MAX = 60.0
+BACKOFF_FACTOR = 2.0
+
+HEADER_SESSION_TYPE = "X-TPUD-Session-Type"
+HEADER_MACHINE_ID = "X-TPUD-Machine-ID"
+HEADER_TOKEN = "Authorization"
+HEADER_MACHINE_PROOF = "X-TPUD-Machine-Proof"
+HEADER_VERSION = "X-TPUD-Version"
+
+
+class Frame:
+    """One wire frame (reference: session.go Body{ReqID, Data})."""
+
+    def __init__(self, req_id: str = "", data: Optional[dict] = None) -> None:
+        self.req_id = req_id
+        self.data = data or {}
+
+    def to_json(self) -> str:
+        return json.dumps({"req_id": self.req_id, "data": self.data})
+
+    @classmethod
+    def from_json(cls, line: str) -> Optional["Frame"]:
+        try:
+            d = json.loads(line)
+        except ValueError:
+            return None
+        if not isinstance(d, dict):
+            return None
+        return cls(req_id=str(d.get("req_id", "")), data=d.get("data") or {})
+
+
+class Session:
+    """reference: session.NewSession (session.go:342)."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        machine_id: str,
+        token: str = "",
+        machine_proof: str = "",
+        dispatch_fn: Optional[Callable[[dict], dict]] = None,
+        start_reader_fn=None,
+        start_writer_fn=None,
+        jitter_fn: Callable[[float], float] = None,
+        time_sleep_fn: Callable[[float], bool] = None,
+        audit_logger=None,
+    ) -> None:
+        self.endpoint = endpoint.rstrip("/")
+        self.machine_id = machine_id
+        self.token = token
+        self.machine_proof = machine_proof
+        self.dispatch_fn = dispatch_fn or (lambda req: {"error": "no dispatcher"})
+
+        self.reader: "queue.Queue[Frame]" = queue.Queue(maxsize=CHANNEL_CAP)
+        self.writer: "queue.Queue[Frame]" = queue.Queue(maxsize=CHANNEL_CAP)
+
+        self._stop = threading.Event()
+        self._threads = []
+        self._reconnect_signal = threading.Event()
+        self._connected = threading.Event()
+        self.reconnect_count = 0
+        self.last_connect_error: str = ""
+
+        # injectables
+        self.start_reader_fn = start_reader_fn or self._http_reader
+        self.start_writer_fn = start_writer_fn or self._http_writer
+        self.jitter_fn = jitter_fn or (lambda b: b * (0.5 + random.random()))
+        # returns True if stop was requested during the sleep
+        self.time_sleep_fn = time_sleep_fn or (lambda s: self._stop.wait(s))
+        self.audit_logger = audit_logger
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        for name, target in (
+            ("tpud-session-keepalive", self._keep_alive),
+            ("tpud-session-serve", self._serve),
+        ):
+            t = threading.Thread(target=target, name=name, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._reconnect_signal.set()
+        for t in self._threads:
+            t.join(timeout=3.0)
+        self._threads.clear()
+
+    @property
+    def connected(self) -> bool:
+        return self._connected.is_set()
+
+    # -- keep-alive / reconnect (reference: session_keepalive.go,
+    #    session_reconnect.go) -------------------------------------------
+    def _keep_alive(self) -> None:
+        backoff = BACKOFF_INITIAL
+        while not self._stop.is_set():
+            self._drain_reader()
+            self._reconnect_signal.clear()
+            try:
+                stop_reader = self.start_reader_fn(self)
+                stop_writer = self.start_writer_fn(self)
+            except Exception as e:  # noqa: BLE001
+                self.last_connect_error = str(e)
+                logger.warning("session connect failed: %s", e)
+                if self.time_sleep_fn(self.jitter_fn(backoff)):
+                    return
+                backoff = min(backoff * BACKOFF_FACTOR, BACKOFF_MAX)
+                continue
+            self._connected.set()
+            backoff = BACKOFF_INITIAL
+            self._reconnect_signal.wait()
+            self._connected.clear()
+            self.reconnect_count += 1
+            for stop in (stop_reader, stop_writer):
+                try:
+                    if stop:
+                        stop()
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._stop.is_set():
+                return
+            if self.time_sleep_fn(self.jitter_fn(backoff)):
+                return
+            backoff = min(backoff * BACKOFF_FACTOR, BACKOFF_MAX)
+
+    def signal_reconnect(self, reason: str = "") -> None:
+        if reason:
+            self.last_connect_error = reason
+        self._reconnect_signal.set()
+
+    def _drain_reader(self) -> None:
+        while True:
+            try:
+                self.reader.get_nowait()
+            except queue.Empty:
+                return
+
+    # -- serve loop (reference: session_serve.go:137) ----------------------
+    def _serve(self) -> None:
+        while not self._stop.is_set():
+            try:
+                frame = self.reader.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                resp = self.dispatch_fn(frame.data)
+            except Exception as e:  # noqa: BLE001
+                logger.exception("request dispatch failed")
+                resp = {"error": str(e)}
+            self.send(Frame(req_id=frame.req_id, data=resp))
+
+    def send(self, frame: Frame) -> bool:
+        try:
+            self.writer.put(frame, timeout=5.0)
+            return True
+        except queue.Full:
+            logger.warning("session writer channel full; dropping frame")
+            return False
+
+    # -- HTTP transport (requests-based; replaced in tests) ----------------
+    def _headers(self, session_type: str) -> Dict[str, str]:
+        h = {
+            HEADER_SESSION_TYPE: session_type,
+            HEADER_MACHINE_ID: self.machine_id,
+            HEADER_VERSION: __version__,
+            "Content-Type": "application/x-ndjson",
+        }
+        if self.token:
+            h[HEADER_TOKEN] = f"Bearer {self.token}"
+        if self.machine_proof:
+            h[HEADER_MACHINE_PROOF] = self.machine_proof
+        return h
+
+    def _http_reader(self, _self) -> Callable[[], None]:
+        """Opens the read stream: requests arriving as ndjson lines."""
+        import requests
+
+        resp = requests.post(
+            f"{self.endpoint}/api/v1/session",
+            headers=self._headers("read"),
+            stream=True,
+            timeout=(10, None),
+        )
+        resp.raise_for_status()
+        stopped = threading.Event()
+
+        def pump():
+            try:
+                for line in resp.iter_lines(decode_unicode=True):
+                    if stopped.is_set() or self._stop.is_set():
+                        return
+                    if not line:
+                        continue
+                    frame = Frame.from_json(line)
+                    if frame is not None:
+                        try:
+                            self.reader.put(frame, timeout=5.0)
+                        except queue.Full:
+                            logger.warning("reader channel full; dropping request")
+                # graceful server-side close is also a disconnect: without a
+                # reconnect the session would look connected but be deaf
+                if not stopped.is_set():
+                    self.signal_reconnect("read stream closed")
+            except Exception as e:  # noqa: BLE001
+                if not stopped.is_set():
+                    self.signal_reconnect(f"read stream: {e}")
+
+        t = threading.Thread(target=pump, name="tpud-session-reader", daemon=True)
+        t.start()
+
+        def stop():
+            stopped.set()
+            resp.close()
+
+        return stop
+
+    def _http_writer(self, _self) -> Callable[[], None]:
+        """Opens the write stream: a chunked POST whose body is produced
+        from the writer queue (reference: io.Pipe up, session.go:525-575)."""
+        import requests
+
+        stopped = threading.Event()
+
+        def body_gen():
+            while not stopped.is_set() and not self._stop.is_set():
+                try:
+                    frame = self.writer.get(timeout=PIPE_INTERVAL)
+                except queue.Empty:
+                    yield b"\n"  # keep-alive blank line each pipe interval
+                    continue
+                yield (frame.to_json() + "\n").encode()
+
+        def run():
+            try:
+                resp = requests.post(
+                    f"{self.endpoint}/api/v1/session",
+                    headers=self._headers("write"),
+                    data=body_gen(),
+                    timeout=(10, None),
+                )
+                resp.raise_for_status()
+                # the POST returning at all means the server ended the
+                # write stream — mute session without a reconnect otherwise
+                if not stopped.is_set():
+                    self.signal_reconnect("write stream closed")
+            except Exception as e:  # noqa: BLE001
+                if not stopped.is_set():
+                    self.signal_reconnect(f"write stream: {e}")
+
+        t = threading.Thread(target=run, name="tpud-session-writer", daemon=True)
+        t.start()
+
+        def stop():
+            stopped.set()
+
+        return stop

@@ -120,6 +120,11 @@ class TPUInstance:
     def tpu_lib_exists(self) -> bool:
         raise NotImplementedError
 
+    def is_mock(self) -> bool:
+        """True when this is the CI fixture backend — components that
+        assert on-disk artifacts (e.g. libtpu.so) skip themselves then."""
+        return False
+
     def init_error(self) -> str:
         return ""
 
@@ -212,6 +217,9 @@ class MockBackend(TPUInstance):
         )
 
     def tpu_lib_exists(self) -> bool:
+        return True
+
+    def is_mock(self) -> bool:
         return True
 
     def product_name(self) -> str:
@@ -499,6 +507,9 @@ class InjectedInstance(TPUInstance):
         if self.injector.tpu_enumeration_error:
             return False
         return self.inner.tpu_lib_exists()
+
+    def is_mock(self) -> bool:
+        return self.inner.is_mock()
 
     def init_error(self) -> str:
         if self.injector.tpu_enumeration_error:

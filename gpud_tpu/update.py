@@ -1,0 +1,95 @@
+"""Self-update via the target-version file.
+
+Reference: pkg/update — ``UpdateTargetVersion`` watches a version file
+(version_file.go:16, polled every 30s at pkg/server/server.go:814-832);
+when the target differs from the running version the daemon exits with a
+dedicated code so systemd/DaemonSet restarts it into the new binary. The
+binary-download path (update.go:19-50, pkg.gpud.dev tarballs + ed25519
+verification — see gpud_tpu/release/distsign.py) is gated behind an
+installer hook since this build ships as a Python package.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+from gpud_tpu.log import audit, get_logger
+from gpud_tpu.version import __version__
+
+logger = get_logger(__name__)
+
+POLL_INTERVAL = 30.0   # reference: server.go:814-832
+EXIT_CODE_UPDATE = 244 # supervisor restarts into the new version
+
+
+def read_target_version(path: str) -> str:
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def write_target_version(path: str, version: str) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(version + "\n")
+    os.replace(tmp, path)
+    audit("set_target_version", version=version)
+
+
+class VersionFileWatcher:
+    def __init__(
+        self,
+        path: str,
+        current_version: str = __version__,
+        on_update: Optional[Callable[[str], None]] = None,
+        interval: float = POLL_INTERVAL,
+    ) -> None:
+        self.path = path
+        self.current_version = current_version
+        self.on_update = on_update or self._default_on_update
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _default_on_update(self, target: str) -> None:
+        logger.warning(
+            "target version %s != running %s; exiting %d for supervisor restart",
+            target, self.current_version, EXIT_CODE_UPDATE,
+        )
+        audit("self_update_exit", target=target, current=self.current_version)
+        os._exit(EXIT_CODE_UPDATE)  # noqa: SLF001 — immediate, like the reference
+
+    def check_once(self) -> bool:
+        """Returns True if an update was triggered."""
+        target = read_target_version(self.path)
+        if target and target != self.current_version:
+            self.on_update(target)
+            return True
+        return False
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="tpud-update-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                if self.check_once():
+                    return
+            except Exception:  # noqa: BLE001
+                logger.exception("update check failed")
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -1,0 +1,147 @@
+"""Dispatcher tests against a live server (reference:
+session_process_request coverage)."""
+
+import base64
+import time
+
+import pytest
+
+from gpud_tpu.config import default_config
+from gpud_tpu.server.server import Server
+from gpud_tpu.session.dispatch import Dispatcher
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("dispatch")
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp / "data"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+    )
+    s = Server(config=cfg)
+    s.start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def dispatch(srv):
+    return Dispatcher(srv)
+
+
+def test_unknown_method(dispatch):
+    assert "unknown method" in dispatch({"method": "nope"})["error"]
+
+
+def test_states(dispatch):
+    out = dispatch({"method": "states"})
+    comps = {s["component"] for s in out["states"]}
+    assert "cpu" in comps
+
+
+def test_states_filtered(dispatch):
+    out = dispatch({"method": "states", "components": ["cpu"]})
+    assert len(out["states"]) == 1
+
+
+def test_events_and_metrics(dispatch, srv):
+    srv.metrics_syncer.sync_once()
+    ev = dispatch({"method": "events"})
+    assert any(c["component"] == "os" for c in ev["events"])
+    ms = dispatch({"method": "metrics"})
+    assert ms["metrics"]
+
+
+def test_set_healthy(dispatch):
+    out = dispatch({"method": "setHealthy", "component": "accelerator-tpu-error-kmsg"})
+    assert out.get("status") == "ok"
+    out = dispatch({"method": "setHealthy", "component": "ghost"})
+    assert "not found" in out["error"]
+
+
+def test_trigger_component(dispatch):
+    out = dispatch({"method": "triggerComponent", "component": "cpu"})
+    assert out["status"] == "triggered"
+    out = dispatch({"method": "triggerComponent", "tag": "tpu"})
+    assert len(out["components"]) >= 4
+
+
+def test_inject_fault(dispatch, srv):
+    out = dispatch(
+        {"method": "injectFault", "tpu_error_name": "tpu_thermal_trip", "chip_id": 1}
+    )
+    assert out.get("status") == "ok"
+    out = dispatch({"method": "injectFault", "tpu_error_name": "bogus"})
+    assert "unknown" in out["error"]
+
+
+def test_bootstrap_script(dispatch):
+    script = base64.b64encode(b"echo bootstrap-ok; exit 0").decode()
+    out = dispatch({"method": "bootstrap", "script_base64": script})
+    assert out["exit_code"] == 0
+    assert "bootstrap-ok" in out["output"]
+    out = dispatch({"method": "bootstrap", "script_base64": "!!!"})
+    assert "invalid base64" in out["error"]
+
+
+def test_update_config(dispatch, srv):
+    out = dispatch(
+        {
+            "method": "updateConfig",
+            "configs": {
+                "expected_chip_count": 4,
+                "ici": {"flap_threshold": 5},
+                "temperature": {"degraded_c": 80.0},
+            },
+        }
+    )
+    assert set(out["updated"]) == {
+        "expected_chip_count", "ici.flap_threshold", "temperature.degraded_c"
+    }
+    assert srv.registry.get("accelerator-tpu-chip-counts").expected_count == 4
+    assert srv.registry.get("accelerator-tpu-ici").flap_threshold == 5
+
+
+def test_token_roundtrip(dispatch, srv):
+    assert dispatch({"method": "updateToken", "token": "tok-9"})["status"] == "ok"
+    assert dispatch({"method": "getToken"})["token"] == "tok-9"
+
+
+def test_reboot_dry(dispatch):
+    calls = []
+    dispatch.reboot_fn = lambda: calls.append(1) or None
+    out = dispatch({"method": "reboot"})
+    assert out["status"] == "rebooting"
+    deadline = time.time() + 2
+    while not calls and time.time() < deadline:
+        time.sleep(0.01)
+    assert calls
+
+
+def test_package_status_empty(dispatch):
+    assert dispatch({"method": "packageStatus"})["packages"] == []
+
+
+def test_update_writes_version_file(dispatch, srv):
+    out = dispatch({"method": "update", "version": "9.9.9"})
+    assert out["status"] == "ok"
+    from gpud_tpu.update import read_target_version
+
+    assert read_target_version(srv.config.target_version_file()) == "9.9.9"
+
+
+def test_gossip(dispatch):
+    out1 = dispatch({"method": "gossip"})
+    assert out1["status"] in ("started", "ok")
+    deadline = time.time() + 3
+    while time.time() < deadline:
+        out2 = dispatch({"method": "gossip"})
+        if out2["status"] == "ok":
+            assert out2["machine_info"]["machine_id"]
+            return
+        time.sleep(0.05)
+    raise AssertionError("gossip never completed")

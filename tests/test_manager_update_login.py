@@ -1,0 +1,178 @@
+import os
+
+from gpud_tpu.api.v1.types import PackagePhase
+from gpud_tpu.login import login, normalize_node_labels
+from gpud_tpu.manager.packages import PackageManager
+from gpud_tpu.metadata import KEY_MACHINE_ID, KEY_TOKEN, Metadata
+from gpud_tpu.nfs_checker import GroupConfig, NFSChecker
+from gpud_tpu.providers.detect import DetectResult, detect_gcp
+from gpud_tpu.update import (
+    VersionFileWatcher,
+    read_target_version,
+    write_target_version,
+)
+
+
+# -- packages ----------------------------------------------------------------
+
+def _mk_pkg(root, name, target="1.0", init_body="echo installed"):
+    d = root / "packages" / name
+    d.mkdir(parents=True)
+    (d / "init.sh").write_text(f"#!/bin/bash\n{init_body}\n")
+    (d / "version").write_text(target)
+    return d
+
+
+def test_package_install_and_status(tmp_path):
+    d = _mk_pkg(tmp_path, "tooling")
+    pm = PackageManager(str(tmp_path / "packages"))
+    assert pm.package_names() == ["tooling"]
+    st = pm.status()[0]
+    assert st.phase == PackagePhase.UNKNOWN and not st.is_installed
+
+    pm.reconcile_once()
+    st = pm.status()[0]
+    assert st.phase == PackagePhase.INSTALLED
+    assert st.current_version == "1.0"
+    assert (d / "installed_version").read_text() == "1.0"
+
+    # version bump → reinstall
+    (d / "version").write_text("2.0")
+    assert pm.status()[0].phase == PackagePhase.UNKNOWN
+    pm.reconcile_once()
+    assert pm.status()[0].current_version == "2.0"
+
+
+def test_package_install_failure_not_marked(tmp_path):
+    _mk_pkg(tmp_path, "broken", init_body="exit 1")
+    pm = PackageManager(str(tmp_path / "packages"))
+    pm.reconcile_once()
+    st = pm.status()[0]
+    assert not st.is_installed
+
+
+def test_package_status_probe(tmp_path):
+    d = _mk_pkg(tmp_path, "svc")
+    (d / "status.sh").write_text("#!/bin/bash\nexit 0\n")
+    pm = PackageManager(str(tmp_path / "packages"))
+    pm.reconcile_once()
+    assert pm.status()[0].status == "running"
+
+
+# -- update watcher ------------------------------------------------------------
+
+def test_version_file_roundtrip(tmp_path):
+    p = str(tmp_path / "target_version")
+    assert read_target_version(p) == ""
+    write_target_version(p, "1.2.3")
+    assert read_target_version(p) == "1.2.3"
+
+
+def test_update_watcher_triggers(tmp_path):
+    p = str(tmp_path / "target_version")
+    fired = []
+    w = VersionFileWatcher(p, current_version="1.0.0", on_update=fired.append)
+    assert w.check_once() is False
+    write_target_version(p, "1.0.0")  # same version → no-op
+    assert w.check_once() is False
+    write_target_version(p, "2.0.0")
+    assert w.check_once() is True
+    assert fired == ["2.0.0"]
+
+
+# -- login ---------------------------------------------------------------------
+
+def test_normalize_node_labels():
+    out = normalize_node_labels({"team": "ml", "user.node.tpud.dev/x": "y"})
+    assert out == {"user.node.tpud.dev/team": "ml", "user.node.tpud.dev/x": "y"}
+
+
+def test_login_persists_identity(tmp_db):
+    md = Metadata(tmp_db)
+    captured = {}
+
+    def fake_post(url, body):
+        captured["url"] = url
+        captured["body"] = body
+        return {"machine_id": "assigned-42", "token": "server-token",
+                "machine_proof": "proof-1"}
+
+    resp = login(
+        "https://cp.example/", "join-token", md,
+        node_labels={"rack": "r1"}, post_fn=fake_post,
+    )
+    assert captured["url"] == "https://cp.example/api/v1/login"
+    assert captured["body"]["token"] == "join-token"
+    assert resp.machine_id == "assigned-42"
+    assert md.get(KEY_MACHINE_ID) == "assigned-42"  # overwrite semantics
+    assert md.get(KEY_TOKEN) == "server-token"
+
+
+def test_login_rejection_raises(tmp_db):
+    md = Metadata(tmp_db)
+
+    def fake_post(url, body):
+        return {"error": "invalid token"}
+
+    try:
+        login("https://cp", "bad", md, post_fn=fake_post)
+        raised = False
+    except RuntimeError as e:
+        raised = "invalid token" in str(e)
+    assert raised
+
+
+# -- nfs checker -----------------------------------------------------------------
+
+def test_nfs_group_two_members(tmp_path):
+    d = str(tmp_path / "group")
+    m1 = NFSChecker("machine-1", [GroupConfig(dir=d, ttl_seconds=60)])
+    m2 = NFSChecker("machine-2", [GroupConfig(dir=d, ttl_seconds=60)])
+    r1 = m1.check_group(m1.configs[0])
+    assert r1.write_ok
+    r2 = m2.check_group(m2.configs[0])
+    assert r2.fresh_members == 2
+    assert {m.machine_id for m in r2.members} == {"machine-1", "machine-2"}
+
+
+def test_nfs_stale_member_detected(tmp_path):
+    d = str(tmp_path / "group")
+    cfg = GroupConfig(dir=d, ttl_seconds=60)
+    m1 = NFSChecker("m1", [cfg])
+    now = [1000.0]
+    m1.time_now_fn = lambda: now[0]
+    m1.check_group(cfg)
+    now[0] += 120  # m1's file is now stale
+    m2 = NFSChecker("m2", [cfg])
+    m2.time_now_fn = lambda: now[0]
+    rep = m2.check_group(cfg)
+    stale = [m for m in rep.members if m.machine_id == "m1"]
+    assert stale and not stale[0].fresh
+
+
+# -- providers --------------------------------------------------------------------
+
+def test_detect_gcp_with_fake_imds():
+    def fake_get(url, headers, timeout=1.0):
+        assert headers == {"Metadata-Flavor": "Google"}
+        if url.endswith("/zone"):
+            return "projects/123/zones/us-central2-b"
+        if url.endswith("/machine-type"):
+            return "projects/123/machineTypes/ct5lp-hightpu-8t"
+        if url.endswith("accelerator-type"):
+            return "v5litepod-8"
+        raise OSError("no such attr")
+
+    r = detect_gcp(get_fn=fake_get)
+    assert r.provider == "gcp"
+    assert r.zone == "us-central2-b"
+    assert r.region == "us-central2"
+    assert r.instance_type == "ct5lp-hightpu-8t"
+    assert r.accelerator_type == "v5litepod-8"
+
+
+def test_detect_gcp_absent():
+    def fake_get(url, headers, timeout=1.0):
+        raise OSError("no route")
+
+    assert detect_gcp(get_fn=fake_get) is None

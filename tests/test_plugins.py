@@ -1,0 +1,162 @@
+import base64
+
+import pytest
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.plugins.component import (
+    PluginComponent,
+    build_components,
+    run_init_plugins,
+)
+from gpud_tpu.plugins.spec import (
+    MatchRule,
+    OutputParser,
+    PluginSpec,
+    PluginStep,
+    extract_path,
+    load_specs,
+    save_specs,
+    specs_from_list,
+)
+
+
+def _spec(**kw):
+    base = dict(
+        name="p1",
+        steps=[PluginStep(name="s1", script="echo hello")],
+    )
+    base.update(kw)
+    return PluginSpec.from_dict(PluginSpec(**base).to_dict())
+
+
+def test_spec_validate():
+    assert _spec().validate() is None
+    assert _spec(name="").validate()
+    assert _spec(name="bad name!").validate()
+    assert _spec(plugin_type="weird").validate()
+    assert _spec(steps=[]).validate()
+    assert _spec(plugin_type="component_list").validate()  # needs list
+
+
+def test_specs_yaml_roundtrip(tmp_path):
+    specs = [
+        _spec(name="a"),
+        _spec(name="b", run_mode="manual", tags=["t1"]),
+    ]
+    p = tmp_path / "plugins.yaml"
+    save_specs(str(p), specs)
+    back = load_specs(str(p))
+    assert [s.name for s in back] == ["a", "b"]
+    assert back[1].run_mode == "manual"
+
+
+def test_specs_duplicate_names_rejected():
+    with pytest.raises(ValueError):
+        specs_from_list([_spec(name="x").to_dict(), _spec(name="x").to_dict()])
+
+
+def test_extract_path():
+    doc = {"a": {"b": [{"c": 42}]}, "top": "v"}
+    assert extract_path(doc, "$.a.b[0].c") == 42
+    assert extract_path(doc, "$.top") == "v"
+    assert extract_path(doc, "$.missing.x") is None
+    assert extract_path(doc, "no-dollar") is None
+
+
+def test_plugin_component_healthy():
+    c = PluginComponent(TpudInstance(), _spec())
+    cr = c.check()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert "hello" in cr.raw_output
+    assert c.can_deregister()
+
+
+def test_plugin_exit_code_contract():
+    spec = _spec(steps=[PluginStep(name="fail", script="echo nope; exit 3")])
+    cr = PluginComponent(TpudInstance(), spec).check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "exited 3" in cr.summary()
+
+
+def test_plugin_base64_step():
+    b64 = base64.b64encode(b"echo from-b64").decode()
+    spec = _spec(steps=[PluginStep(name="b", script_base64=b64)])
+    cr = PluginComponent(TpudInstance(), spec).check()
+    assert "from-b64" in cr.raw_output
+
+
+def test_plugin_json_parser_and_match_rules():
+    spec = _spec(
+        steps=[PluginStep(name="j", script='echo \'{"status": "bad", "count": 5}\'')],
+        parser=OutputParser(
+            json_paths={"status": "$.status", "count": "$.count"},
+            match_rules=[
+                MatchRule(
+                    regex="bad",
+                    field="status",
+                    health="Unhealthy",
+                    suggested_actions=["REBOOT_SYSTEM"],
+                    description="status went bad",
+                )
+            ],
+        ),
+    )
+    cr = PluginComponent(TpudInstance(), spec).check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert cr.extra_info["status"] == "bad"
+    assert cr.extra_info["count"] == "5"
+    assert cr.suggested_actions.repair_actions == ["REBOOT_SYSTEM"]
+
+
+def test_plugin_raw_match_rule():
+    spec = _spec(
+        steps=[PluginStep(name="r", script="echo WARNING something degraded")],
+        parser=OutputParser(
+            match_rules=[MatchRule(regex="WARNING", health="Degraded")]
+        ),
+    )
+    cr = PluginComponent(TpudInstance(), spec).check()
+    assert cr.health_state_type() == HealthStateType.DEGRADED
+
+
+def test_plugin_timeout():
+    spec = _spec(
+        steps=[PluginStep(name="slow", script="sleep 5")],
+        timeout_seconds=0.3,
+    )
+    cr = PluginComponent(TpudInstance(), spec).check()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "timed out" in cr.summary()
+
+
+def test_component_list_fanout():
+    spec = _spec(
+        name="multi",
+        plugin_type="component_list",
+        component_list=["a", "b"],
+        steps=[PluginStep(name="s", script='echo "item=$TPUD_PLUGIN_ITEM"')],
+    )
+    comps = build_components(TpudInstance(), [spec])
+    assert [c.name() for c in comps] == ["multi.a", "multi.b"]
+    cr = comps[1].check()
+    assert "item=b" in cr.raw_output
+
+
+def test_init_plugin_gate():
+    ok = _spec(name="init-ok", plugin_type="init")
+    assert run_init_plugins(TpudInstance(), [ok]) is None
+    bad = _spec(
+        name="init-bad",
+        plugin_type="init",
+        steps=[PluginStep(name="f", script="exit 1")],
+    )
+    err = run_init_plugins(TpudInstance(), [bad])
+    assert err and "init-bad" in err
+
+
+def test_manual_plugin_not_started():
+    spec = _spec(name="man", run_mode="manual")
+    c = PluginComponent(TpudInstance(), spec)
+    c.start()
+    assert c._thread is None  # no poller for manual mode

@@ -1,0 +1,128 @@
+"""Session tests with injectable transports (reference:
+pkg/session/mock_session_test.go, session_reconnect_test.go)."""
+
+import queue
+import threading
+import time
+
+from gpud_tpu.session.session import Frame, Session
+
+
+class LoopbackTransport:
+    """Fake control plane: requests pushed via push(); responses collected."""
+
+    def __init__(self, fail_connects=0):
+        self.responses = []
+        self.fail_connects = fail_connects
+        self.connects = 0
+        self.reader_stops = 0
+        self.writer_stops = 0
+        self._session = None
+
+    def start_reader(self, session):
+        self.connects += 1
+        if self.connects <= self.fail_connects:
+            raise ConnectionError("refused")
+        self._session = session
+
+        def stop():
+            self.reader_stops += 1
+
+        return stop
+
+    def start_writer(self, session):
+        self._drain = threading.Thread(target=self._pump, daemon=True)
+        self._alive = True
+        self._drain.start()
+
+        def stop():
+            self._alive = False
+            self.writer_stops += 1
+
+        return stop
+
+    def _pump(self):
+        while self._alive:
+            try:
+                frame = self._session.writer.get(timeout=0.05)
+                self.responses.append(frame)
+            except queue.Empty:
+                continue
+
+    def push(self, frame):
+        self._session.reader.put(frame)
+
+
+def _mk_session(transport, dispatch=None, **kw):
+    return Session(
+        endpoint="https://cp.example",
+        machine_id="m1",
+        token="t",
+        dispatch_fn=dispatch or (lambda req: {"echo": req}),
+        start_reader_fn=transport.start_reader,
+        start_writer_fn=transport.start_writer,
+        jitter_fn=lambda b: 0.01,
+        **kw,
+    )
+
+
+def _wait(cond, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_request_response_roundtrip():
+    tr = LoopbackTransport()
+    s = _mk_session(tr)
+    s.start()
+    assert _wait(lambda: s.connected)
+    tr.push(Frame(req_id="r1", data={"method": "states"}))
+    assert _wait(lambda: tr.responses)
+    resp = tr.responses[0]
+    assert resp.req_id == "r1"
+    assert resp.data == {"echo": {"method": "states"}}
+    s.stop()
+
+
+def test_dispatch_exception_becomes_error_response():
+    tr = LoopbackTransport()
+
+    def bad_dispatch(req):
+        raise ValueError("kaboom")
+
+    s = _mk_session(tr, dispatch=bad_dispatch)
+    s.start()
+    assert _wait(lambda: s.connected)
+    tr.push(Frame(req_id="r2", data={"method": "x"}))
+    assert _wait(lambda: tr.responses)
+    assert "kaboom" in tr.responses[0].data["error"]
+    s.stop()
+
+
+def test_reconnect_with_backoff():
+    tr = LoopbackTransport(fail_connects=2)
+    s = _mk_session(tr)
+    s.start()
+    assert _wait(lambda: s.connected)
+    assert tr.connects == 3  # two failures then success
+    assert "refused" in s.last_connect_error
+
+    # remote drop → reconnect; old streams stopped
+    s.signal_reconnect("remote closed")
+    assert _wait(lambda: tr.connects == 4)
+    assert _wait(lambda: s.connected)
+    assert s.reconnect_count == 1
+    assert tr.reader_stops >= 1 and tr.writer_stops >= 1
+    s.stop()
+
+
+def test_frame_json_roundtrip():
+    f = Frame(req_id="a", data={"x": 1})
+    back = Frame.from_json(f.to_json())
+    assert back.req_id == "a" and back.data == {"x": 1}
+    assert Frame.from_json("not json") is None
+    assert Frame.from_json('"a string"') is None
