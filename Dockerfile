@@ -1,0 +1,22 @@
+# tpud container image (reference: Dockerfile:1-40 — multi-arch runtime
+# image; the CUDA base becomes a slim Python base since the TPU runtime
+# needs no userspace driver stack in the monitoring container).
+FROM python:3.12-slim
+
+# monitoring tools used by components (lspci, lsmod equivalents)
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends pciutils kmod curl \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /opt/tpud
+COPY pyproject.toml README.md ./
+COPY gpud_tpu ./gpud_tpu
+RUN pip install --no-cache-dir .
+
+# state under a hostPath mount in k8s (see deployments/helm)
+ENV TPUD_DATA_DIR=/var/lib/tpud
+VOLUME ["/var/lib/tpud"]
+
+EXPOSE 15132
+ENTRYPOINT ["python", "-m", "gpud_tpu"]
+CMD ["run"]

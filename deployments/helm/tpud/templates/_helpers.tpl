@@ -1,0 +1,3 @@
+{{- define "tpud.fullname" -}}
+{{- printf "%s" .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}

@@ -52,8 +52,8 @@ class Config:
     scrape_interval_seconds: int = DEFAULT_SCRAPE_INTERVAL
     compact_period_seconds: int = 0      # 0 = disabled (reference default)
     enable_auto_update: bool = True
-    endpoint: str = ""                   # control-plane endpoint
-    token: str = ""
+    endpoint: str = ""                   # control-plane endpoint (or TPUD_ENDPOINT)
+    token: str = ""                      # join/session token (or TPUD_TOKEN)
     machine_id: str = ""
     components_enabled: List[str] = field(default_factory=list)   # empty = all
     components_disabled: List[str] = field(default_factory=list)
@@ -108,6 +108,10 @@ class Config:
 
 def default_config(**overrides) -> Config:
     cfg = Config()
+    # env-based enrollment for containerized deploys (the Helm chart
+    # injects TPUD_TOKEN from a Secret and TPUD_ENDPOINT from values)
+    cfg.endpoint = os.environ.get("TPUD_ENDPOINT", "")
+    cfg.token = os.environ.get("TPUD_TOKEN", "")
     for k, v in overrides.items():
         if not hasattr(cfg, k):
             raise AttributeError(f"unknown config field: {k}")

@@ -30,6 +30,14 @@ from gpud_tpu.log import get_logger
 
 logger = get_logger(__name__)
 
+# native fast path (optional)
+try:
+    from gpud_tpu.native import available as _native_available, parse_kmsg
+
+    _native_parse = parse_kmsg if _native_available() else None
+except Exception:  # noqa: BLE001 — native is never required
+    _native_parse = None
+
 DEFAULT_KMSG_PATH = "/dev/kmsg"
 ENV_KMSG_PATH = "TPUD_KMSG_FILE_PATH"
 
@@ -66,10 +74,26 @@ class Message:
 
 
 def parse_line(line: str, boot_unix: float = 0.0) -> Optional[Message]:
-    """Parse one /dev/kmsg record line; None for continuation/garbage lines."""
+    """Parse one /dev/kmsg record line; None for continuation/garbage lines.
+
+    Uses the native C++ parser when built (native/tpud_native.cpp, loaded
+    via gpud_tpu.native); the Python path below is the reference
+    implementation and the fallback.
+    """
     if not line or line.startswith(" "):
         return None
     line = line.rstrip("\n")
+    if _native_parse is not None:
+        parsed = _native_parse(line)
+        if parsed is None:
+            return None
+        prio, fac, seq, ts_us, msg = parsed
+        m = Message(
+            priority=prio, facility=fac, sequence=seq,
+            timestamp_us=ts_us, message=msg, raw=line,
+        )
+        m.time = boot_unix + ts_us / 1e6 if boot_unix > 0 else time.time()
+        return m
     head, sep, msg = line.partition(";")
     if not sep:
         return None

@@ -62,7 +62,9 @@ class PackageManager:
         return out
 
     # -- status ------------------------------------------------------------
-    def status(self) -> List[PackageStatus]:
+    def status(self, probe: bool = True) -> List[PackageStatus]:
+        """``probe=False`` skips status.sh subprocesses for callers on
+        latency-sensitive paths (the session serve loop)."""
         out = []
         for name in self.package_names():
             d = os.path.join(self.packages_dir, name)
@@ -83,7 +85,7 @@ class PackageManager:
                 PackageStatus(
                     name=name,
                     phase=phase,
-                    status="running" if self._probe(d) else "",
+                    status="running" if (probe and self._probe(d)) else "",
                     current_version=current,
                     target_version=target,
                     progress=100 if phase == PackagePhase.INSTALLED else progress,

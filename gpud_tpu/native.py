@@ -1,0 +1,169 @@
+"""Loader for the native C++ hot-path library (native/tpud_native.cpp).
+
+The native library is strictly a fast path: every entry point has a
+pure-Python twin with identical semantics (kmsg/watcher.parse_line,
+kmsg/deduper.Deduper, components/tpu/ici_store.scan), and tests assert
+parity. Binding is ctypes over a C ABI (pybind11 is not in the image).
+
+Search order: ``TPUD_NATIVE_LIB`` env → ``<repo>/native/libtpud_native.so``
+→ system loader. Absence is fine.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Optional, Tuple
+
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+
+class _KmsgRec(ctypes.Structure):
+    _fields_ = [
+        ("priority", ctypes.c_int32),
+        ("facility", ctypes.c_int32),
+        ("sequence", ctypes.c_int64),
+        ("ts_us", ctypes.c_int64),
+        ("msg_offset", ctypes.c_int32),
+    ]
+
+
+class _LinkScan(ctypes.Structure):
+    _fields_ = [
+        ("drops", ctypes.c_int32),
+        ("flaps", ctypes.c_int32),
+        ("currently_down", ctypes.c_int32),
+        ("samples", ctypes.c_int32),
+        ("counter_delta", ctypes.c_int64),
+    ]
+
+
+def _candidates() -> List[str]:
+    out = []
+    env = os.environ.get("TPUD_NATIVE_LIB", "")
+    if env:
+        out.append(env)
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out.append(os.path.join(here, "native", "libtpud_native.so"))
+    out.append(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "libtpud_native.so"))
+    out.append("libtpud_native.so")
+    return out
+
+
+def load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    for path in _candidates():
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            continue
+        try:
+            lib.tpud_parse_kmsg.restype = ctypes.c_int
+            lib.tpud_parse_kmsg.argtypes = [
+                ctypes.c_char_p, ctypes.POINTER(_KmsgRec)
+            ]
+            lib.tpud_scan_links_ragged.restype = None
+            lib.tpud_scan_links_ragged.argtypes = [
+                ctypes.POINTER(ctypes.c_int8),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int32),
+                ctypes.c_int32,
+                ctypes.POINTER(_LinkScan),
+            ]
+            lib.tpud_deduper_new.restype = ctypes.c_void_p
+            lib.tpud_deduper_new.argtypes = [ctypes.c_double, ctypes.c_int64]
+            lib.tpud_deduper_free.argtypes = [ctypes.c_void_p]
+            lib.tpud_deduper_seen.restype = ctypes.c_int
+            lib.tpud_deduper_seen.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_double
+            ]
+            lib.tpud_deduper_len.restype = ctypes.c_int64
+            lib.tpud_deduper_len.argtypes = [ctypes.c_void_p]
+        except AttributeError:
+            continue
+        _LIB = lib
+        logger.info("native library loaded from %s", path)
+        return _LIB
+    return None
+
+
+def available() -> bool:
+    return load() is not None
+
+
+# -- typed wrappers -----------------------------------------------------------
+
+def parse_kmsg(line: str) -> Optional[Tuple[int, int, int, int, str]]:
+    """Returns (priority, facility, sequence, ts_us, message) or None."""
+    lib = load()
+    if lib is None:
+        return None
+    raw = line.encode("utf-8", "replace")
+    rec = _KmsgRec()
+    if not lib.tpud_parse_kmsg(raw, ctypes.byref(rec)):
+        return None
+    return (
+        rec.priority,
+        rec.facility,
+        rec.sequence,
+        rec.ts_us,
+        raw[rec.msg_offset:].decode("utf-8", "replace"),
+    )
+
+
+def scan_links_ragged(states: List[int], counters: List[int],
+                      offsets: List[int]) -> Optional[List[dict]]:
+    """Scan packed per-link sequences. Returns per-link dicts or None when
+    the native library is absent."""
+    lib = load()
+    if lib is None:
+        return None
+    n_links = len(offsets) - 1
+    st = (ctypes.c_int8 * len(states))(*states)
+    ct = (ctypes.c_int64 * len(counters))(*counters)
+    off = (ctypes.c_int32 * len(offsets))(*offsets)
+    out = (_LinkScan * n_links)()
+    lib.tpud_scan_links_ragged(st, ct, off, n_links, out)
+    return [
+        {
+            "drops": r.drops,
+            "flaps": r.flaps,
+            "currently_down": bool(r.currently_down),
+            "samples": r.samples,
+            "counter_delta": r.counter_delta,
+        }
+        for r in out
+    ]
+
+
+class NativeDeduper:
+    """ctypes wrapper over the C++ TTL cache; drop-in for kmsg.Deduper's
+    seen_before contract (key = message+second bucket)."""
+
+    def __init__(self, ttl_seconds: float, max_entries: int) -> None:
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library not available")
+        self._lib = lib
+        self._h = lib.tpud_deduper_new(ttl_seconds, max_entries)
+
+    def seen(self, key: str, now: float) -> bool:
+        return bool(self._lib.tpud_deduper_seen(self._h, key.encode(), now))
+
+    def __len__(self) -> int:
+        return int(self._lib.tpud_deduper_len(self._h))
+
+    def __del__(self) -> None:
+        try:
+            self._lib.tpud_deduper_free(self._h)
+        except Exception:  # noqa: BLE001
+            pass

@@ -41,7 +41,12 @@ class Dispatcher:
     def __init__(self, server: "Server") -> None:
         self.server = server
         self.reboot_fn: Callable = pkghost.reboot
-        self.exit_fn: Callable[[int], None] = None  # set by server run loop
+        # restart-by-exit-code: the supervisor (systemd Restart=always,
+        # SuccessExitStatus=244 245) brings us back with the new specs
+        import os as _os
+
+        self.exit_fn: Callable[[int], None] = _os._exit  # noqa: SLF001
+        self._gossip_inflight = threading.Event()
 
     def __call__(self, req: Dict) -> Dict:
         method = req.get("method", "")
@@ -102,8 +107,14 @@ class Dispatcher:
                 self.server.last_gossip = mi.to_dict()
             except Exception:  # noqa: BLE001
                 logger.exception("gossip failed")
+            finally:
+                self._gossip_inflight.clear()
 
-        threading.Thread(target=work, daemon=True).start()
+        # in-flight guard: when machine-info hangs (NFS stat), re-polls
+        # must not stack additional stuck threads
+        if not self._gossip_inflight.is_set():
+            self._gossip_inflight.set()
+            threading.Thread(target=work, daemon=True).start()
         if getattr(self.server, "last_gossip", None):
             result["machine_info"] = self.server.last_gossip
             result["status"] = "ok"
@@ -242,8 +253,14 @@ class Dispatcher:
     def _m_packageStatus(self, req: Dict) -> Dict:
         if self.server.package_manager is None:
             return {"packages": []}
+        # probe=False: status.sh probes are subprocesses (30s timeout each)
+        # and this runs on the session serve loop — same slow-op rule as
+        # gossip/triggerComponent
         return {
-            "packages": [s.to_dict() for s in self.server.package_manager.status()]
+            "packages": [
+                s.to_dict()
+                for s in self.server.package_manager.status(probe=False)
+            ]
         }
 
     def _m_update(self, req: Dict) -> Dict:

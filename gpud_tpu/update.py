@@ -22,6 +22,9 @@ logger = get_logger(__name__)
 
 POLL_INTERVAL = 30.0   # reference: server.go:814-832
 EXIT_CODE_UPDATE = 244 # supervisor restarts into the new version
+# script invoked with TARGET_VERSION env to install the new version before
+# the restart-exit (the reference's tarball-download step, update.go:19-50)
+ENV_UPDATE_HOOK = "TPUD_UPDATE_HOOK"
 
 
 def read_target_version(path: str) -> str:
@@ -57,9 +60,33 @@ class VersionFileWatcher:
         self._thread: Optional[threading.Thread] = None
 
     def _default_on_update(self, target: str) -> None:
+        """Install via the update hook, then restart-exit. Without a hook
+        (or on hook failure) we must NOT exit: the restarted process would
+        still be the old version and see the same mismatch — a permanent
+        30-second crash loop on every node the update was pushed to."""
+        hook = os.environ.get(ENV_UPDATE_HOOK, "")
+        if not hook:
+            if not getattr(self, "_warned_no_hook", False):
+                logger.warning(
+                    "target version %s != running %s but %s is not set; "
+                    "staying on the current version",
+                    target, self.current_version, ENV_UPDATE_HOOK,
+                )
+                self._warned_no_hook = True
+            return
+        from gpud_tpu.process import run_command
+
+        r = run_command(
+            ["bash", hook], timeout=15 * 60.0, env={"TARGET_VERSION": target}
+        )
+        if r.exit_code != 0:
+            logger.error(
+                "update hook failed (exit %d): %s", r.exit_code, r.output[-500:]
+            )
+            return
         logger.warning(
-            "target version %s != running %s; exiting %d for supervisor restart",
-            target, self.current_version, EXIT_CODE_UPDATE,
+            "update hook installed %s; exiting %d for supervisor restart",
+            target, EXIT_CODE_UPDATE,
         )
         audit("self_update_exit", target=target, current=self.current_version)
         os._exit(EXIT_CODE_UPDATE)  # noqa: SLF001 — immediate, like the reference

@@ -1,0 +1,60 @@
+#!/bin/sh
+# tpud installer (reference: install.sh:1-30 — tailscale-style
+# version-aware installer). Installs the gpud_tpu package into a private
+# venv and enrolls the node via `tpud up`.
+set -eu
+
+TPUD_VERSION="${TPUD_VERSION:-latest}"
+TPUD_HOME="${TPUD_HOME:-/opt/tpud}"
+TPUD_PKG_URL="${TPUD_PKG_URL:-https://pkg.tpud.dev/releases}"
+TPUD_SIGNING_PUB="${TPUD_SIGNING_PUB:-}"
+
+main() {
+    if [ "$(id -u)" != "0" ]; then
+        echo "tpud install requires root" >&2
+        exit 1
+    fi
+    if ! command -v python3 >/dev/null 2>&1; then
+        echo "python3 is required" >&2
+        exit 1
+    fi
+
+    echo "installing tpud ${TPUD_VERSION} into ${TPUD_HOME}"
+    mkdir -p "${TPUD_HOME}"
+    python3 -m venv "${TPUD_HOME}/venv"
+
+    if [ -f "./gpud_tpu/__init__.py" ]; then
+        # local checkout install
+        "${TPUD_HOME}/venv/bin/pip" install -q -e .
+    else
+        pkg="tpud-${TPUD_VERSION}.tar.gz"
+        echo "fetching ${TPUD_PKG_URL}/${pkg}"
+        curl -fsSL -o "/tmp/${pkg}" "${TPUD_PKG_URL}/${pkg}"
+        if [ -n "${TPUD_SIGNING_PUB}" ]; then
+            # verify BEFORE installing, with system tools only (the venv
+            # has no gpud_tpu yet): signature = ed25519 over sha512(pkg)
+            curl -fsSL -o "/tmp/${pkg}.sig" "${TPUD_PKG_URL}/${pkg}.sig"
+            python3 -c "import hashlib,sys; \
+sys.stdout.buffer.write(hashlib.sha512(open('/tmp/${pkg}','rb').read()).digest())" \
+                > "/tmp/${pkg}.digest"
+            openssl pkeyutl -verify -pubin -inkey "${TPUD_SIGNING_PUB}" \
+                -rawin -in "/tmp/${pkg}.digest" -sigfile "/tmp/${pkg}.sig" \
+                || { echo "signature verification failed" >&2; exit 1; }
+        fi
+        "${TPUD_HOME}/venv/bin/pip" install -q "/tmp/${pkg}"
+    fi
+
+    ln -sf "${TPUD_HOME}/venv/bin/tpud" /usr/local/bin/tpud 2>/dev/null || true
+
+    # enroll + start (systemd)
+    if [ -n "${TPUD_TOKEN:-}" ] && [ -n "${TPUD_ENDPOINT:-}" ]; then
+        "${TPUD_HOME}/venv/bin/python" -m gpud_tpu up \
+            --token "${TPUD_TOKEN}" --endpoint "${TPUD_ENDPOINT}"
+    else
+        "${TPUD_HOME}/venv/bin/python" -m gpud_tpu up || true
+        echo "enroll later with: tpud up --token <t> --endpoint <url>"
+    fi
+    echo "tpud installed."
+}
+
+main "$@"

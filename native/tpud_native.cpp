@@ -1,0 +1,155 @@
+// tpud native hot paths.
+//
+// The reference daemon's only native boundaries are its accelerator
+// library binding and SQLite (SURVEY §2.7); this library plays the same
+// role for tpud's hot loops:
+//   1. kmsg record parsing — runs on every kernel log line on every node
+//      (reference hot loop #2, SURVEY §3.1),
+//   2. the ICI link window scan — every poll walks up to 14 days of
+//      per-link snapshots (reference: infiniband store Scan),
+//   3. a TTL dedup cache for kmsg-derived events (pkg/kmsg/deduper.go).
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in the
+// image); gpud_tpu/native.py holds the loader and the pure-Python
+// fallback contract: identical results, native is only a fast path.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// 1. kmsg record parser
+//    format: "<prefix>,<seq>,<usec>,<flags>[,...];<message>"
+//    returns 1 on success, 0 on continuation/garbage lines.
+// ---------------------------------------------------------------------------
+
+typedef struct {
+  int32_t priority;
+  int32_t facility;
+  int64_t sequence;
+  int64_t ts_us;
+  int32_t msg_offset;  // byte offset of the message within the line
+} tpud_kmsg_rec;
+
+int tpud_parse_kmsg(const char* line, tpud_kmsg_rec* out) {
+  if (!line || !out) return 0;
+  if (line[0] == ' ' || line[0] == '\0') return 0;  // continuation line
+
+  const char* p = line;
+  char* end = nullptr;
+  long long prefix = strtoll(p, &end, 10);
+  if (end == p || *end != ',') return 0;
+  p = end + 1;
+  long long seq = strtoll(p, &end, 10);
+  if (end == p || *end != ',') return 0;
+  p = end + 1;
+  long long ts = strtoll(p, &end, 10);
+  if (end == p) return 0;
+  const char* semi = strchr(end, ';');
+  if (!semi) return 0;
+
+  out->priority = static_cast<int32_t>(prefix & 7);
+  out->facility = static_cast<int32_t>(prefix >> 3);
+  out->sequence = seq;
+  out->ts_us = ts;
+  out->msg_offset = static_cast<int32_t>(semi - line + 1);
+  return 1;
+}
+
+// ---------------------------------------------------------------------------
+// 2. ICI ragged window scan
+//    per link l, samples live in [offsets[l], offsets[l+1]) in time order.
+//    Semantics match ICIStore.scan: consecutive-sample transitions,
+//    positive counter steps only (reset-safe).
+// ---------------------------------------------------------------------------
+
+typedef struct {
+  int32_t drops;
+  int32_t flaps;
+  int32_t currently_down;
+  int32_t samples;
+  int64_t counter_delta;
+} tpud_link_scan;
+
+void tpud_scan_links_ragged(const int8_t* states, const int64_t* counters,
+                            const int32_t* offsets, int32_t n_links,
+                            tpud_link_scan* out) {
+  for (int32_t l = 0; l < n_links; ++l) {
+    tpud_link_scan r;
+    r.drops = 0;
+    r.flaps = 0;
+    r.currently_down = 0;
+    r.samples = 0;
+    r.counter_delta = 0;
+    int32_t lo = offsets[l], hi = offsets[l + 1];
+    int8_t prev_state = -1;
+    int64_t prev_counter = -1;
+    for (int32_t i = lo; i < hi; ++i) {
+      int8_t s = states[i];
+      int64_t c = counters[i];
+      r.samples++;
+      if (prev_state != -1) {
+        if (prev_state == 1 && s == 0) r.drops++;
+        if (prev_state == 0 && s == 1) r.flaps++;
+      }
+      if (prev_counter != -1 && c > prev_counter) {
+        r.counter_delta += c - prev_counter;
+      }
+      prev_state = s;
+      prev_counter = c;
+      r.currently_down = (s == 0) ? 1 : 0;
+    }
+    out[l] = r;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// 3. TTL dedup cache (string key → expiry), bounded size with coarse
+//    eviction — mirrors gpud_tpu/kmsg/deduper.py semantics.
+// ---------------------------------------------------------------------------
+
+struct TpudDeduper {
+  std::unordered_map<std::string, double> seen;
+  double ttl;
+  size_t max_entries;
+};
+
+void* tpud_deduper_new(double ttl_seconds, int64_t max_entries) {
+  auto* d = new TpudDeduper();
+  d->ttl = ttl_seconds;
+  d->max_entries = static_cast<size_t>(max_entries);
+  return d;
+}
+
+void tpud_deduper_free(void* handle) {
+  delete static_cast<TpudDeduper*>(handle);
+}
+
+// returns 1 if already seen (within TTL), 0 otherwise (and records it)
+int tpud_deduper_seen(void* handle, const char* key, double now) {
+  auto* d = static_cast<TpudDeduper*>(handle);
+  auto it = d->seen.find(key);
+  if (it != d->seen.end() && it->second > now) return 1;
+  if (d->seen.size() >= d->max_entries) {
+    // coarse eviction: drop expired entries; if still over, clear
+    for (auto i = d->seen.begin(); i != d->seen.end();) {
+      if (i->second <= now)
+        i = d->seen.erase(i);
+      else
+        ++i;
+    }
+    if (d->seen.size() >= d->max_entries) d->seen.clear();
+  }
+  d->seen[key] = now + d->ttl;
+  return 0;
+}
+
+int64_t tpud_deduper_len(void* handle) {
+  return static_cast<int64_t>(static_cast<TpudDeduper*>(handle)->seen.size());
+}
+
+}  // extern "C"

@@ -1,0 +1,121 @@
+"""Native C++ fast-path parity tests: every native entry point must agree
+with its pure-Python twin (the contract in gpud_tpu/native.py)."""
+
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from gpud_tpu import native
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_lib():
+    so = REPO / "native" / "libtpud_native.so"
+    if not so.exists():
+        r = subprocess.run(["make", "-C", str(REPO / "native")], capture_output=True)
+        if r.returncode != 0:
+            pytest.skip(f"native build failed: {r.stderr.decode()[:200]}")
+    if not native.available():
+        pytest.skip("native library not loadable")
+
+
+def test_parse_kmsg_parity():
+    from gpud_tpu.kmsg.watcher import Message
+
+    cases = [
+        "6,1234,5678901,-;hello world",
+        "26,1,10,-;msg;with;semis",
+        "3,99,0,c;x",
+    ]
+    for line in cases:
+        got = native.parse_kmsg(line)
+        assert got is not None, line
+        prio, fac, seq, ts_us, msg = got
+        # python reference parse
+        head, _, pmsg = line.partition(";")
+        parts = head.split(",")
+        assert prio == int(parts[0]) & 7
+        assert fac == int(parts[0]) >> 3
+        assert seq == int(parts[1])
+        assert ts_us == int(parts[2])
+        assert msg == pmsg
+
+
+def test_parse_kmsg_rejects_garbage():
+    for bad in (" SUBSYSTEM=pci", "no-separator", "a,b,c;x", ""):
+        assert native.parse_kmsg(bad) is None, bad
+
+
+def test_parse_line_uses_native_and_matches():
+    from gpud_tpu.kmsg import watcher
+
+    m = watcher.parse_line("6,42,1000000,-;native path", boot_unix=100.0)
+    assert m.priority == 6 and m.sequence == 42
+    assert m.message == "native path"
+    assert abs(m.time - 101.0) < 1e-6
+
+
+def test_scan_links_ragged_parity(tmp_db):
+    """Native scan must agree with ICIStore.scan on the same history."""
+    from gpud_tpu.components.tpu.ici_store import ICIStore
+    from gpud_tpu.tpu.instance import ICILinkSnapshot, LinkState
+
+    store = ICIStore(tmp_db)
+    store.time_now_fn = lambda: 1000.0
+
+    def links(down, crc):
+        return [
+            ICILinkSnapshot(
+                chip_id=0, link_id=i,
+                state=LinkState.DOWN if i in down else LinkState.UP,
+                crc_errors=crc + i,
+            )
+            for i in range(3)
+        ]
+
+    store.insert_snapshot(links(set(), 0), ts=900)
+    store.insert_snapshot(links({1}, 10), ts=920)
+    store.insert_snapshot(links(set(), 20), ts=940)
+    store.insert_snapshot(links({2}, 25), ts=960)
+    py = store.scan(200.0)
+
+    # pack the same history for the native scan (crc counter only)
+    states, counters, offsets = [], [], [0]
+    names = sorted(py.links)
+    rows = {
+        name: [] for name in names
+    }
+    for name in names:
+        data = tmp_db.query(
+            "SELECT state, crc_errors FROM tpud_ici_snapshots_v0_1 "
+            "WHERE link=? ORDER BY ts", (name,),
+        )
+        for st, crc in data:
+            states.append(st)
+            counters.append(crc)
+        offsets.append(len(states))
+    res = native.scan_links_ragged(states, counters, offsets)
+    assert res is not None
+    for i, name in enumerate(names):
+        assert res[i]["drops"] == py.links[name].drops, name
+        assert res[i]["flaps"] == py.links[name].flaps, name
+        assert res[i]["currently_down"] == py.links[name].currently_down, name
+        assert res[i]["counter_delta"] == py.links[name].crc_delta, name
+
+
+def test_native_deduper_parity():
+    nd = native.NativeDeduper(ttl_seconds=10.0, max_entries=100)
+    assert nd.seen("k1", 1000.0) is False
+    assert nd.seen("k1", 1005.0) is True
+    assert nd.seen("k1", 1011.0) is False  # TTL expired
+    assert len(nd) >= 1
+
+
+def test_native_deduper_eviction():
+    nd = native.NativeDeduper(ttl_seconds=1e9, max_entries=16)
+    for i in range(100):
+        nd.seen(f"k{i}", float(i))
+    assert len(nd) <= 17

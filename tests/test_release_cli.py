@@ -1,0 +1,90 @@
+from gpud_tpu.cli import main
+from gpud_tpu.release import distsign
+
+
+def test_distsign_chain(tmp_path):
+    root_priv, root_pub = distsign.write_keypair(str(tmp_path), "root")
+    sign_priv, sign_pub = distsign.write_keypair(str(tmp_path), "signing")
+    key_sig = distsign.sign_key(root_priv, sign_pub)
+    assert distsign.verify_key(root_pub, sign_pub, key_sig)
+
+    pkg = tmp_path / "tpud-1.0.tar.gz"
+    pkg.write_bytes(b"fake package bytes" * 1000)
+    sig = distsign.sign_package(sign_priv, str(pkg))
+
+    # full-chain verify
+    assert distsign.verify_package(
+        sign_pub, str(pkg), sig_path=sig,
+        root_pub_path=root_pub, key_sig_path=key_sig,
+    ) is None
+
+    # tampered package fails
+    pkg.write_bytes(b"tampered")
+    assert distsign.verify_package(sign_pub, str(pkg), sig_path=sig) is not None
+
+
+def test_distsign_wrong_key(tmp_path):
+    _, pub_a = distsign.write_keypair(str(tmp_path), "a")
+    priv_b, _ = distsign.write_keypair(str(tmp_path), "b")
+    pkg = tmp_path / "p.tar.gz"
+    pkg.write_bytes(b"data")
+    sig = distsign.sign_package(priv_b, str(pkg))
+    assert distsign.verify_package(pub_a, str(pkg), sig_path=sig) is not None
+
+
+def test_cli_release_flow(tmp_path, capsys):
+    d = str(tmp_path)
+    assert main(["release", "gen-root-key", "--dir", d]) == 0
+    assert main(["release", "gen-signing-key", "--dir", d]) == 0
+    assert main(["release", "sign-key", "--root-key", f"{d}/root.key",
+                 "--signing-pub", f"{d}/signing.pub"]) == 0
+    pkg = tmp_path / "pkg.tar.gz"
+    pkg.write_bytes(b"x" * 100)
+    assert main(["release", "sign-package", "--signing-key", f"{d}/signing.key",
+                 "--package", str(pkg)]) == 0
+    assert main(["release", "verify-package", "--signing-pub", f"{d}/signing.pub",
+                 "--package", str(pkg)]) == 0
+    pkg.write_bytes(b"tampered")
+    assert main(["release", "verify-package", "--signing-pub", f"{d}/signing.pub",
+                 "--package", str(pkg)]) == 1
+
+
+def test_cli_update_check_and_set(tmp_path, capsys):
+    assert main(["update", "--data-dir", str(tmp_path), "--check"]) == 0
+    assert "(none)" in capsys.readouterr().out
+    assert main(["update", "--data-dir", str(tmp_path),
+                 "--target-version", "2.0.0"]) == 0
+    assert main(["update", "--data-dir", str(tmp_path), "--check"]) == 0
+    assert "2.0.0" in capsys.readouterr().out
+
+
+def test_cli_custom_plugins_validate(tmp_path, capsys):
+    good = tmp_path / "good.yaml"
+    good.write_text(
+        "- name: ok\n  steps:\n    - name: s\n      script: echo hi\n"
+    )
+    assert main(["custom-plugins", str(good)]) == 0
+    bad = tmp_path / "bad.yaml"
+    bad.write_text("- name: 'bad name!'\n  steps: []\n")
+    assert main(["custom-plugins", str(bad)]) == 1
+
+
+def test_cli_run_plugin_group(tmp_path, capsys):
+    f = tmp_path / "p.yaml"
+    f.write_text(
+        "- name: g1\n  tags: [grp]\n  steps:\n    - {name: s, script: echo ok}\n"
+        "- name: g2\n  tags: [grp]\n  steps:\n    - {name: s, script: exit 1}\n"
+    )
+    rc = main(["run-plugin-group", str(f), "--tag", "grp"])
+    out = capsys.readouterr().out
+    assert rc == 1  # g2 fails
+    assert "✔ g1" in out and "✘ g2" in out
+
+
+def test_cli_notify(tmp_path, capsys):
+    assert main(["notify", "startup", "--data-dir", str(tmp_path)]) == 0
+    from gpud_tpu.eventstore import EventStore
+    from gpud_tpu.sqlite import DB
+
+    es = EventStore(DB(str(tmp_path / "tpud.state")))
+    assert any(e.name == "daemon_startup" for e in es.bucket("os").get(0))
