@@ -26,6 +26,10 @@ from gpud_tpu.components.tpu.error_kmsg import TPUErrorKmsgComponent
 from gpud_tpu.components.tpu.hbm import TPUHbmComponent
 from gpud_tpu.components.tpu.ici import TPUICIComponent
 from gpud_tpu.components.tpu.power import TPUPowerComponent
+from gpud_tpu.components.tpu.runtime import (
+    TPUProcessesComponent,
+    TPURuntimeComponent,
+)
 from gpud_tpu.components.tpu.temperature import TPUTemperatureComponent
 
 
@@ -51,5 +55,7 @@ def all_components() -> List[InitFunc]:
         TPUHbmComponent,
         TPUPowerComponent,
         TPUICIComponent,
+        TPURuntimeComponent,
+        TPUProcessesComponent,
         TPUErrorKmsgComponent,
     ]

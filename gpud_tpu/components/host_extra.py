@@ -27,7 +27,6 @@ import glob
 import json
 import os
 import socket
-import time
 from typing import List, Optional
 
 from gpud_tpu.api.v1.types import HealthStateType
@@ -160,30 +159,21 @@ class NetworkLatencyComponent(PollingComponent):
     NAME = "network-latency"
     TAGS = ["host", "network"]
 
-    DEFAULT_TARGETS = [("metadata.google.internal", 80), ("8.8.8.8", 53)]
     DEGRADED_MS = 250.0
 
     def __init__(self, instance: TpudInstance) -> None:
         super().__init__(instance)
-        self.targets = list(self.DEFAULT_TARGETS)
-        self.connect_fn = self._tcp_rtt
+        from gpud_tpu import netutil
 
-    @staticmethod
-    def _tcp_rtt(host: str, port: int, timeout: float = 2.0) -> Optional[float]:
-        t0 = time.perf_counter()
-        try:
-            with socket.create_connection((host, port), timeout=timeout):
-                return (time.perf_counter() - t0) * 1000.0
-        except OSError:
-            return None
+        self.edges = list(netutil.DEFAULT_EDGES)
+        self.measure_fn = lambda: netutil.measure_edges(self.edges)
 
     def check_once(self) -> CheckResult:
         rtts = {}
-        for host, port in self.targets:
-            rtt = self.connect_fn(host, port)
+        for name, rtt in self.measure_fn().items():
             if rtt is not None:
-                rtts[f"{host}:{port}"] = rtt
-                _g_latency.set(rtt, {"component": self.NAME, "target": host})
+                rtts[name] = rtt
+                _g_latency.set(rtt, {"component": self.NAME, "target": name})
         if not rtts:
             return CheckResult(
                 self.NAME,

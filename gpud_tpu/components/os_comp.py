@@ -56,8 +56,34 @@ class OSComponent(PollingComponent):
         self._event_bucket = (
             instance.event_store.bucket(NAME) if instance.event_store else None
         )
+        # pstore crash attribution: a dump appearing after a reboot means
+        # the reboot was a kernel panic (reference: components/os + pkg/pstore)
+        self._pstore_history = None
+        if instance.db_rw is not None:
+            from gpud_tpu.pstore import PstoreHistory
+
+            self._pstore_history = PstoreHistory(instance.db_rw)
+
+    def _check_pstore(self) -> None:
+        if self._pstore_history is None or self._event_bucket is None:
+            return
+        from gpud_tpu.api.v1.types import Event, EventType
+        from gpud_tpu.pstore import read_crash_files
+
+        fresh = self._pstore_history.record_new(read_crash_files())
+        for rec in fresh:
+            self._event_bucket.insert(
+                Event(
+                    component=NAME,
+                    time=rec.mtime,
+                    name="kernel_crash_dump",
+                    type=EventType.FATAL,
+                    message=f"pstore {rec.kind} dump {rec.path}: {rec.excerpt[:300]}",
+                )
+            )
 
     def check_once(self) -> CheckResult:
+        self._check_pstore()
         alloc, limit = self.get_file_nr_fn()
         up = self.get_uptime_fn()
         _g_fds_alloc.set(alloc, LABELS)

@@ -1,0 +1,177 @@
+"""TPU runtime/fabric service component — the fabric-manager analog.
+
+Reference: components/accelerator/nvidia/fabric-manager (1545 LoC) —
+nvidia-fabricmanager.service activeness + arch-dependent strategy
+selection (H100-SXM vs GB200 vs PCIe). TPU translation: the per-host
+runtime services that keep a slice's fabric usable — the TPU runtime
+(tpu-runtime / libtpu grpc server on TPU-VM images) and, for multi-slice,
+the megascale DCN transport — health-checked by systemd activeness and
+local port probes; single-host generations skip fabric checks the way the
+reference skips non-NVSwitch parts.
+
+Also covers components/accelerator/nvidia/processes (661): which
+processes hold the TPU device nodes (a training job crash can leave a
+zombie holding /dev/accel*, blocking the next job).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List
+
+from gpud_tpu.api.v1.types import (
+    HealthStateType,
+    RepairActionType,
+    SuggestedActions,
+)
+from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
+from gpud_tpu.metrics.registry import gauge
+from gpud_tpu.process import run_command
+
+RUNTIME_NAME = "accelerator-tpu-runtime"
+PROCESSES_NAME = "accelerator-tpu-processes"
+
+_g_holders = gauge("tpud_tpu_device_holder_processes", "processes holding TPU devices")
+
+# services probed when present; absence is fine (GKE images differ)
+RUNTIME_UNITS = ("tpu-runtime.service", "tpu-device-daemon.service")
+
+
+class TPURuntimeComponent(PollingComponent):
+    NAME = RUNTIME_NAME
+    TAGS = ["accelerator", "tpu", "fabric"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self.tpu = instance.tpu_instance
+        self.units = list(RUNTIME_UNITS)
+        self.is_active_fn = self._systemd_is_active
+
+    def is_supported(self) -> bool:
+        return self.tpu is not None and self.tpu.tpu_lib_exists()
+
+    @staticmethod
+    def _systemd_is_active(unit: str) -> str:
+        """'active' | 'inactive' | 'failed' | 'absent'."""
+        r = run_command(["systemctl", "is-active", unit], timeout=10)
+        out = r.output.strip()
+        if r.exit_code == 0:
+            return "active"
+        if "could not be found" in out or "not-found" in out or r.error:
+            return "absent"
+        return out or "inactive"
+
+    def check_once(self) -> CheckResult:
+        if self.tpu is not None and self.tpu.is_mock():
+            return CheckResult(self.NAME, reason="mock backend; runtime assumed healthy")
+        statuses: Dict[str, str] = {u: self.is_active_fn(u) for u in self.units}
+        failed = [u for u, s in statuses.items() if s == "failed"]
+        present = {u: s for u, s in statuses.items() if s != "absent"}
+        if failed:
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=f"TPU runtime unit(s) failed: {failed}",
+                suggested_actions=SuggestedActions(
+                    description="TPU runtime service failed — restart/reboot",
+                    repair_actions=[RepairActionType.REBOOT_SYSTEM],
+                ),
+                extra_info=statuses,
+            )
+        if not present:
+            return CheckResult(
+                self.NAME,
+                reason="no TPU runtime services on this image (direct libtpu mode)",
+                extra_info=statuses,
+            )
+        return CheckResult(
+            self.NAME,
+            reason=f"runtime units healthy: {sorted(present)}",
+            extra_info=statuses,
+        )
+
+
+class TPUProcessesComponent(PollingComponent):
+    NAME = PROCESSES_NAME
+    TAGS = ["accelerator", "tpu"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self.tpu = instance.tpu_instance
+        self.proc_root = "/proc"
+        self._stuck_last_check: set = set()
+
+    def is_supported(self) -> bool:
+        return self.tpu is not None and self.tpu.tpu_lib_exists()
+
+    def _device_holders(self) -> Dict[int, List[str]]:
+        """pid → device paths held, from /proc/*/fd symlinks
+        (reference: NVML running-processes; TPUs have no side-band process
+        API, so fd tables are the source of truth)."""
+        holders: Dict[int, List[str]] = {}
+        for fd_dir in glob.iglob(os.path.join(self.proc_root, "[0-9]*", "fd")):
+            pid_s = fd_dir.split(os.sep)[-2]
+            try:
+                pid = int(pid_s)
+                for fd in os.listdir(fd_dir):
+                    try:
+                        target = os.readlink(os.path.join(fd_dir, fd))
+                    except OSError:
+                        continue
+                    if target.startswith("/dev/accel") or target.startswith("/dev/vfio"):
+                        holders.setdefault(pid, []).append(target)
+            except (OSError, ValueError):
+                continue
+        return holders
+
+    @staticmethod
+    def _proc_state(pid: int) -> str:
+        try:
+            with open(f"/proc/{pid}/stat", "r", encoding="ascii") as f:
+                return f.read().split(") ", 1)[1].split()[0]
+        except (OSError, IndexError):
+            return "?"
+
+    def check_once(self) -> CheckResult:
+        if self.tpu is not None and self.tpu.is_mock():
+            return CheckResult(self.NAME, reason="mock backend; no device holders")
+        holders = self._device_holders()
+        _g_holders.set(len(holders), {"component": self.NAME})
+        # a defunct process has no open fds (the kernel closes them in
+        # do_exit before the Z state), so the stuck-device signal is a
+        # holder in uninterruptible sleep ('D') — typically wedged in a
+        # driver ioctl; escalate if it stays stuck across checks
+        stuck = sorted(p for p in holders if self._proc_state(p) == "D")
+        persistent = [p for p in stuck if p in self._stuck_last_check]
+        self._stuck_last_check = set(stuck)
+        extra = {
+            str(pid): ",".join(sorted(set(devs))) for pid, devs in holders.items()
+        }
+        if persistent:
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=(
+                    f"process(es) stuck in uninterruptible sleep holding TPU "
+                    f"devices across checks: {persistent}"
+                ),
+                suggested_actions=SuggestedActions(
+                    description="process wedged in TPU driver — check app; reboot frees the device",
+                    repair_actions=[RepairActionType.CHECK_USER_APP_AND_TPU,
+                                    RepairActionType.REBOOT_SYSTEM],
+                ),
+                extra_info=extra,
+            )
+        if stuck:
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.DEGRADED,
+                reason=f"process(es) in uninterruptible sleep holding TPU devices: {stuck}",
+                extra_info=extra,
+            )
+        return CheckResult(
+            self.NAME,
+            reason=f"{len(holders)} process(es) holding TPU devices",
+            extra_info=extra,
+        )

@@ -1,0 +1,164 @@
+"""Node-local mTLS credential manager.
+
+Reference: pkg/kapmtls/manager.go:29-50 — installs short-lived client
+certificates pushed by the control plane into atomic release directories
+with a ``current`` symlink, supports activation, readiness probing and
+rollback, so the node-local agent's identity can be rotated without
+downtime.
+
+Layout::
+
+    <root>/releases/<version>/{client.crt,client.key}
+    <root>/current -> releases/<version>
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+from gpud_tpu.log import audit, get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_ROOT = "/var/lib/tpud/kapmtls"
+
+
+@dataclass
+class Status:
+    current_version: str = ""
+    versions: List[str] = None
+    ready: bool = False
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return {
+            "current_version": self.current_version,
+            "versions": list(self.versions or []),
+            "ready": self.ready,
+            "error": self.error,
+        }
+
+
+class CertManager:
+    def __init__(self, root: str = DEFAULT_ROOT) -> None:
+        self.root = root
+        self.releases_dir = os.path.join(root, "releases")
+
+    def _release_dir(self, version: str) -> str:
+        if not version or "/" in version or version.startswith("."):
+            raise ValueError(f"invalid version {version!r}")
+        return os.path.join(self.releases_dir, version)
+
+    # -- install -----------------------------------------------------------
+    def install(self, version: str, cert_pem: str, key_pem: str) -> Optional[str]:
+        """Write a release atomically (tmp dir + rename). Returns error or
+        None. Does NOT activate (reference: install then Activate)."""
+        try:
+            d = self._release_dir(version)
+        except ValueError as e:
+            return str(e)
+        tmp = d + f".tmp-{int(time.time() * 1e6)}"
+        try:
+            os.makedirs(tmp, exist_ok=True)
+            with open(os.path.join(tmp, "client.crt"), "w", encoding="utf-8") as f:
+                f.write(cert_pem)
+            key_path = os.path.join(tmp, "client.key")
+            with open(key_path, "w", encoding="utf-8") as f:
+                f.write(key_pem)
+            os.chmod(key_path, 0o600)
+            old = None
+            if os.path.isdir(d):
+                # re-push of the active version: move the old dir aside
+                # first so `current` never dangles (rmtree-then-rename
+                # would leave a crash window with no credentials)
+                old = d + f".old-{int(time.time() * 1e6)}"
+                os.rename(d, old)
+            try:
+                os.rename(tmp, d)
+            except OSError:
+                if old is not None:
+                    os.rename(old, d)  # restore the previous release
+                raise
+            if old is not None:
+                import shutil
+
+                shutil.rmtree(old, ignore_errors=True)
+        except OSError as e:
+            return str(e)
+        audit("kapmtls_install", version=version)
+        return None
+
+    # -- activate / rollback ----------------------------------------------
+    def activate(self, version: str) -> Optional[str]:
+        """Atomic ``current`` symlink swap (symlink-at-temp-path + rename,
+        reference: atomic release dirs + current symlink)."""
+        d = self._release_dir(version)
+        if not os.path.isdir(d):
+            return f"release {version!r} not installed"
+        if not self._release_ready(d):
+            return f"release {version!r} failed readiness probe"
+        link = os.path.join(self.root, "current")
+        tmp_link = link + f".tmp-{int(time.time() * 1e6)}"
+        try:
+            os.symlink(os.path.join("releases", version), tmp_link)
+            os.replace(tmp_link, link)
+        except OSError as e:
+            try:
+                os.unlink(tmp_link)
+            except OSError:
+                pass
+            return str(e)
+        audit("kapmtls_activate", version=version)
+        return None
+
+    def rollback(self) -> Optional[str]:
+        """Activate the newest release strictly older than current — a
+        newer-but-inactive release must never be "rolled back" to."""
+        st = self.status()
+        if not st.current_version:
+            return "nothing active to roll back from"
+        older = [v for v in st.versions if v < st.current_version]
+        if not older:
+            return "no older release to roll back to"
+        target = sorted(older)[-1]
+        err = self.activate(target)
+        if err is None:
+            audit("kapmtls_rollback", to=target)
+        return err
+
+    # -- status ------------------------------------------------------------
+    @staticmethod
+    def _release_ready(d: str) -> bool:
+        """Readiness: both files exist, key is private, cert parses."""
+        crt = os.path.join(d, "client.crt")
+        key = os.path.join(d, "client.key")
+        if not (os.path.isfile(crt) and os.path.isfile(key)):
+            return False
+        try:
+            from cryptography import x509
+
+            with open(crt, "rb") as f:
+                x509.load_pem_x509_certificate(f.read())
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def status(self) -> Status:
+        versions: List[str] = []
+        if os.path.isdir(self.releases_dir):
+            versions = sorted(
+                v for v in os.listdir(self.releases_dir)
+                if os.path.isdir(os.path.join(self.releases_dir, v))
+                and ".tmp-" not in v and ".old-" not in v
+            )
+        current = ""
+        link = os.path.join(self.root, "current")
+        try:
+            current = os.path.basename(os.readlink(link))
+        except OSError:
+            pass
+        ready = bool(current) and self._release_ready(os.path.join(self.root, "current"))
+        return Status(current_version=current, versions=versions, ready=ready)

@@ -138,4 +138,18 @@ def detect(timeout: float = 5.0) -> DetectResult:
     for preferred in ("gcp", "aws", "azure"):
         if preferred in results:
             return results[preferred]
+    # no IMDS answered: fall back to the public-IP → ASN lookup
+    # (reference: detect.go falls back to pkg/asn)
+    try:
+        from gpud_tpu import asn as asnmod
+        from gpud_tpu import netutil
+
+        ip = netutil.public_ip(timeout=min(2.0, timeout))
+        info = asnmod.lookup(ip) if ip else None
+        if info is not None and info.provider:
+            return DetectResult(
+                provider=info.provider, raw={"asn": str(info.asn), "org": info.org}
+            )
+    except Exception:  # noqa: BLE001 — fallback must never fail detection
+        pass
     return DetectResult(provider="unknown")

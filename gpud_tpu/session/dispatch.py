@@ -274,6 +274,42 @@ class Dispatcher:
         write_target_version(self.server.config.target_version_file(), version)
         return {"status": "ok", "target_version": version}
 
+    # -- kapmtls (reference: kapMTLS{Status,UpdateCredentials,Activate},
+    #    session_process_request.go) --------------------------------------
+    def _kapmtls(self):
+        from gpud_tpu.kapmtls import CertManager
+
+        mgr = getattr(self.server, "kapmtls_manager", None)
+        if mgr is None:
+            import os as _os
+
+            root = _os.path.join(
+                self.server.config.resolved_data_dir(), "kapmtls"
+            )
+            mgr = CertManager(root=root)
+            self.server.kapmtls_manager = mgr
+        return mgr
+
+    def _m_kapMTLSStatus(self, req: Dict) -> Dict:
+        return {"kapmtls": self._kapmtls().status().to_dict()}
+
+    def _m_kapMTLSUpdateCredentials(self, req: Dict) -> Dict:
+        version = req.get("version", "")
+        err = self._kapmtls().install(
+            version, req.get("cert_pem", ""), req.get("key_pem", "")
+        )
+        if err:
+            return {"error": err}
+        if req.get("activate", False):
+            err = self._kapmtls().activate(version)
+            if err:
+                return {"error": f"installed but activation failed: {err}"}
+        return {"status": "ok", "version": version}
+
+    def _m_kapMTLSActivate(self, req: Dict) -> Dict:
+        err = self._kapmtls().activate(req.get("version", ""))
+        return {"error": err} if err else {"status": "ok"}
+
     def _m_getPluginSpecs(self, req: Dict) -> Dict:
         specs = self.server.plugin_specs or []
         return {"specs": [s.to_dict() for s in specs]}

@@ -82,6 +82,7 @@ class Session:
         jitter_fn: Callable[[float], float] = None,
         time_sleep_fn: Callable[[float], bool] = None,
         audit_logger=None,
+        protocol: str = "auto",
     ) -> None:
         self.endpoint = endpoint.rstrip("/")
         self.machine_id = machine_id
@@ -98,6 +99,11 @@ class Session:
         self._connected = threading.Event()
         self.reconnect_count = 0
         self.last_connect_error: str = ""
+
+        # protocol auto: try v2 gRPC, fall back to legacy v1 dual streams
+        # (reference: session_v2.go:49-80); injected transports pin v1
+        self.protocol = "v1" if start_reader_fn is not None else protocol
+        self.active_protocol = ""
 
         # injectables
         self.start_reader_fn = start_reader_fn or self._http_reader
@@ -136,8 +142,7 @@ class Session:
             self._drain_reader()
             self._reconnect_signal.clear()
             try:
-                stop_reader = self.start_reader_fn(self)
-                stop_writer = self.start_writer_fn(self)
+                stops = self._connect()
             except Exception as e:  # noqa: BLE001
                 self.last_connect_error = str(e)
                 logger.warning("session connect failed: %s", e)
@@ -150,7 +155,7 @@ class Session:
             self._reconnect_signal.wait()
             self._connected.clear()
             self.reconnect_count += 1
-            for stop in (stop_reader, stop_writer):
+            for stop in stops:
                 try:
                     if stop:
                         stop()
@@ -161,6 +166,28 @@ class Session:
             if self.time_sleep_fn(self.jitter_fn(backoff)):
                 return
             backoff = min(backoff * BACKOFF_FACTOR, BACKOFF_MAX)
+
+    def _connect(self):
+        """Open the transport per protocol preference; returns stop fns."""
+        if self.protocol == "v2" or (
+            self.protocol == "auto" and not getattr(self, "_v2_failed", False)
+        ):
+            try:
+                from gpud_tpu.session.v2.client import start_v2_transport
+
+                stop = start_v2_transport(self)
+                self.active_protocol = "v2"
+                return [stop]
+            except Exception as e:  # noqa: BLE001
+                if self.protocol == "v2":
+                    raise
+                # remember: re-probing a non-gRPC endpoint on every
+                # reconnect would add latency and noise each cycle
+                self._v2_failed = True
+                logger.info("session v2 unavailable (%s); using legacy v1", e)
+        stops = [self.start_reader_fn(self), self.start_writer_fn(self)]
+        self.active_protocol = "v1"
+        return stops
 
     def signal_reconnect(self, reason: str = "") -> None:
         if reason:

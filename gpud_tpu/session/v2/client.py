@@ -1,0 +1,185 @@
+"""Session v2 transport: gRPC bidi stream.
+
+Reference: pkg/session/session_v2.go:36-80 — a single
+``Connect(AgentPacket) ↔ ManagerPacket`` stream with Hello/HelloAck
+handshake and DrainNotice handling; protocol "auto" tries v2 first and
+falls back to legacy v1 (session_v2.go:49-80).
+
+Stubs are hand-written over ``channel.stream_stream`` (grpc_tools isn't in
+the image); messages come from protoc-generated session_pb2.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import TYPE_CHECKING, Callable, Optional
+
+import grpc
+
+from gpud_tpu.log import get_logger
+from gpud_tpu.session.v2 import session_pb2 as pb
+from gpud_tpu.version import __version__
+
+if TYPE_CHECKING:
+    from gpud_tpu.session.session import Session
+
+logger = get_logger(__name__)
+
+METHOD = "/tpud.session.v2.Session/Connect"
+REVISION = 1
+HANDSHAKE_TIMEOUT = 10.0
+
+
+def grpc_target_from_endpoint(endpoint: str) -> str:
+    """https://cp.example:8443/x → cp.example:8443 (gRPC dials host:port)."""
+    from urllib.parse import urlparse
+
+    u = urlparse(endpoint if "//" in endpoint else f"//{endpoint}")
+    host = u.hostname or endpoint
+    port = u.port or (443 if u.scheme == "https" else 80)
+    return f"{host}:{port}"
+
+
+class HandshakeRejected(Exception):
+    pass
+
+
+def start_v2_transport(session: "Session") -> Callable[[], None]:
+    """Transport function with the (start_reader_fn) contract of
+    Session: starts pump threads, returns a stop(). Raises on connection
+    or handshake failure so the keep-alive loop can fall back to v1."""
+    target = grpc_target_from_endpoint(session.endpoint)
+    use_tls = session.endpoint.startswith("https")
+    if use_tls:
+        channel = grpc.secure_channel(target, grpc.ssl_channel_credentials())
+    else:
+        channel = grpc.insecure_channel(target)
+
+    stream = channel.stream_stream(
+        METHOD,
+        request_serializer=pb.AgentPacket.SerializeToString,
+        response_deserializer=pb.ManagerPacket.FromString,
+    )
+
+    out_q: "queue.Queue[Optional[pb.AgentPacket]]" = queue.Queue()
+    stopped = threading.Event()
+    handshake_ok = threading.Event()
+    handshake_err: list = []
+    # reconnect signals are only valid once this transport was adopted —
+    # a failed v2 probe must not tear down the v1 fallback that follows
+    established = threading.Event()
+
+    hello = pb.AgentPacket()
+    hello.hello.machine_id = session.machine_id
+    hello.hello.token = session.token
+    hello.hello.machine_proof = session.machine_proof
+    hello.hello.tpud_version = __version__
+    hello.hello.revision = REVISION
+    out_q.put(hello)
+
+    def request_iter():
+        while not stopped.is_set():
+            try:
+                pkt = out_q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if pkt is None:
+                return
+            yield pkt
+
+    call = stream(request_iter())
+
+    def _signal_if_established(reason: str) -> None:
+        """A disconnect after adoption must reconnect the session; one
+        during a failed probe must not poison the v1 fallback. The drain/
+        EOF may race the main thread between handshake-ok and adoption, so
+        wait briefly for the verdict instead of sampling it."""
+        if stopped.is_set():
+            return
+        if established.wait(HANDSHAKE_TIMEOUT) and not stopped.is_set():
+            session.signal_reconnect(reason)
+
+    def recv_pump():
+        try:
+            for mpkt in call:
+                if stopped.is_set():
+                    return
+                kind = mpkt.WhichOneof("payload")
+                if kind == "hello_ack":
+                    if not mpkt.hello_ack.accepted:
+                        handshake_err.append(mpkt.hello_ack.reason or "rejected")
+                        handshake_ok.set()
+                        return
+                    handshake_ok.set()
+                elif kind == "frame":
+                    from gpud_tpu.session.session import Frame
+                    import json
+
+                    try:
+                        data = json.loads(mpkt.frame.data.decode("utf-8"))
+                    except ValueError:
+                        continue
+                    try:
+                        session.reader.put(
+                            Frame(req_id=mpkt.frame.req_id, data=data), timeout=5.0
+                        )
+                    except queue.Full:
+                        logger.warning("v2 reader channel full; dropping")
+                elif kind == "drain_notice":
+                    logger.info(
+                        "manager drain notice: %s", mpkt.drain_notice.reason
+                    )
+                    _signal_if_established("manager draining")
+                    return
+            if not stopped.is_set():
+                handshake_err.append("stream closed before ack")
+                handshake_ok.set()
+                _signal_if_established("v2 stream closed")
+        except grpc.RpcError as e:
+            handshake_err.append(str(e))
+            handshake_ok.set()
+            if not stopped.is_set():
+                _signal_if_established(f"v2 stream: {e.code()}")
+
+    def send_pump():
+        import json
+
+        while not stopped.is_set():
+            try:
+                frame = session.writer.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            pkt = pb.AgentPacket()
+            pkt.frame.req_id = frame.req_id
+            pkt.frame.data = json.dumps(frame.data).encode("utf-8")
+            out_q.put(pkt)
+
+    recv_t = threading.Thread(target=recv_pump, name="tpud-v2-recv", daemon=True)
+    recv_t.start()
+
+    if not handshake_ok.wait(HANDSHAKE_TIMEOUT):
+        stopped.set()
+        call.cancel()
+        channel.close()
+        raise TimeoutError("v2 handshake timed out")
+    if handshake_err:
+        stopped.set()
+        call.cancel()
+        channel.close()
+        raise HandshakeRejected(handshake_err[0])
+
+    established.set()
+    send_t = threading.Thread(target=send_pump, name="tpud-v2-send", daemon=True)
+    send_t.start()
+
+    def stop():
+        stopped.set()
+        out_q.put(None)
+        try:
+            call.cancel()
+        except Exception:  # noqa: BLE001
+            pass
+        channel.close()
+
+    return stop

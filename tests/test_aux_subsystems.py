@@ -1,0 +1,183 @@
+"""pstore, netutil, asn, kapmtls, TPU runtime/processes components."""
+
+import os
+
+from gpud_tpu import asn, netutil
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.components.tpu.runtime import (
+    TPUProcessesComponent,
+    TPURuntimeComponent,
+)
+from gpud_tpu.kapmtls import CertManager
+from gpud_tpu.pstore import PstoreHistory, read_crash_files
+from gpud_tpu.tpu.instance import MockBackend
+
+
+# -- pstore -------------------------------------------------------------------
+
+def _write_dump(d, name, content, mtime=None):
+    p = d / name
+    p.write_text(content)
+    if mtime:
+        os.utime(p, (mtime, mtime))
+    return p
+
+
+def test_pstore_read_and_classify(tmp_path):
+    _write_dump(tmp_path, "dmesg-efi-170001", "foo\nKernel panic - not syncing: oops\nbar")
+    _write_dump(tmp_path, "console-ramoops-0", "BUG: unable to handle page fault")
+    _write_dump(tmp_path, "ignored.txt", "not a dump")
+    recs = read_crash_files(str(tmp_path))
+    assert len(recs) == 2
+    kinds = {r.kind for r in recs}
+    assert "panic" in kinds and "oops" in kinds
+
+
+def test_pstore_history_dedupe(tmp_path, tmp_db):
+    _write_dump(tmp_path, "dmesg-efi-1", "Kernel panic - not syncing", mtime=1000)
+    hist = PstoreHistory(tmp_db)
+    recs = read_crash_files(str(tmp_path))
+    assert len(hist.record_new(recs)) == 1
+    assert len(hist.record_new(recs)) == 0  # dedupe
+    assert len(hist.all()) == 1
+
+
+def test_os_component_pstore_events(tmp_path, tmp_db, monkeypatch):
+    from gpud_tpu.components.os_comp import OSComponent
+    from gpud_tpu.eventstore import EventStore
+
+    monkeypatch.setenv("TPUD_PSTORE_DIR", str(tmp_path))
+    _write_dump(tmp_path, "dmesg-efi-9", "Kernel panic - not syncing: test", mtime=2000)
+    inst = TpudInstance(db_rw=tmp_db, event_store=EventStore(tmp_db))
+    c = OSComponent(inst)
+    c.check()
+    evs = [e for e in c.events(0) if e.name == "kernel_crash_dump"]
+    assert len(evs) == 1
+    assert "panic" in evs[0].message
+    c.check()  # second check: no duplicate event
+    assert len([e for e in c.events(0) if e.name == "kernel_crash_dump"]) == 1
+
+
+# -- netutil ------------------------------------------------------------------
+
+def test_private_ip_shape():
+    ip = netutil.private_ip()
+    assert ip == "" or ip.count(".") == 3
+
+
+def test_port_check_closed():
+    assert netutil.is_port_open("127.0.0.1", 1, timeout=0.3) is False
+
+
+def test_measure_edges_custom():
+    out = netutil.measure_edges([("nowhere", "127.0.0.1", 1)], timeout=0.3)
+    assert out == {"nowhere": None}
+
+
+# -- asn ----------------------------------------------------------------------
+
+def test_asn_lookup_parses():
+    def fake_fetch(url):
+        assert "8.8.8.8" in url
+        return {"network": {"autonomous_system": {"asn": 15169, "organization": "GOOGLE"}}}
+
+    info = asn.lookup("8.8.8.8", fetch_fn=fake_fetch)
+    assert info.asn == 15169
+    assert info.provider == "gcp"
+
+
+def test_asn_lookup_failure():
+    def bad_fetch(url):
+        raise OSError("no egress")
+
+    assert asn.lookup("8.8.8.8", fetch_fn=bad_fetch) is None
+    assert asn.lookup("") is None
+
+
+# -- kapmtls ------------------------------------------------------------------
+
+def _self_signed_pem():
+    from gpud_tpu.server.tls import generate_self_signed
+
+    cert_path, key_path = generate_self_signed()
+    return open(cert_path).read(), open(key_path).read()
+
+
+def test_kapmtls_install_activate_rollback(tmp_path):
+    mgr = CertManager(root=str(tmp_path))
+    cert, key = _self_signed_pem()
+
+    assert mgr.install("v1", cert, key) is None
+    assert mgr.activate("v1") is None
+    st = mgr.status()
+    assert st.current_version == "v1" and st.ready
+
+    assert mgr.install("v2", cert, key) is None
+    assert mgr.activate("v2") is None
+    assert mgr.status().current_version == "v2"
+
+    assert mgr.rollback() is None
+    assert mgr.status().current_version == "v1"
+
+
+def test_kapmtls_activate_missing_or_bad(tmp_path):
+    mgr = CertManager(root=str(tmp_path))
+    assert "not installed" in mgr.activate("ghost")
+    assert mgr.install("bad", "not a cert", "not a key") is None
+    assert "readiness" in mgr.activate("bad")
+    assert mgr.install("../evil", "c", "k") is not None  # path traversal refused
+
+
+def test_kapmtls_session_methods(tmp_path, tmp_db):
+    from gpud_tpu.config import default_config
+    from gpud_tpu.session.dispatch import Dispatcher
+
+    class FakeServer:
+        config = default_config(data_dir=str(tmp_path))
+        registry = None
+        metadata = None
+
+    d = Dispatcher.__new__(Dispatcher)
+    d.server = FakeServer()
+    cert, key = _self_signed_pem()
+    out = d._m_kapMTLSUpdateCredentials(
+        {"version": "r1", "cert_pem": cert, "key_pem": key, "activate": True}
+    )
+    assert out["status"] == "ok"
+    st = d._m_kapMTLSStatus({})
+    assert st["kapmtls"]["current_version"] == "r1"
+    assert st["kapmtls"]["ready"]
+
+
+# -- TPU runtime / processes ---------------------------------------------------
+
+def test_runtime_component_mock_short_circuits():
+    c = TPURuntimeComponent(TpudInstance(tpu_instance=MockBackend(accelerator_type="v5e-8")))
+    assert c.is_supported()
+    cr = c.check()
+    assert cr.health_state_type() == "Healthy"
+    assert "mock" in cr.summary()
+
+
+def test_runtime_component_failed_unit():
+    c = TPURuntimeComponent(TpudInstance(tpu_instance=MockBackend(accelerator_type="v5e-8")))
+    c.tpu.is_mock = lambda: False  # force the probe path
+    c.is_active_fn = lambda u: "failed"
+    cr = c.check()
+    assert cr.health_state_type() == "Unhealthy"
+    assert "failed" in cr.summary()
+
+
+def test_runtime_component_absent_units_ok():
+    c = TPURuntimeComponent(TpudInstance(tpu_instance=MockBackend(accelerator_type="v5e-8")))
+    c.tpu.is_mock = lambda: False
+    c.is_active_fn = lambda u: "absent"
+    cr = c.check()
+    assert cr.health_state_type() == "Healthy"
+    assert "direct libtpu" in cr.summary()
+
+
+def test_processes_component_mock():
+    c = TPUProcessesComponent(TpudInstance(tpu_instance=MockBackend(accelerator_type="v5e-8")))
+    cr = c.check()
+    assert cr.health_state_type() == "Healthy"

@@ -1,0 +1,201 @@
+"""Session v2 (gRPC bidi) e2e against a real in-process gRPC manager."""
+
+import json
+import queue
+import threading
+import time
+from concurrent import futures
+
+import pytest
+
+grpc = pytest.importorskip("grpc")  # session v2 is the "v2" optional extra
+
+from gpud_tpu.session.session import Session
+from gpud_tpu.session.v2 import session_pb2 as pb
+from gpud_tpu.session.v2.client import METHOD, grpc_target_from_endpoint
+
+
+class FakeManagerV2:
+    """Minimal v2 control plane: accepts Hello, streams requests, collects
+    responses; can emit a DrainNotice."""
+
+    def __init__(self, reject=False):
+        self.reject = reject
+        self.hellos = []
+        self.responses = []
+        self.outbound = queue.Queue()
+        self.drain = threading.Event()
+        self._server = None
+        self.port = 0
+
+    def _connect(self, request_iterator, context):
+        first = next(request_iterator)
+        assert first.WhichOneof("payload") == "hello"
+        self.hellos.append(first.hello)
+        ack = pb.ManagerPacket()
+        ack.hello_ack.accepted = not self.reject
+        ack.hello_ack.reason = "bad token" if self.reject else ""
+        ack.hello_ack.revision = 1
+        yield ack
+        if self.reject:
+            return
+
+        stop = threading.Event()
+
+        def drain_requests():
+            try:
+                for pkt in request_iterator:
+                    if pkt.WhichOneof("payload") == "frame":
+                        self.responses.append(
+                            (pkt.frame.req_id, json.loads(pkt.frame.data.decode()))
+                        )
+            except Exception:
+                pass
+            finally:
+                stop.set()  # must run even when the client cancels mid-read
+
+        threading.Thread(target=drain_requests, daemon=True).start()
+        while not stop.is_set() and context.is_active():
+            if self.drain.is_set():
+                d = pb.ManagerPacket()
+                d.drain_notice.reason = "rolling restart"
+                yield d
+                return
+            try:
+                item = self.outbound.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            req_id, data = item
+            m = pb.ManagerPacket()
+            m.frame.req_id = req_id
+            m.frame.data = json.dumps(data).encode()
+            yield m
+
+    def start(self):
+        self._pool = futures.ThreadPoolExecutor(max_workers=8)
+        self._server = grpc.server(self._pool)
+        handler = grpc.stream_stream_rpc_method_handler(
+            self._connect,
+            request_deserializer=pb.AgentPacket.FromString,
+            response_serializer=pb.ManagerPacket.SerializeToString,
+        )
+        service = grpc.method_handlers_generic_handler(
+            "tpud.session.v2.Session", {"Connect": handler}
+        )
+        self._server.add_generic_rpc_handlers((service,))
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.start()
+
+    def stop(self):
+        if self._server:
+            self._server.stop(grace=0.2).wait(timeout=3)
+            # grpc.server does not shut down an externally-supplied pool;
+            # non-daemon workers would block interpreter exit
+            self._pool.shutdown(wait=False, cancel_futures=True)
+
+
+@pytest.fixture()
+def manager():
+    m = FakeManagerV2()
+    m.start()
+    yield m
+    m.stop()
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_target_from_endpoint():
+    assert grpc_target_from_endpoint("https://cp.example") == "cp.example:443"
+    assert grpc_target_from_endpoint("http://1.2.3.4:9000") == "1.2.3.4:9000"
+    assert grpc_target_from_endpoint("cp.example:15000") == "cp.example:15000"
+
+
+def test_v2_handshake_and_roundtrip(manager):
+    s = Session(
+        endpoint=f"http://127.0.0.1:{manager.port}",
+        machine_id="m-v2",
+        token="tok",
+        machine_proof="proof",
+        dispatch_fn=lambda req: {"echo": req},
+        protocol="v2",
+        jitter_fn=lambda b: 0.05,
+    )
+    s.start()
+    assert _wait(lambda: s.connected)
+    assert s.active_protocol == "v2"
+    assert manager.hellos[0].machine_id == "m-v2"
+    assert manager.hellos[0].machine_proof == "proof"
+
+    manager.outbound.put(("r1", {"method": "ping"}))
+    assert _wait(lambda: manager.responses)
+    req_id, data = manager.responses[0]
+    assert req_id == "r1"
+    assert data == {"echo": {"method": "ping"}}
+    s.stop()
+
+
+def test_v2_drain_notice_reconnects(manager):
+    s = Session(
+        endpoint=f"http://127.0.0.1:{manager.port}",
+        machine_id="m-v2",
+        dispatch_fn=lambda req: {},
+        protocol="v2",
+        jitter_fn=lambda b: 0.05,
+    )
+    s.start()
+    assert _wait(lambda: s.connected)
+    manager.drain.set()
+    assert _wait(lambda: s.reconnect_count >= 1)
+    manager.drain.clear()
+    assert _wait(lambda: s.connected)  # reconnected after drain
+    assert len(manager.hellos) >= 2
+    s.stop()
+
+
+def test_v2_rejected_handshake():
+    m = FakeManagerV2(reject=True)
+    m.start()
+    try:
+        s = Session(
+            endpoint=f"http://127.0.0.1:{m.port}",
+            machine_id="m-v2",
+            dispatch_fn=lambda req: {},
+            protocol="v2",
+            jitter_fn=lambda b: 0.05,
+        )
+        s.start()
+        assert _wait(lambda: "bad token" in s.last_connect_error)
+        assert not s.connected
+        s.stop()
+    finally:
+        m.stop()
+
+
+def test_auto_falls_back_to_v1_and_remembers():
+    """auto against an HTTP-only control plane → one v2 probe then v1."""
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp = FakeControlPlane()
+    cp.start()
+    try:
+        s = Session(
+            endpoint=f"http://127.0.0.1:{cp.port}",
+            machine_id="m-auto",
+            dispatch_fn=lambda req: {"ok": True},
+            protocol="auto",
+            jitter_fn=lambda b: 0.05,
+        )
+        s.start()
+        assert _wait(lambda: s.connected, timeout=15)
+        assert s.active_protocol == "v1"
+        assert s._v2_failed is True
+        s.stop()
+    finally:
+        cp.stop()
