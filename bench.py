@@ -111,27 +111,35 @@ def bench_tpu_scan() -> None:
     try:
         import numpy as np
         import jax
+        import jax.numpy as jnp
 
+        from gpud_tpu.ops.pallas_scan import scan_links_packed
         from gpud_tpu.ops.window_scan import classify_links, scan_links
 
         rng = np.random.default_rng(0)
-        L, T = 4096, 1440  # a day of minutes for a v5p-256-scale link set
-        states = (rng.random((L, T)) > 0.001).astype(np.int8)
-        counters = np.cumsum(rng.integers(0, 2, (L, T)), axis=1).astype(np.int32)
-        valid = np.ones((L, T), dtype=bool)
+        L, T = 4096, 1408  # a day of minutes for a v5p-256-scale link set
+        states = jnp.asarray((rng.random((L, T)) > 0.001).astype(np.int8))
+        counters = jnp.asarray(
+            np.cumsum(rng.integers(0, 2, (L, T)), axis=1).astype(np.int32)
+        )
+        valid = jnp.ones((L, T), dtype=bool)
 
-        s = scan_links(states, counters, valid)  # compile + run
-        jax.block_until_ready(s)
-        t0 = time.perf_counter()
-        n_rep = 10
-        for _ in range(n_rep):
-            s = scan_links(states, counters, valid)
-            c = classify_links(s)
-        jax.block_until_ready(c)
-        dt = (time.perf_counter() - t0) / n_rep
+        def timeit(f, n=20):
+            out = f()  # compile
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(n):
+                out = f()
+            jax.block_until_ready(out)
+            return (time.perf_counter() - t0) / n
+
+        dt_jnp = timeit(lambda: classify_links(scan_links(states, counters, valid)))
+        dt_pl = timeit(lambda: scan_links_packed(states, counters, valid))
+        dev = jax.devices()[0].device_kind
         print(
-            f"[bench] ici-scan {L}x{T} on {jax.devices()[0].device_kind}: "
-            f"{dt * 1e3:.2f}ms/scan ({L * T / dt / 1e6:.0f}M samples/s)",
+            f"[bench] ici-scan {L}x{T} on {dev}: "
+            f"jnp {dt_jnp * 1e3:.2f}ms, pallas {dt_pl * 1e3:.2f}ms "
+            f"({L * T / dt_pl / 1e6:.0f}M samples/s, {dt_jnp / dt_pl:.2f}x)",
             file=sys.stderr,
         )
     except Exception as e:  # noqa: BLE001

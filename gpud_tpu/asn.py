@@ -17,6 +17,9 @@ from gpud_tpu.log import get_logger
 logger = get_logger(__name__)
 
 LOOKUP_URL = "https://ip.guide/{ip}"
+# ip.guide with no path resolves the caller's own IP — usable even when
+# the node can't discover its public IP via any cloud metadata service
+LOOKUP_URL_SELF = "https://ip.guide/"
 TIMEOUT = 5.0
 
 # ASN org substrings → canonical provider names
@@ -44,12 +47,11 @@ def _default_fetch(url: str) -> Optional[dict]:
         return json.loads(resp.read().decode())
 
 
-def lookup(ip: str, fetch_fn: Callable[[str], Optional[dict]] = _default_fetch) -> Optional[ASNInfo]:
-    """Returns None when the lookup fails (offline, bad IP)."""
-    if not ip:
-        return None
+def lookup(ip: str = "", fetch_fn: Callable[[str], Optional[dict]] = _default_fetch) -> Optional[ASNInfo]:
+    """Returns None when the lookup fails (offline, bad IP). Empty ``ip``
+    asks ip.guide about the caller's own address."""
     try:
-        d = fetch_fn(LOOKUP_URL.format(ip=ip))
+        d = fetch_fn(LOOKUP_URL.format(ip=ip) if ip else LOOKUP_URL_SELF)
     except Exception as e:  # noqa: BLE001
         logger.debug("asn lookup failed: %s", e)
         return None

@@ -53,6 +53,8 @@ def _build_config(args) -> "cfgmod.Config":
         cfg.components_disabled = [
             c.strip() for c in args.disable_components.split(",") if c.strip()
         ]
+    if getattr(args, "pprof", False):
+        cfg.pprof = True
     cfg.log_level = getattr(args, "log_level", "info")
     return cfg
 
@@ -433,6 +435,8 @@ def build_parser() -> argparse.ArgumentParser:
     pr.add_argument("--token", default="", help="control-plane token")
     pr.add_argument("--disable-components", default="",
                     help="comma-separated component names to disable")
+    pr.add_argument("--pprof", action="store_true",
+                    help="enable /admin/pprof debug endpoints")
     pr.set_defaults(fn=cmd_run)
 
     pi = sub.add_parser("inject-fault", help="inject a synthetic fault via kmsg")

@@ -83,7 +83,13 @@ class OSComponent(PollingComponent):
             )
 
     def check_once(self) -> CheckResult:
-        self._check_pstore()
+        try:
+            self._check_pstore()
+        except Exception:  # noqa: BLE001 — crash attribution is a side
+            # feature; it must never take down fd/uptime monitoring
+            import logging
+
+            logging.getLogger("tpud.components.os").exception("pstore check failed")
         alloc, limit = self.get_file_nr_fn()
         up = self.get_uptime_fn()
         _g_fds_alloc.set(alloc, LABELS)

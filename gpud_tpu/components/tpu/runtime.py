@@ -125,10 +125,10 @@ class TPUProcessesComponent(PollingComponent):
                 continue
         return holders
 
-    @staticmethod
-    def _proc_state(pid: int) -> str:
+    def _proc_state(self, pid: int) -> str:
         try:
-            with open(f"/proc/{pid}/stat", "r", encoding="ascii") as f:
+            path = os.path.join(self.proc_root, str(pid), "stat")
+            with open(path, "r", encoding="ascii") as f:
                 return f.read().split(") ", 1)[1].split()[0]
         except (OSError, IndexError):
             return "?"

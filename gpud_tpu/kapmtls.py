@@ -114,16 +114,24 @@ class CertManager:
         audit("kapmtls_activate", version=version)
         return None
 
+    @staticmethod
+    def _version_key(v: str):
+        """Natural ordering so v10 > v9 (lexicographic would invert them)."""
+        import re as _re
+
+        return [int(p) if p.isdigit() else p for p in _re.split(r"(\d+)", v)]
+
     def rollback(self) -> Optional[str]:
         """Activate the newest release strictly older than current — a
         newer-but-inactive release must never be "rolled back" to."""
         st = self.status()
         if not st.current_version:
             return "nothing active to roll back from"
-        older = [v for v in st.versions if v < st.current_version]
+        cur_key = self._version_key(st.current_version)
+        older = [v for v in st.versions if self._version_key(v) < cur_key]
         if not older:
             return "no older release to roll back to"
-        target = sorted(older)[-1]
+        target = sorted(older, key=self._version_key)[-1]
         err = self.activate(target)
         if err is None:
             audit("kapmtls_rollback", to=target)

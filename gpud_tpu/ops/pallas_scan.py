@@ -1,0 +1,136 @@
+"""Pallas TPU kernel for the packed ICI link window scan.
+
+The jnp implementation in ``window_scan.py`` handles ragged validity with
+gap-spanning forward fills (several associative scans → multiple fused HBM
+passes). When histories are *packed* — each link's samples left-aligned and
+contiguous, validity only as suffix padding, which is exactly what
+``scan_numpy_bridge``/the SQLite store produce — the transitions are plain
+adjacent compares and the whole scan collapses into one VPU pass per tile.
+This kernel does that single pass: one [8, T] tile of links per grid step
+resident in VMEM, all reductions lane-wise on the VPU, one [8, 128] result
+tile out (columns 0..4 carry the per-link scalars).
+
+Layout notes (pallas_guide.md):
+- float32 tiles (8, 128): links ride the sublane axis, time rides lanes.
+- T is padded to a lane multiple; L to a sublane multiple.
+- No MXU work here — this is a bandwidth-bound scan; the win is doing it
+  in one pass instead of the multi-scan jnp graph.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+LINK_BLOCK = 8
+LANE = 128
+
+# result columns
+COL_DROPS = 0
+COL_FLAPS = 1
+COL_DOWN = 2
+COL_VALID = 3
+COL_DELTA = 4
+
+
+class PackedScan(NamedTuple):
+    drops: jax.Array
+    flaps: jax.Array
+    currently_down: jax.Array
+    samples: jax.Array
+    counter_delta: jax.Array
+
+
+def _scan_kernel(states_ref, counters_ref, valid_ref, out_ref):
+    s = states_ref[:]          # [8, T] float32 (1=up / 0=down)
+    c = counters_ref[:]        # [8, T] float32
+    v = valid_ref[:]           # [8, T] float32 (prefix mask)
+
+    prev_s = s[:, :-1]
+    next_s = s[:, 1:]
+    v_pair = v[:, 1:] * v[:, :-1]
+
+    drops = jnp.sum((prev_s > 0.5) * (next_s < 0.5) * v_pair, axis=1)
+    flaps = jnp.sum((prev_s < 0.5) * (next_s > 0.5) * v_pair, axis=1)
+
+    n_valid = jnp.sum(v, axis=1)
+    # last valid sample via one-hot on the prefix-mask boundary
+    # (tpu.iota only produces integer vectors — compare in int32)
+    t_idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, dimension=1)
+    last_one_hot = (
+        t_idx == (n_valid[:, None].astype(jnp.int32) - 1)
+    ).astype(jnp.float32) * v
+    last_state = jnp.sum(s * last_one_hot, axis=1)
+    currently_down = (n_valid > 0.5) * (last_state < 0.5)
+
+    diffs = c[:, 1:] - c[:, :-1]
+    delta = jnp.sum(jnp.maximum(diffs, 0.0) * v_pair, axis=1)
+
+    # scatter (.at[].set) has no Mosaic lowering — build the result tile
+    # with lane-index masks and selects (pure VPU ops)
+    col = jax.lax.broadcasted_iota(jnp.int32, (s.shape[0], LANE), dimension=1)
+    out = jnp.zeros((s.shape[0], LANE), dtype=jnp.float32)
+    for idx, vals in (
+        (COL_DROPS, drops),
+        (COL_FLAPS, flaps),
+        (COL_DOWN, currently_down.astype(jnp.float32)),
+        (COL_VALID, n_valid),
+        (COL_DELTA, delta),
+    ):
+        out = jnp.where(col == idx, vals[:, None], out)
+    out_ref[:] = out
+
+
+def _pad_to(x, mult, axis):
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def scan_links_packed(
+    states: jax.Array,
+    counters: jax.Array,
+    valid: jax.Array,
+    interpret: bool = False,
+) -> PackedScan:
+    """Packed-history scan. Inputs [L, T]; ``valid`` must be a prefix mask
+    per link (contiguous samples, suffix padding) — the packing contract.
+    """
+    from jax.experimental import pallas as pl
+
+    L = states.shape[0]
+    s = _pad_to(_pad_to(states.astype(jnp.float32), LANE, 1), LINK_BLOCK, 0)
+    c = _pad_to(_pad_to(counters.astype(jnp.float32), LANE, 1), LINK_BLOCK, 0)
+    v = _pad_to(_pad_to(valid.astype(jnp.float32), LANE, 1), LINK_BLOCK, 0)
+    Lp, Tp = s.shape
+
+    grid = (Lp // LINK_BLOCK,)
+    block_in = pl.BlockSpec((LINK_BLOCK, Tp), lambda i: (i, 0))
+    block_out = pl.BlockSpec((LINK_BLOCK, LANE), lambda i: (i, 0))
+
+    out = pl.pallas_call(
+        _scan_kernel,
+        out_shape=jax.ShapeDtypeStruct((Lp, LANE), jnp.float32),
+        grid=grid,
+        in_specs=[block_in, block_in, block_in],
+        out_specs=block_out,
+        interpret=interpret,
+    )(s, c, v)
+
+    out = out[:L]
+    return PackedScan(
+        drops=out[:, COL_DROPS].astype(jnp.int32),
+        flaps=out[:, COL_FLAPS].astype(jnp.int32),
+        currently_down=out[:, COL_DOWN] > 0.5,
+        samples=out[:, COL_VALID].astype(jnp.int32),
+        counter_delta=out[:, COL_DELTA].astype(jnp.int64)
+        if jax.config.jax_enable_x64
+        else out[:, COL_DELTA].astype(jnp.int32),
+    )

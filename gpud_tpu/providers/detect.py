@@ -138,14 +138,14 @@ def detect(timeout: float = 5.0) -> DetectResult:
     for preferred in ("gcp", "aws", "azure"):
         if preferred in results:
             return results[preferred]
-    # no IMDS answered: fall back to the public-IP → ASN lookup
+    # no IMDS answered: fall back to the ASN lookup. public_ip() only knows
+    # GCE metadata, which just failed — so ask ip.guide about our own
+    # address (self-lookup), which works from any egress-capable host
     # (reference: detect.go falls back to pkg/asn)
     try:
         from gpud_tpu import asn as asnmod
-        from gpud_tpu import netutil
 
-        ip = netutil.public_ip(timeout=min(2.0, timeout))
-        info = asnmod.lookup(ip) if ip else None
+        info = asnmod.lookup("")
         if info is not None and info.provider:
             return DetectResult(
                 provider=info.provider, raw={"asn": str(info.asn), "org": info.org}

@@ -53,6 +53,30 @@ def scan(
         registry.must_register(init_func)
 
     out.write(f"machine-id : {inst.machine_id}\n")
+    # machine summary + provider detect (reference: scan.go:62-73)
+    try:
+        import psutil
+
+        from gpud_tpu import host as _host
+
+        vm = psutil.virtual_memory()
+        out.write(
+            f"host       : {_host.os_name()}, kernel {_host.kernel_version()}, "
+            f"{psutil.cpu_count(logical=True)} cpus, {vm.total >> 30} GiB ram\n"
+        )
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from gpud_tpu.providers.detect import detect
+
+        prov = detect(timeout=2.0)
+        if prov.provider != "unknown":
+            out.write(
+                f"provider   : {prov.provider} {prov.region} "
+                f"{prov.instance_type}".rstrip() + "\n"
+            )
+    except Exception:  # noqa: BLE001
+        pass
     out.write(f"tpu        : {'present' if tpu.tpu_lib_exists() else 'absent'}")
     if tpu.tpu_lib_exists():
         out.write(

@@ -242,6 +242,70 @@ def build_app(srv: "Server") -> web.Application:
         specs = srv.plugin_specs or []
         return _json([s.to_dict() for s in specs])
 
+    # -- debug/profiling, gated by --pprof (reference: pkg/server
+    #    /admin/pprof/{profile,heap,trace}, server.go:425-434) ------------
+    async def pprof_profile(req: web.Request) -> web.Response:
+        """Wall-clock sampling profiler over ALL threads (cProfile is
+        per-thread and would only see this handler sleeping; Go pprof — the
+        reference — samples every goroutine, so sample _current_frames)."""
+        seconds = min(60.0, float(req.query.get("seconds", 5)))
+        interval = 0.01
+
+        def run():
+            import collections
+            import sys as _sys
+            import time as _t
+
+            counts: collections.Counter = collections.Counter()
+            deadline = _t.monotonic() + seconds
+            samples = 0
+            while _t.monotonic() < deadline:
+                for frame in _sys._current_frames().values():  # noqa: SLF001
+                    co = frame.f_code
+                    counts[f"{co.co_filename}:{frame.f_lineno} {co.co_name}"] += 1
+                samples += 1
+                _t.sleep(interval)
+            lines = [f"# {samples} samples over {seconds}s ({interval * 1e3:.0f}ms interval)"]
+            for loc, n in counts.most_common(60):
+                lines.append(f"{n:6d}  {loc}")
+            return "\n".join(lines) + "\n"
+
+        text = await _run_blocking(srv, run)
+        return web.Response(text=text, content_type="text/plain")
+
+    async def pprof_heap(_req: web.Request) -> web.Response:
+        import tracemalloc
+
+        if not tracemalloc.is_tracing():
+            tracemalloc.start()
+            return web.Response(
+                text="tracemalloc started; re-request for a snapshot\n",
+                content_type="text/plain",
+            )
+        snap = tracemalloc.take_snapshot()
+        # stop after the snapshot: per-allocation tracing must not keep
+        # taxing a long-lived monitoring daemon after one debug request
+        tracemalloc.stop()
+        lines = [str(s) for s in snap.statistics("lineno")[:50]]
+        return web.Response(text="\n".join(lines) + "\n", content_type="text/plain")
+
+    async def pprof_threads(_req: web.Request) -> web.Response:
+        import sys as _sys
+        import threading as _threading
+        import traceback as _traceback
+
+        names = {t.ident: t.name for t in _threading.enumerate()}
+        parts = []
+        for tid, frame in _sys._current_frames().items():  # noqa: SLF001
+            parts.append(f"--- thread {names.get(tid, '?')} ({tid}) ---")
+            parts.append("".join(_traceback.format_stack(frame)))
+        return web.Response(text="\n".join(parts), content_type="text/plain")
+
+    if srv.config.pprof:
+        r.add_get("/admin/pprof/profile", pprof_profile)
+        r.add_get("/admin/pprof/heap", pprof_heap)
+        r.add_get("/admin/pprof/threads", pprof_threads)
+
     r.add_get("/healthz", healthz)
     r.add_get("/v1/components", list_components)
     r.add_delete("/v1/components", deregister_component)

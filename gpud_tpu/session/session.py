@@ -169,6 +169,11 @@ class Session:
 
     def _connect(self):
         """Open the transport per protocol preference; returns stop fns."""
+        skip = getattr(self, "_v2_skip_cycles", 0)
+        if skip > 0:
+            self._v2_skip_cycles = skip - 1
+            if self._v2_skip_cycles == 0:
+                self._v2_failed = False  # cooldown elapsed: re-probe v2
         if self.protocol == "v2" or (
             self.protocol == "auto" and not getattr(self, "_v2_failed", False)
         ):
@@ -181,8 +186,11 @@ class Session:
             except Exception as e:  # noqa: BLE001
                 if self.protocol == "v2":
                     raise
-                # remember: re-probing a non-gRPC endpoint on every
-                # reconnect would add latency and noise each cycle
+                # back off from v2 for a number of reconnect cycles rather
+                # than forever: a transient UNAVAILABLE during a control-
+                # plane rolling restart must not pin the daemon to v1 for
+                # its whole lifetime
+                self._v2_skip_cycles = 10
                 self._v2_failed = True
                 logger.info("session v2 unavailable (%s); using legacy v1", e)
         stops = [self.start_reader_fn(self), self.start_writer_fn(self)]

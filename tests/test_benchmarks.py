@@ -1,0 +1,80 @@
+"""Micro-benchmarks as tests (reference:
+pkg/eventstore/database_benchmark_test.go and
+infiniband/store/insert_benchmark_test.go — Go testing.B harnesses; here
+pytest functions that assert sane throughput floors and print rates, so
+perf regressions surface in CI without a separate harness)."""
+
+import time
+
+from gpud_tpu.api.v1.types import Event
+from gpud_tpu.components.tpu.ici_store import ICIStore
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.tpu.instance import ICILinkSnapshot
+
+
+def test_eventstore_insert_throughput(tmp_db):
+    es = EventStore(tmp_db)
+    b = es.bucket("bench")
+    n = 2000
+    t0 = time.perf_counter()
+    for i in range(n):
+        b.insert(Event(time=float(i), name=f"e{i}", message="x" * 64))
+    dt = time.perf_counter() - t0
+    rate = n / dt
+    print(f"\n[bench] eventstore insert: {rate:.0f} events/s")
+    assert rate > 200  # generous floor; catches pathological regressions
+
+
+def test_eventstore_scan_throughput(tmp_db):
+    es = EventStore(tmp_db)
+    b = es.bucket("bench")
+    es.db.executemany(
+        "INSERT INTO tpud_events_v0_1 (component, timestamp, name, type, message, extra_info) "
+        "VALUES ('bench', ?, 'e', 'Info', 'm', '')",
+        [(float(i),) for i in range(20000)],
+    )
+    t0 = time.perf_counter()
+    evs = b.get(0.0)
+    dt = time.perf_counter() - t0
+    print(f"[bench] eventstore scan: {len(evs) / dt:.0f} events/s read")
+    assert len(evs) == 20000
+    assert len(evs) / dt > 10000
+
+
+def test_ici_store_insert_and_scan_throughput(tmp_db):
+    store = ICIStore(tmp_db)
+    store.time_now_fn = lambda: 100000.0
+    links = [
+        ICILinkSnapshot(chip_id=c, link_id=l, state="up", crc_errors=0)
+        for c in range(4) for l in range(6)
+    ]
+    n_snapshots = 500  # ~8h of minutes for a v5p host
+    t0 = time.perf_counter()
+    for i in range(n_snapshots):
+        store.insert_snapshot(links, ts=float(i))
+    dt_insert = time.perf_counter() - t0
+    rows = n_snapshots * len(links)
+    t0 = time.perf_counter()
+    res = store.scan(200000.0)
+    dt_scan = time.perf_counter() - t0
+    print(
+        f"[bench] ici store: insert {rows / dt_insert:.0f} rows/s, "
+        f"scan {rows / dt_scan:.0f} rows/s"
+    )
+    assert len(res.links) == 24
+    assert rows / dt_insert > 5000
+    assert rows / dt_scan > 20000
+
+
+def test_metrics_store_roundtrip_throughput(tmp_db):
+    from gpud_tpu.metrics.store import MetricsStore
+
+    ms = MetricsStore(tmp_db)
+    rows = [(i, f"m{i % 20}", {"component": "bench"}, float(i)) for i in range(5000)]
+    t0 = time.perf_counter()
+    ms.record(rows)
+    dt = time.perf_counter() - t0
+    print(f"[bench] metrics record: {len(rows) / dt:.0f} rows/s")
+    got = ms.read(0)
+    assert len(got) == 5000
+    assert len(rows) / dt > 5000  # batched executemany path
