@@ -77,21 +77,6 @@ def bench_fault_detection() -> dict:
             # clear state between injections so dedupe never skips the next
             err_comp.set_healthy()
 
-        # steady-state footprint snapshot
-        try:
-            import psutil
-
-            p = psutil.Process()
-            p.cpu_percent(interval=None)
-            time.sleep(2.0)
-            cpu_pct = p.cpu_percent(interval=None)
-            rss_mb = p.memory_info().rss / (1 << 20)
-            print(
-                f"[bench] steady-state cpu={cpu_pct:.1f}% rss={rss_mb:.1f}MB",
-                file=sys.stderr,
-            )
-        except Exception:  # noqa: BLE001
-            pass
     finally:
         srv.stop()
 
@@ -146,8 +131,81 @@ def bench_tpu_scan() -> None:
         print(f"[bench] tpu scan skipped: {e}", file=sys.stderr)
 
 
+def bench_footprint(measure_seconds: float = 20.0) -> None:
+    """Steady-state CPU%/RSS of a dedicated daemon subprocess (the
+    BASELINE.json targets: <1% CPU, <150 MB RSS). stderr report only."""
+    import socket
+    import subprocess
+
+    try:
+        import psutil
+    except ImportError:
+        return
+    tmp = tempfile.mkdtemp(prefix="tpud-footprint-")
+    kmsg = os.path.join(tmp, "kmsg.fixture")
+    open(kmsg, "w").close()
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = {
+        **os.environ,
+        "TPUD_TPU_MOCK_ALL_SUCCESS": "1",
+        "TPUD_KMSG_FILE_PATH": kmsg,
+        "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    # the CLI treats --port 0 as "default 15132"; pick a real free port so
+    # a co-resident tpud (or parallel bench) can't collide
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gpud_tpu", "run",
+         "--data-dir", os.path.join(tmp, "d"), "--port", str(port), "--no-tls"],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        time.sleep(8.0)  # boot + first checks
+        if proc.poll() is not None:
+            print(
+                f"[bench] footprint daemon exited during boot "
+                f"(code {proc.returncode}); skipping measurement",
+                file=sys.stderr,
+            )
+            return
+        p = psutil.Process(proc.pid)
+        p.cpu_percent()
+        time.sleep(measure_seconds)
+        if proc.poll() is not None:
+            print(
+                f"[bench] footprint daemon died mid-measurement "
+                f"(code {proc.returncode})",
+                file=sys.stderr,
+            )
+            return
+        cpu = p.cpu_percent()
+        rss = p.memory_info().rss / (1 << 20)
+        note = ""
+        if "axon_site" in os.environ.get("PYTHONPATH", ""):
+            # the CI harness's site hook imports jax into every python
+            # process (~130MB); a deployed daemon has no such hook
+            note = " [rss inflated by test-harness site hook]"
+        print(
+            f"[bench] daemon steady-state over {measure_seconds:.0f}s: "
+            f"cpu={cpu:.2f}% rss={rss:.1f}MB threads={p.num_threads()} "
+            f"(targets: <1% cpu, <150MB rss){note}",
+            file=sys.stderr,
+        )
+    except Exception as e:  # noqa: BLE001
+        print(f"[bench] footprint measure skipped: {e}", file=sys.stderr)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def main() -> int:
     res = bench_fault_detection()
+    bench_footprint()
     bench_tpu_scan()
     p50 = res["p50_ms"]
     out = {

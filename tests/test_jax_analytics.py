@@ -115,6 +115,19 @@ def test_dryrun_multichip_8_devices():
     ge.dryrun_multichip(8)
 
 
+def test_dryrun_multichip_2_devices():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(2)
+
+
+def test_dryrun_multichip_odd_devices():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(1)  # model_parallel falls back to 1
+    ge.dryrun_multichip(3)
+
+
 def test_entry_compiles():
     import __graft_entry__ as ge
 
