@@ -583,8 +583,9 @@ def new_instance(
 ) -> TPUInstance:
     """Factory (reference: nvml.New / NewWithFailureInjector).
 
-    Order: mock env → JAX (opt-in) → sysfs. The returned instance is always
-    usable; absence of TPUs is reported through ``tpu_lib_exists()``.
+    Order: mock env → JAX (opt-in) → tpu-info CLI (telemetry-capable) →
+    sysfs. The returned instance is always usable; absence of TPUs is
+    reported through ``tpu_lib_exists()``.
     """
     inst: TPUInstance
     if os.environ.get(ENV_MOCK_ALL_SUCCESS, "").lower() in ("1", "true", "yes"):
@@ -593,6 +594,25 @@ def new_instance(
         inst = JaxBackend(accelerator_type=accelerator_type)
     else:
         inst = SysfsBackend(accelerator_type=accelerator_type, worker_id=worker_id)
+        # prefer tpu-info when on PATH: same side-band chips plus telemetry.
+        # Pass the sysfs-resolved accelerator type (GCE metadata) so slice
+        # topology isn't re-inferred from local chips only; availability is
+        # a PATH check, so the probe costs one CLI run at most.
+        try:
+            from gpud_tpu.tpu.tpu_info_backend import (
+                TpuInfoBackend,
+                tpu_info_available,
+            )
+
+            if tpu_info_available():
+                ti = TpuInfoBackend(
+                    accelerator_type=inst.accelerator_type() or accelerator_type,
+                    worker_id=worker_id,
+                )
+                if ti.tpu_lib_exists():
+                    inst = ti
+        except Exception:  # noqa: BLE001 — sysfs result stands
+            pass
     if failure_injector is not None and not failure_injector.empty():
         inst = InjectedInstance(inst, failure_injector)
     return inst

@@ -1,0 +1,187 @@
+"""tpu-info CLI backend.
+
+The ``tpu-info`` tool (Google's libtpu-backed CLI) is the closest thing to
+``nvidia-smi`` on TPU VMs: it prints chip inventory, per-chip duty cycle,
+HBM usage and TensorCore utilization. Output formats vary by version
+(SURVEY §7 hard parts: "tpu-info output formats and libtpu metric APIs
+vary by runtime version → isolate behind tpu.Instance with capability
+flags"), so this parser is deliberately tolerant: it scans for the stable
+tokens (/dev/accel paths, "GiB / GiB" pairs, percentages) rather than
+fixed column offsets, and every capability degrades independently.
+
+The runner is injectable so fixture outputs drive the tests without the
+binary (reference test strategy: mock external binaries, e2e/mock/common.go).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Dict, List, Optional
+
+from gpud_tpu.log import get_logger
+from gpud_tpu.process import RunResult, run_command
+from gpud_tpu.tpu.instance import TPUChip, TPUChipTelemetry, TPUInstance
+from gpud_tpu.tpu.topology import GENERATIONS, normalize_generation
+
+logger = get_logger(__name__)
+
+TPU_INFO_BIN = "tpu-info"
+_GiB = 1024**3
+
+# "/dev/accel0" or "/dev/vfio/0" device paths
+_CHIP_ROW = re.compile(r"(?P<path>/dev/(?:accel|vfio/)\d+)", re.IGNORECASE)
+# chip generation token appearing in the same row ("v4 chip", "v5e", ...)
+_GEN_TOKEN = re.compile(r"\b(v\d+(?:e|p|litepod)?)\b", re.IGNORECASE)
+# "1.23 GiB / 31.75 GiB" HBM usage pairs
+_HBM_PAIR = re.compile(
+    r"(?P<used>[\d.]+)\s*GiB\s*/\s*(?P<total>[\d.]+)\s*GiB", re.IGNORECASE
+)
+# "12.34%" utilization/duty-cycle cells
+_PCT = re.compile(r"([\d.]+)\s*%")
+_DEV_INDEX = re.compile(r"(\d+)$")
+
+
+ENUMERATE_TIMEOUT = 30.0
+# the telemetry path runs under the shared sampler lock every TTL (10s):
+# a hung CLI must stall the TPU components for far less than that
+TELEMETRY_TIMEOUT = 5.0
+
+
+def default_runner(args: List[str], timeout: float = ENUMERATE_TIMEOUT) -> RunResult:
+    return run_command([TPU_INFO_BIN] + args, timeout=timeout)
+
+
+class TpuInfoBackend(TPUInstance):
+    """Side-band enumeration + telemetry via the tpu-info CLI."""
+
+    def __init__(
+        self,
+        accelerator_type: str = "",
+        worker_id: int = 0,
+        run_fn: Callable[[List[str]], RunResult] = default_runner,
+    ) -> None:
+        self._accel_type = accelerator_type
+        self._worker_id = worker_id
+        self.run_fn = run_fn
+        self._init_error = ""
+        self._chips: Dict[int, TPUChip] = {}
+        self._enumerate()
+
+    # -- parsing -----------------------------------------------------------
+    def _enumerate(self) -> None:
+        r = self.run_fn([])
+        if r.exit_code != 0:
+            self._init_error = (
+                r.error or f"tpu-info exited {r.exit_code}: {r.output[:200]}"
+            )
+            return
+        self._chips = self._parse_chips(r.output)
+        if not self._chips:
+            self._init_error = "tpu-info ran but no chips parsed"
+
+    def _parse_chips(self, output: str) -> Dict[int, TPUChip]:
+        chips: Dict[int, TPUChip] = {}
+        gen = ""
+        for ln in output.splitlines():
+            m = _CHIP_ROW.search(ln)
+            if not m or "/dev/" not in ln:
+                continue
+            path = m.group("path")
+            idx_m = _DEV_INDEX.search(path)
+            if not idx_m:
+                continue
+            cid = int(idx_m.group(1))
+            gen_m = _GEN_TOKEN.search(ln.replace(path, ""))
+            if gen_m:
+                gen = normalize_generation(gen_m.group(1)) or gen
+            spec = GENERATIONS.get(gen)
+            chips[cid] = TPUChip(
+                chip_id=cid,
+                device_path=path,
+                generation=gen,
+                cores=spec.cores_per_chip if spec else 1,
+                hbm_total_bytes=spec.hbm_bytes_per_chip if spec else 0,
+            )
+        if chips and not self._accel_type and gen:
+            spec = GENERATIONS.get(gen)
+            if spec is not None:
+                n = len(chips)
+                count = n if spec.suffix_counts_chips else n * spec.cores_per_chip
+                self._accel_type = f"{gen}-{count}"
+        return chips
+
+    def _parse_telemetry(self, output: str) -> Dict[int, TPUChipTelemetry]:
+        """Best-effort: associate HBM pairs and percentages with chips in
+        row order within the usage/utilization tables."""
+        out: Dict[int, TPUChipTelemetry] = {
+            cid: TPUChipTelemetry(
+                chip_id=cid, hbm_total_bytes=c.hbm_total_bytes
+            )
+            for cid, c in self._chips.items()
+        }
+        ordered = sorted(out)
+        hbm_i = 0
+        for ln in output.splitlines():
+            pair = _HBM_PAIR.search(ln)
+            if pair is None:
+                continue
+            # key by the row's Device index when present (the utilization
+            # table may be a subset or reordered); fall back to row order
+            head = ln[: pair.start()]
+            dev_m = re.search(r"(?<![\d/.])(\d+)(?![\d%])", head)
+            if dev_m and int(dev_m.group(1)) in out:
+                cid = int(dev_m.group(1))
+            elif hbm_i < len(ordered):
+                cid = ordered[hbm_i]
+            else:
+                continue
+            tel = out[cid]
+            tel.hbm_used_bytes = int(float(pair.group("used")) * _GiB)
+            tel.hbm_total_bytes = int(float(pair.group("total")) * _GiB)
+            # the duty-cycle % sits on the same row, after the memory pair
+            pcts = _PCT.findall(ln[pair.end():])
+            if pcts:
+                tel.duty_cycle_pct = float(pcts[0])
+                if len(pcts) > 1:
+                    tel.tensorcore_util_pct = float(pcts[1])
+            hbm_i += 1
+        return out
+
+    # -- TPUInstance surface ----------------------------------------------
+    def tpu_lib_exists(self) -> bool:
+        return bool(self._chips)
+
+    def init_error(self) -> str:
+        return self._init_error
+
+    def product_name(self) -> str:
+        t = self.topology()
+        return f"TPU {t.generation}" if t else "TPU"
+
+    def accelerator_type(self) -> str:
+        return self._accel_type
+
+    def worker_id(self) -> int:
+        return self._worker_id
+
+    def devices(self) -> Dict[int, TPUChip]:
+        return dict(self._chips)
+
+    def telemetry_supported(self) -> bool:
+        return bool(self._chips)
+
+    def telemetry(self) -> Dict[int, TPUChipTelemetry]:
+        try:
+            r = self.run_fn([], timeout=TELEMETRY_TIMEOUT)
+        except TypeError:  # injected runner without a timeout parameter
+            r = self.run_fn([])
+        if r.exit_code != 0:
+            logger.warning("tpu-info telemetry read failed: %s", r.error or r.exit_code)
+            return {}
+        return self._parse_telemetry(r.output)
+
+
+def tpu_info_available() -> bool:
+    import shutil
+
+    return shutil.which(TPU_INFO_BIN) is not None

@@ -1,0 +1,107 @@
+"""tpu-info CLI backend parsing against fixture outputs (the binary is
+mocked the way the reference mocks lspci, e2e/mock/common.go:16-31)."""
+
+from gpud_tpu.process import RunResult
+from gpud_tpu.tpu.tpu_info_backend import TpuInfoBackend
+
+# a representative v4-8 single-host output (tolerant parser: the exact
+# frame characters don't matter, only the stable tokens)
+FIXTURE_V4 = """\
+TPU Chips
+┌─────────────┬─────────┬─────────┬──────┐
+│ Chip        │ Type    │ Devices │ PID  │
+├─────────────┼─────────┼─────────┼──────┤
+│ /dev/accel0 │ v4 chip │ 1       │ 1001 │
+│ /dev/accel1 │ v4 chip │ 1       │ 1001 │
+│ /dev/accel2 │ v4 chip │ 1       │ 1001 │
+│ /dev/accel3 │ v4 chip │ 1       │ 1001 │
+└─────────────┴─────────┴─────────┴──────┘
+TPU Runtime Utilization
+┌────────┬───────────────────┬────────────┐
+│ Device │ Memory usage      │ Duty cycle │
+├────────┼───────────────────┼────────────┤
+│ 0      │ 1.25 GiB / 30.75 GiB │  12.50% │
+│ 1      │ 2.50 GiB / 30.75 GiB │  99.00% │
+│ 2      │ 0.00 GiB / 30.75 GiB │   0.00% │
+│ 3      │ 3.75 GiB / 30.75 GiB │  45.25% │
+└────────┴───────────────────┴────────────┘
+"""
+
+FIXTURE_EMPTY = "TPU Chips\n(no devices found)\n"
+
+
+def _runner(output, exit_code=0):
+    def run(args):
+        return RunResult(exit_code=exit_code, output=output)
+
+    return run
+
+
+def test_enumerates_chips_and_infers_type():
+    b = TpuInfoBackend(run_fn=_runner(FIXTURE_V4))
+    assert b.tpu_lib_exists()
+    devs = b.devices()
+    assert sorted(devs) == [0, 1, 2, 3]
+    assert devs[0].device_path == "/dev/accel0"
+    assert devs[0].generation == "v4"
+    assert b.accelerator_type() == "v4-8"  # 4 chips × 2 cores
+    assert b.generation() == "v4"
+
+
+def test_parses_telemetry():
+    b = TpuInfoBackend(run_fn=_runner(FIXTURE_V4))
+    tel = b.telemetry()
+    assert len(tel) == 4
+    assert abs(tel[0].hbm_used_bytes / (1 << 30) - 1.25) < 0.01
+    assert abs(tel[0].hbm_total_bytes / (1 << 30) - 30.75) < 0.01
+    assert tel[1].duty_cycle_pct == 99.0
+    assert tel[2].duty_cycle_pct == 0.0
+
+
+def test_no_chips_is_init_error():
+    b = TpuInfoBackend(run_fn=_runner(FIXTURE_EMPTY))
+    assert not b.tpu_lib_exists()
+    assert "no chips parsed" in b.init_error()
+
+
+def test_binary_failure_is_init_error():
+    b = TpuInfoBackend(run_fn=_runner("boom", exit_code=127))
+    assert not b.tpu_lib_exists()
+    assert b.init_error()
+
+
+def test_telemetry_failure_degrades():
+    calls = {"n": 0}
+
+    def flaky(args):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return RunResult(exit_code=0, output=FIXTURE_V4)
+        return RunResult(exit_code=1, error="transient")
+
+    b = TpuInfoBackend(run_fn=flaky)
+    assert b.tpu_lib_exists()
+    assert b.telemetry() == {}  # degraded, not raising
+
+
+def test_subset_table_keys_by_device_index():
+    fix = (
+        "TPU Chips\n"
+        "| /dev/accel0 | v4 chip | 1 | 1 |\n"
+        "| /dev/accel1 | v4 chip | 1 | 1 |\n"
+        "| /dev/accel2 | v4 chip | 1 | 1 |\n"
+        "TPU Runtime Utilization\n"
+        "| 2 | 5.00 GiB / 30.75 GiB | 70.00% |\n"
+        "| 1 | 1.00 GiB / 30.75 GiB | 10.00% |\n"
+    )
+    b = TpuInfoBackend(run_fn=_runner(fix))
+    tel = b.telemetry()
+    assert tel[2].duty_cycle_pct == 70.0
+    assert tel[1].duty_cycle_pct == 10.0
+    assert tel[0].hbm_used_bytes == 0  # chip absent from the table
+
+
+def test_explicit_accelerator_type_wins():
+    b = TpuInfoBackend(accelerator_type="v4-16", run_fn=_runner(FIXTURE_V4))
+    assert b.accelerator_type() == "v4-16"
+    assert b.topology().hosts == 2
