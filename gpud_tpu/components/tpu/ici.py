@@ -65,6 +65,7 @@ class TPUICIComponent(PollingComponent):
         self.auto_clear_window = DEFAULT_AUTO_CLEAR_WINDOW
         self.time_now_fn = time.time
         self._last_purge = 0.0
+        self._max_links_seen = 0
 
     def is_supported(self) -> bool:
         return (
@@ -73,11 +74,21 @@ class TPUICIComponent(PollingComponent):
             and self.tpu.ici_supported()
         )
 
-    def _expected_links(self) -> int:
+    def _expected_links(self, reported: int) -> int:
+        """Expected link count. Driver sysfs exposure can be partial
+        (SURVEY §7: per-link counters are less exposed than IB sysfs), so
+        when the backend stably reports fewer links than the topology, the
+        baseline is the most links ever observed — a link *vanishing* from
+        a previously-larger set still alarms, but a consistently partial
+        mapping doesn't page operators forever."""
         topo = self.tpu.topology() if self.tpu else None
         if topo is None:
             return 0
-        return len(self.tpu.devices()) * topo.ici_links_per_chip
+        topo_expected = len(self.tpu.devices()) * topo.ici_links_per_chip
+        self._max_links_seen = max(self._max_links_seen, reported)
+        if self._max_links_seen >= topo_expected:
+            return topo_expected
+        return self._max_links_seen
 
     def _record_event(self, name: str, ev_type: str, message: str) -> None:
         if self._event_bucket is None:
@@ -111,7 +122,7 @@ class TPUICIComponent(PollingComponent):
             _g_crc.set(ln.crc_errors, labels)
             if ln.state == "up":
                 up += 1
-        expected = self._expected_links()
+        expected = self._expected_links(len(links))
         _g_links_up.set(up, LABELS)
         _g_links_expected.set(expected, LABELS)
 

@@ -1,0 +1,103 @@
+"""Built-daemon e2e: boot `python -m gpud_tpu run` as a real subprocess
+(the reference's pattern: build the binary, boot with mock accelerator env
+and a kmsg fixture, drive the API with the client SDK —
+e2e/e2e_test.go:36-41, tests-e2e.yml:31)."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from gpud_tpu.client.v1 import Client
+
+REPO = str(Path(__file__).resolve().parent.parent)
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("subproc")
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        **os.environ,
+        "TPUD_TPU_MOCK_ALL_SUCCESS": "1",
+        "TPUD_KMSG_FILE_PATH": str(kmsg),
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gpud_tpu", "run",
+         "--data-dir", str(tmp / "data"), "--port", str(port), "--no-tls",
+         "--disable-components", "network-latency"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+    )
+    client = Client(base_url=f"http://localhost:{port}", timeout=10)
+    deadline = time.time() + 30
+    last_err = None
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            out = proc.stdout.read().decode()
+            raise RuntimeError(f"daemon exited {proc.returncode}: {out[-1000:]}")
+        try:
+            client.healthz()
+            break
+        except Exception as e:  # noqa: BLE001
+            last_err = e
+            time.sleep(0.3)
+    else:
+        proc.terminate()
+        raise RuntimeError(f"daemon never became healthy: {last_err}")
+    yield proc, client, kmsg
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_healthz_and_components(daemon):
+    _proc, client, _kmsg = daemon
+    assert client.healthz()["status"] == "ok"
+    comps = client.get_components()
+    assert "cpu" in comps and "accelerator-tpu-ici" in comps
+
+
+def test_fault_injection_cli_to_running_daemon(daemon):
+    """tpud inject-fault (separate process) → the running daemon detects."""
+    proc, client, kmsg = daemon
+    env = {
+        **os.environ,
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    r = subprocess.run(
+        [sys.executable, "-m", "gpud_tpu", "inject-fault",
+         "--kmsg-path", str(kmsg), "--name", "tpu_power_fault", "--chip-id", "1"],
+        env=env, capture_output=True, timeout=60,
+    )
+    assert r.returncode == 0, r.stderr.decode()
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        states = client.get_health_states(components=["accelerator-tpu-error-kmsg"])
+        st = states[0].states[0]
+        if st.health == "Unhealthy" and "tpu_power_fault" in st.reason:
+            assert "HARDWARE_INSPECTION" in st.suggested_actions.repair_actions
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"fault not detected; last state: {st.health} {st.reason}")
+
+
+def test_graceful_shutdown(daemon):
+    proc, client, _kmsg = daemon
+    assert client.healthz()["status"] == "ok"
+    # SIGTERM → clean exit 0 (signal handler in cmd_run)
+    proc.terminate()
+    assert proc.wait(timeout=15) == 0

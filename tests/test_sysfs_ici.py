@@ -1,0 +1,102 @@
+"""SysfsBackend ICI links from a fixture tree (SURVEY §4.4 pattern: real
+sysfs trees checked into testdata / built in tmp dirs; the root is
+parameterized via TPUD_ICI_SYSFS_ROOT)."""
+
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.components.tpu.ici import TPUICIComponent
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.tpu.instance import LinkState, SysfsBackend
+
+
+def _build_tree(root, chips=4, links=4, down=(), crc=None):
+    for c in range(chips):
+        for l in range(links):
+            d = root / f"chip{c}" / f"ici{l}"
+            d.mkdir(parents=True, exist_ok=True)
+            (d / "state").write_text(
+                "down" if f"chip{c}/ici{l}" in down else "up"
+            )
+            (d / "tx_bytes").write_text("1000")
+            (d / "rx_bytes").write_text("2000")
+            (d / "crc_errors").write_text(str((crc or {}).get(f"chip{c}/ici{l}", 0)))
+
+
+def _backend(tmp_path, monkeypatch, accel="v5e-4"):
+    dev = tmp_path / "dev"
+    dev.mkdir(exist_ok=True)
+    for i in range(4):
+        (dev / f"accel{i}").write_text("")
+    ici_root = tmp_path / "ici"
+    ici_root.mkdir(exist_ok=True)
+    monkeypatch.setenv("TPUD_ICI_SYSFS_ROOT", str(ici_root))
+    b = SysfsBackend(dev_root=str(dev), accelerator_type=accel)
+    return b, ici_root
+
+
+def test_sysfs_ici_links_parsed(tmp_path, monkeypatch):
+    b, root = _backend(tmp_path, monkeypatch)
+    _build_tree(root, down=("chip1/ici0",), crc={"chip0/ici1": 42})
+    assert b.ici_supported()
+    links = {l.name: l for l in b.ici_links()}
+    assert len(links) == 16
+    assert links["chip1/ici0"].state == LinkState.DOWN
+    assert links["chip0/ici0"].state == LinkState.UP
+    assert links["chip0/ici1"].crc_errors == 42
+    assert links["chip0/ici0"].tx_bytes == 1000
+
+
+def test_sysfs_ici_unsupported_without_root(tmp_path, monkeypatch):
+    monkeypatch.delenv("TPUD_ICI_SYSFS_ROOT", raising=False)
+    b = SysfsBackend(dev_root=str(tmp_path), accelerator_type="v5e-4")
+    assert not b.ici_supported()
+    assert b.ici_links() == []
+
+
+def test_ici_component_over_sysfs_fixture(tmp_path, monkeypatch, tmp_db):
+    """The full ICI component driven by the sysfs tree: down link detected,
+    recovery leaves sticky state, set-healthy clears."""
+    b, root = _backend(tmp_path, monkeypatch)
+    _build_tree(root, down=("chip0/ici1",))
+    inst = TpudInstance(
+        tpu_instance=b, db_rw=tmp_db, event_store=EventStore(tmp_db)
+    )
+    c = TPUICIComponent(inst)
+    c.sampler.ttl = 0.0
+    cr = c.check()
+    assert cr.health_state_type() == "Unhealthy"
+    assert "chip0/ici1" in cr.summary()
+
+    _build_tree(root)  # link recovers
+    cr = c.check()
+    assert "sticky" in cr.summary()
+    c.set_healthy()
+    assert c.check().health_state_type() == "Healthy"
+
+
+def test_unrecognized_state_skipped_not_down(tmp_path, monkeypatch):
+    """A garbage/unreadable state must be skipped, never reported as down —
+    one bad read would otherwise create a CRITICAL drop + sticky flap."""
+    b, root = _backend(tmp_path, monkeypatch)
+    _build_tree(root, chips=1, links=2)
+    (root / "chip0" / "ici0" / "state").write_text("weird")
+    links = b.ici_links()
+    assert [l.name for l in links] == ["chip0/ici1"]  # bad link skipped
+
+
+def test_partial_exposure_not_permanently_unhealthy(tmp_path, monkeypatch, tmp_db):
+    """v5e-4 topology expects 16 links but the deployment maps only 8:
+    stable partial exposure must not alarm; a mapped link vanishing must."""
+    import shutil
+
+    b, root = _backend(tmp_path, monkeypatch)
+    _build_tree(root, chips=4, links=2)  # 8 of 16 mapped
+    inst = TpudInstance(tpu_instance=b, db_rw=tmp_db, event_store=EventStore(tmp_db))
+    c = TPUICIComponent(inst)
+    c.sampler.ttl = 0.0
+    assert c.check().health_state_type() == "Healthy"
+
+    # one mapped link disappears entirely → alarm
+    shutil.rmtree(root / "chip3" / "ici1")
+    cr = c.check()
+    assert cr.health_state_type() == "Unhealthy"
+    assert "unreported" in cr.summary()
