@@ -79,6 +79,8 @@ def cmd_run(args) -> int:
     """Reference: cmd/gpud run → pkg/server.New (SURVEY §3.1)."""
     cfg = _build_config(args)
     log_setup(cfg.log_level, cfg.log_file)
+    # main() already wired the default data-dir audit logger; only an
+    # explicit audit_log_file config overrides it here
     if cfg.audit_log_file:
         set_audit_logger(AuditLogger(cfg.audit_log_file))
 
@@ -407,11 +409,11 @@ def build_parser() -> argparse.ArgumentParser:
     pu.add_argument("--token", default="", help="control-plane join token")
     pu.add_argument("--endpoint", default="", help="control-plane endpoint URL")
     pu.add_argument("--no-systemd", action="store_true")
-    pu.set_defaults(fn=cmd_up)
+    pu.set_defaults(fn=cmd_up, audited=True)
 
     pd = sub.add_parser("down", help="stop and disable the systemd service")
     _add_common_flags(pd)
-    pd.set_defaults(fn=cmd_down)
+    pd.set_defaults(fn=cmd_down, audited=True)
 
     plp = sub.add_parser("list-plugins", help="list configured plugin specs")
     _add_common_flags(plp)
@@ -437,7 +439,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="comma-separated component names to disable")
     pr.add_argument("--pprof", action="store_true",
                     help="enable /admin/pprof debug endpoints")
-    pr.set_defaults(fn=cmd_run)
+    pr.set_defaults(fn=cmd_run, audited=True)
 
     pi = sub.add_parser("inject-fault", help="inject a synthetic fault via kmsg")
     _add_common_flags(pi)
@@ -445,7 +447,7 @@ def build_parser() -> argparse.ArgumentParser:
     pi.add_argument("--chip-id", type=int, default=0)
     pi.add_argument("--detail", default="")
     pi.add_argument("--kernel-message", default="", help="raw kernel message instead of --name")
-    pi.set_defaults(fn=cmd_inject_fault)
+    pi.set_defaults(fn=cmd_inject_fault, audited=True)
 
     pst = sub.add_parser("status", help="query the running daemon")
     pst.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
@@ -454,7 +456,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     pc = sub.add_parser("compact", help="VACUUM the state DB (daemon stopped)")
     _add_common_flags(pc)
-    pc.set_defaults(fn=cmd_compact)
+    pc.set_defaults(fn=cmd_compact, audited=True)
 
     ph = sub.add_parser("set-healthy", help="clear a component's sticky state")
     ph.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
@@ -494,7 +496,7 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common_flags(pup)
     pup.add_argument("--check", action="store_true")
     pup.add_argument("--target-version", default="")
-    pup.set_defaults(fn=cmd_update)
+    pup.set_defaults(fn=cmd_update, audited=True)
 
     pcp = sub.add_parser("custom-plugins", help="validate a plugin specs file")
     pcp.add_argument("file")
@@ -508,14 +510,29 @@ def build_parser() -> argparse.ArgumentParser:
     pn = sub.add_parser("notify", help="record a lifecycle notification")
     _add_common_flags(pn)
     pn.add_argument("phase", choices=["startup", "shutdown"])
-    pn.set_defaults(fn=cmd_notify)
+    pn.set_defaults(fn=cmd_notify, audited=True)
 
     return p
 
 
 def main(argv: Optional[List[str]] = None) -> int:
+    import os
+
     args = build_parser().parse_args(argv)
     log_setup(getattr(args, "log_level", "info"))
+    # privileged CLI actions are audited into the data dir like the
+    # daemon's own; read-only commands (scan, list-plugins, status, ...)
+    # must not touch the data dir at all
+    if getattr(args, "audited", False) and hasattr(args, "data_dir"):
+        cfg = _build_config(args)
+        if not cfg.db_in_memory:
+            try:
+                set_audit_logger(
+                    AuditLogger(os.path.join(cfg.resolved_data_dir(),
+                                             cfgmod.AUDIT_LOG_FILE))
+                )
+            except OSError:
+                pass  # unwritable data dir: act unaudited rather than fail
     return args.fn(args)
 
 

@@ -25,7 +25,8 @@ KEY_NODE_LABELS = "node_labels"
 KEY_LOGIN_SUCCESS_TS = "login_success_ts"
 KEY_EXPECTED_CHIP_COUNT = "expected_chip_count"
 KEY_ACCELERATOR_TYPE = "accelerator_type"
-KEY_ICI_THRESHOLDS = "ici_thresholds"
+KEY_ICI_THRESHOLDS = "ici_thresholds"  # legacy name, unused
+KEY_CONFIG_OVERRIDES = "config_overrides"
 
 
 class Metadata:

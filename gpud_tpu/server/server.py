@@ -208,6 +208,7 @@ class Server:
         self.package_manager.start()
         if self.update_watcher is not None:
             self.update_watcher.start()
+        self._reapply_config_overrides()
         self._maybe_start_session()
         self._start_token_fifo()
 
@@ -287,6 +288,32 @@ class Server:
             except Exception:  # noqa: BLE001
                 logger.exception("component %s close failed", comp.name())
         self.event_store.close()
+
+    def _reapply_config_overrides(self) -> None:
+        """Control-plane config overrides survive restarts (reference:
+        persistMetadataOverrides in cmd/gpud/run). Best-effort: a corrupt
+        row must never abort boot (systemd would crash-loop us)."""
+        try:
+            import json as _json
+
+            from gpud_tpu import metadata as md
+
+            raw = self.metadata.get(md.KEY_CONFIG_OVERRIDES)
+            if not raw:
+                return
+            cfgs = _json.loads(raw)
+            if not isinstance(cfgs, dict):
+                logger.warning("ignoring malformed persisted overrides: %r", cfgs)
+                return
+            from gpud_tpu.session.dispatch import Dispatcher
+
+            updated, _applied, errors = Dispatcher(self).apply_config_overrides(cfgs)
+            if updated:
+                logger.info("re-applied persisted config overrides: %s", updated)
+            if errors:
+                logger.warning("persisted override errors: %s", errors)
+        except Exception:  # noqa: BLE001
+            logger.exception("re-applying persisted overrides failed; continuing boot")
 
     # -- session wiring ----------------------------------------------------
     def _maybe_start_session(self) -> None:

@@ -199,33 +199,92 @@ class Dispatcher:
     # -- config/token ------------------------------------------------------
     def _m_updateConfig(self, req: Dict) -> Dict:
         """Runtime re-config pushed by the control plane (reference:
-        session/update_config.go:19 → setters, session.go:222-227)."""
-        updated = []
+        session/update_config.go:19 → setters, session.go:222-227).
+        Overrides are persisted to the metadata table and re-applied at
+        boot (reference: cmd/gpud/run persistMetadataOverrides)."""
         cfgs = req.get("configs", {})
+        updated, applied, errors = self.apply_config_overrides(cfgs)
+        if applied:
+            self._persist_config_overrides(applied)
+        out: Dict = {"status": "ok", "updated": updated}
+        if errors:
+            out["errors"] = errors
+        return out
+
+    def _persist_config_overrides(self, applied: Dict) -> None:
+        """Merge ONLY the successfully-applied subset into the persisted
+        overrides — unknown or invalid keys must not be replayed forever."""
+        import json as _json
+
+        from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
+
+        existing = {}
+        raw = self.server.metadata.get(KEY_CONFIG_OVERRIDES)
+        if raw:
+            try:
+                loaded = _json.loads(raw)
+                if isinstance(loaded, dict):
+                    existing = loaded
+            except ValueError:
+                pass
+        for k, v in applied.items():
+            if isinstance(v, dict):
+                prev = existing.get(k)
+                merged = dict(prev) if isinstance(prev, dict) else {}
+                merged.update(v)
+                existing[k] = merged
+            else:
+                existing[k] = v
+        self.server.metadata.set(KEY_CONFIG_OVERRIDES, _json.dumps(existing))
+
+    def apply_config_overrides(self, cfgs: Dict):
+        """Apply overrides key-by-key; one invalid value must not block the
+        rest. Returns (updated_names, applied_subset, errors)."""
+        updated: list = []
+        applied: Dict = {}
+        errors: list = []
+        if not isinstance(cfgs, dict):
+            return updated, applied, ["configs must be an object"]
         if "expected_chip_count" in cfgs:
-            n = int(cfgs["expected_chip_count"])
             comp = self.server.registry.get("accelerator-tpu-chip-counts")
-            if comp is not None:
-                comp.expected_count = n
-                updated.append("expected_chip_count")
-        if "ici" in cfgs:
-            ici_cfg = cfgs["ici"]
+            try:
+                n = int(cfgs["expected_chip_count"])
+                if comp is not None:
+                    comp.expected_count = n
+                    updated.append("expected_chip_count")
+                    applied["expected_chip_count"] = n
+            except (TypeError, ValueError) as e:
+                errors.append(f"expected_chip_count: {e}")
+        ici_cfg = cfgs.get("ici")
+        if isinstance(ici_cfg, dict):
             comp = self.server.registry.get("accelerator-tpu-ici")
             if comp is not None:
                 for key in ("flap_threshold", "crc_delta_degraded",
                             "auto_clear_window", "scan_window"):
-                    if key in ici_cfg:
-                        setattr(comp, key, type(getattr(comp, key))(ici_cfg[key]))
+                    if key not in ici_cfg:
+                        continue
+                    try:
+                        val = type(getattr(comp, key))(ici_cfg[key])
+                        setattr(comp, key, val)
                         updated.append(f"ici.{key}")
-        if "temperature" in cfgs:
-            t_cfg = cfgs["temperature"]
+                        applied.setdefault("ici", {})[key] = val
+                    except (TypeError, ValueError) as e:
+                        errors.append(f"ici.{key}: {e}")
+        t_cfg = cfgs.get("temperature")
+        if isinstance(t_cfg, dict):
             comp = self.server.registry.get("accelerator-tpu-temperature")
             if comp is not None:
                 for key in ("degraded_c", "unhealthy_c"):
-                    if key in t_cfg:
-                        setattr(comp, key, float(t_cfg[key]))
+                    if key not in t_cfg:
+                        continue
+                    try:
+                        val = float(t_cfg[key])
+                        setattr(comp, key, val)
                         updated.append(f"temperature.{key}")
-        return {"status": "ok", "updated": updated}
+                        applied.setdefault("temperature", {})[key] = val
+                    except (TypeError, ValueError) as e:
+                        errors.append(f"temperature.{key}: {e}")
+        return updated, applied, errors
 
     def _m_updateToken(self, req: Dict) -> Dict:
         token = req.get("token", "")

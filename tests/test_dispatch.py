@@ -134,6 +134,74 @@ def test_update_writes_version_file(dispatch, srv):
     assert read_target_version(srv.config.target_version_file()) == "9.9.9"
 
 
+def test_update_config_persists_across_restart(srv, dispatch, tmp_path):
+    """Overrides land in metadata and re-apply on a fresh server boot
+    (reference: persistMetadataOverrides). An invalid key applies the
+    valid ones and reports errors; invalid values are never persisted."""
+    ici = srv.registry.get("accelerator-tpu-ici")
+    orig = ici.crc_delta_degraded
+    out = dispatch({"method": "updateConfig",
+                    "configs": {"ici": {"crc_delta_degraded": 777},
+                                "temperature": {"degraded_c": "hot"}}})
+    assert "ici.crc_delta_degraded" in out["updated"]
+    assert any("temperature.degraded_c" in e for e in out["errors"])
+    try:
+        from gpud_tpu.config import default_config
+        from gpud_tpu.server.server import Server
+
+        kmsg = tmp_path / "k.fix"
+        kmsg.write_text("")
+        cfg = default_config(
+            data_dir=srv.config.data_dir,  # same state DB
+            port=0, tls=False, kmsg_path=str(kmsg),
+        )
+        s2 = Server(config=cfg)
+        s2.start()
+        try:
+            assert s2.registry.get("accelerator-tpu-ici").crc_delta_degraded == 777
+        finally:
+            s2.stop()
+    finally:
+        ici.crc_delta_degraded = orig  # module-scoped srv: restore
+
+
+def test_set_plugin_specs_persists_and_restarts(dispatch, srv):
+    import os
+
+    orig_exit = dispatch.exit_fn
+    exits = []
+    dispatch.exit_fn = exits.append
+    try:
+        out = dispatch({
+            "method": "setPluginSpecs",
+            "specs": [{"name": "pushed-probe",
+                       "steps": [{"name": "s", "script": "echo ok"}]}],
+        })
+        assert out["status"] == "ok" and out["restarting"]
+        from gpud_tpu.plugins.spec import load_specs
+
+        specs = load_specs(srv.config.resolved_plugin_specs_file())
+        assert [s.name for s in specs] == ["pushed-probe"]
+        # name clash with a built-in refused before persisting
+        out = dispatch({
+            "method": "setPluginSpecs",
+            "specs": [{"name": "cpu", "steps": [{"name": "s", "script": "echo"}]}],
+        })
+        assert "clash" in out["error"]
+        import time as _t
+
+        deadline = _t.time() + 3
+        while not exits and _t.time() < deadline:
+            _t.sleep(0.05)
+        assert exits == [245]  # RESTART_EXIT_CODE requested from the first push
+    finally:
+        dispatch.exit_fn = orig_exit
+        try:
+            os.unlink(srv.config.resolved_plugin_specs_file())
+        except OSError:
+            pass
+
+
 def test_gossip(dispatch):
     out1 = dispatch({"method": "gossip"})
     assert out1["status"] in ("started", "ok")
