@@ -164,6 +164,9 @@ def cmd_compact(args) -> int:
 
 
 def cmd_set_healthy(args) -> int:
+    from gpud_tpu.log import audit
+
+    audit("cli_set_healthy", component=args.component)
     try:
         c = _client(args)
         c.set_healthy(args.component)
@@ -459,10 +462,11 @@ def build_parser() -> argparse.ArgumentParser:
     pc.set_defaults(fn=cmd_compact, audited=True)
 
     ph = sub.add_parser("set-healthy", help="clear a component's sticky state")
+    _add_common_flags(ph)  # data-dir locates the audit trail
     ph.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
     ph.add_argument("--no-tls", action="store_true", help="daemon runs with --no-tls")
     ph.add_argument("--component", required=True)
-    ph.set_defaults(fn=cmd_set_healthy)
+    ph.set_defaults(fn=cmd_set_healthy, audited=True)
 
     pm = sub.add_parser("metadata", help="dump the metadata table")
     _add_common_flags(pm)

@@ -73,9 +73,16 @@ class AuditLogger:
         rec: Dict[str, Any] = {"ts": time.time(), "action": action}
         rec.update(fields)
         line = json.dumps(rec, sort_keys=True)
-        with self._mu:
-            with open(self.path, "a", encoding="utf-8") as f:
-                f.write(line + "\n")
+        try:
+            with self._mu:
+                with open(self.path, "a", encoding="utf-8") as f:
+                    f.write(line + "\n")
+        except OSError as e:
+            # an unwritable audit file (perms, ENOSPC) must degrade to
+            # unaudited — never crash the privileged action being audited
+            logging.getLogger("tpud.audit").warning(
+                "audit write failed (%s); record dropped: %s", e, line
+            )
 
 
 def set_audit_logger(a: AuditLogger) -> None:

@@ -256,6 +256,9 @@ class Dispatcher:
             except (TypeError, ValueError) as e:
                 errors.append(f"expected_chip_count: {e}")
         ici_cfg = cfgs.get("ici")
+        if ici_cfg is not None and not isinstance(ici_cfg, dict):
+            errors.append("ici: must be an object")
+            ici_cfg = None
         if isinstance(ici_cfg, dict):
             comp = self.server.registry.get("accelerator-tpu-ici")
             if comp is not None:
@@ -271,6 +274,9 @@ class Dispatcher:
                     except (TypeError, ValueError) as e:
                         errors.append(f"ici.{key}: {e}")
         t_cfg = cfgs.get("temperature")
+        if t_cfg is not None and not isinstance(t_cfg, dict):
+            errors.append("temperature: must be an object")
+            t_cfg = None
         if isinstance(t_cfg, dict):
             comp = self.server.registry.get("accelerator-tpu-temperature")
             if comp is not None:

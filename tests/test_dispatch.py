@@ -99,11 +99,24 @@ def test_update_config(dispatch, srv):
             },
         }
     )
-    assert set(out["updated"]) == {
-        "expected_chip_count", "ici.flap_threshold", "temperature.degraded_c"
-    }
-    assert srv.registry.get("accelerator-tpu-chip-counts").expected_count == 4
-    assert srv.registry.get("accelerator-tpu-ici").flap_threshold == 5
+    try:
+        assert set(out["updated"]) == {
+            "expected_chip_count", "ici.flap_threshold", "temperature.degraded_c"
+        }
+        assert srv.registry.get("accelerator-tpu-chip-counts").expected_count == 4
+        assert srv.registry.get("accelerator-tpu-ici").flap_threshold == 5
+        # a scalar where an object is expected is reported, not silently ok
+        out2 = dispatch({"method": "updateConfig", "configs": {"temperature": 85}})
+        assert any("must be an object" in e for e in out2["errors"])
+    finally:
+        from gpud_tpu.components.tpu.ici import DEFAULT_FLAP_THRESHOLD
+        from gpud_tpu.components.tpu.temperature import DEFAULT_DEGRADED_C
+        from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
+
+        srv.registry.get("accelerator-tpu-chip-counts").expected_count = 0
+        srv.registry.get("accelerator-tpu-ici").flap_threshold = DEFAULT_FLAP_THRESHOLD
+        srv.registry.get("accelerator-tpu-temperature").degraded_c = DEFAULT_DEGRADED_C
+        srv.metadata.delete(KEY_CONFIG_OVERRIDES)
 
 
 def test_token_roundtrip(dispatch, srv):
@@ -163,6 +176,9 @@ def test_update_config_persists_across_restart(srv, dispatch, tmp_path):
             s2.stop()
     finally:
         ici.crc_delta_degraded = orig  # module-scoped srv: restore
+        from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
+
+        srv.metadata.delete(KEY_CONFIG_OVERRIDES)
 
 
 def test_set_plugin_specs_persists_and_restarts(dispatch, srv):

@@ -1,0 +1,119 @@
+"""Production-shaped evolve-state scenarios (reference: the xid component's
+scenario tests + infiniband component_production_scenarios_test.go — the
+interleavings that page operators at 3am)."""
+
+from gpud_tpu.api.v1.types import Event, EventType, HealthStateType, RepairActionType
+from gpud_tpu.components.tpu.health_state import evolve_health
+
+
+def _err(t, name):
+    return Event(time=t, name=name, type=EventType.FATAL, message=name)
+
+
+def _reboot(t):
+    return Event(time=t, name="reboot", type=EventType.WARNING)
+
+
+def _sh(t):
+    return Event(time=t, name="SetHealthy", type=EventType.INFO)
+
+
+def test_two_errors_one_cleared_by_reboot_one_recurring():
+    """HBM ECC recurs post-reboot (escalates); a driver timeout from before
+    the reboot stays cleared."""
+    events = [
+        _err(10, "tpu_driver_timeout"),
+        _err(20, "tpu_hbm_ecc_uncorrectable"),
+        _reboot(30),
+        _err(40, "tpu_hbm_ecc_uncorrectable"),  # came back
+    ]
+    ev = evolve_health(events)
+    assert ev.health == HealthStateType.UNHEALTHY
+    assert set(ev.active_errors) == {"tpu_hbm_ecc_uncorrectable"}
+    assert ev.suggested_actions.repair_actions == [RepairActionType.HARDWARE_INSPECTION]
+
+
+def test_double_reboot_without_recurrence_stays_clear():
+    events = [
+        _err(10, "tpu_chip_lost"),
+        _reboot(20),
+        _reboot(30),
+    ]
+    ev = evolve_health(events)
+    assert ev.health == HealthStateType.HEALTHY
+
+
+def test_flapping_error_over_many_reboots():
+    """Error recurs after every one of 3 reboots (threshold 2 for
+    tpu_chip_lost) — firmly a hardware problem."""
+    events = []
+    t = 0
+    for _ in range(3):
+        events.append(_err(t, "tpu_chip_lost")); t += 10
+        events.append(_reboot(t)); t += 10
+    events.append(_err(t, "tpu_chip_lost"))
+    ev = evolve_health(events)
+    assert ev.suggested_actions.repair_actions == [RepairActionType.HARDWARE_INSPECTION]
+    assert ev.active_errors["tpu_chip_lost"] == 4
+
+
+def test_set_healthy_midstream_resets_reboot_counting():
+    """Operator clears after an escalation; the same error later must walk
+    the full reboot ladder again from scratch."""
+    events = [
+        _err(10, "tpu_hbm_ecc_uncorrectable"),
+        _reboot(20),
+        _err(30, "tpu_hbm_ecc_uncorrectable"),  # escalated at this point
+        _sh(40),
+        _err(50, "tpu_hbm_ecc_uncorrectable"),  # fresh incident
+    ]
+    ev = evolve_health(events)
+    assert ev.health == HealthStateType.UNHEALTHY
+    acts = ev.suggested_actions.repair_actions
+    assert RepairActionType.REBOOT_SYSTEM in acts
+    assert acts != [RepairActionType.HARDWARE_INSPECTION]
+
+
+def test_noncritical_and_critical_mix():
+    """Correctable ECC noise must not mask (or be masked by) a critical
+    ICI cable fault."""
+    events = [
+        Event(time=10, name="tpu_hbm_ecc_correctable", type=EventType.WARNING),
+        _err(20, "tpu_ici_cable_fault"),
+        Event(time=30, name="tpu_hbm_ecc_correctable", type=EventType.WARNING),
+    ]
+    ev = evolve_health(events)
+    assert ev.health == HealthStateType.UNHEALTHY
+    assert RepairActionType.HARDWARE_INSPECTION in ev.suggested_actions.repair_actions
+    assert ev.active_errors["tpu_hbm_ecc_correctable"] == 2
+
+
+def test_burst_of_same_error_counts_but_one_reboot_clears():
+    events = [_err(10 + i, "tpu_driver_timeout") for i in range(20)]
+    ev = evolve_health(events)
+    assert ev.active_errors["tpu_driver_timeout"] == 20
+    ev2 = evolve_health(events + [_reboot(100)])
+    assert ev2.health == HealthStateType.HEALTHY
+
+
+def test_reboot_before_any_error_is_ignored():
+    events = [_reboot(5), _err(10, "tpu_power_fault")]
+    ev = evolve_health(events)
+    assert ev.health == HealthStateType.UNHEALTHY
+    # first occurrence: the pre-existing reboot must not count toward the
+    # escalation threshold
+    assert RepairActionType.HARDWARE_INSPECTION in ev.suggested_actions.repair_actions
+    # power fault suggests HW directly (threshold 1, no reboot suggestion)
+    assert RepairActionType.REBOOT_SYSTEM not in ev.suggested_actions.repair_actions
+
+
+def test_simultaneous_timestamps_stable():
+    """Events at the identical second (kmsg burst) must not crash or
+    double-count."""
+    events = [
+        _err(10.0, "tpu_ici_link_down"),
+        _reboot(10.0),
+        _err(10.0, "tpu_ici_link_down"),
+    ]
+    ev = evolve_health(events)
+    assert ev.active_errors.get("tpu_ici_link_down", 0) >= 1
