@@ -21,6 +21,7 @@ from gpud_tpu.components.host_extra import (
 )
 from gpud_tpu.components.memory import MemoryComponent
 from gpud_tpu.components.os_comp import OSComponent
+from gpud_tpu.components.tpu.anomaly import TPUAnomalyComponent
 from gpud_tpu.components.tpu.chip_counts import TPUChipCountsComponent
 from gpud_tpu.components.tpu.error_kmsg import TPUErrorKmsgComponent
 from gpud_tpu.components.tpu.hbm import TPUHbmComponent
@@ -58,4 +59,5 @@ def all_components() -> List[InitFunc]:
         TPURuntimeComponent,
         TPUProcessesComponent,
         TPUErrorKmsgComponent,
+        TPUAnomalyComponent,
     ]

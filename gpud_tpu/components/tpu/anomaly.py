@@ -1,0 +1,248 @@
+"""TPU telemetry anomaly component — the daemon's analytics check.
+
+No direct reference analog (the reference stops at threshold checks); this
+is the TPU build's fleet-analytics slot: it feeds recent per-chip telemetry
+windows from the metrics store (the 3-stage pipeline of SURVEY §5.5,
+reference: pkg/metrics/syncer/syncer.go:22-50) through the robust EWMA/MAD
+scorer (gpud_tpu/models/anomaly.py) and surfaces per-chip drift — "chip 3
+is running away from its own recent behavior" — as Degraded with events,
+before a hard threshold (temperature slowdown, HBM ECC) trips.
+
+Backend selection (``TPUD_ANALYTICS_BACKEND`` = auto|numpy|jax):
+- ``numpy`` — the jax-free twin (models/anomaly_np.py); default product
+  path, keeps daemon RSS under the footprint target.
+- ``jax``  — models/anomaly.robust_scores on the accelerator; for hosts
+  that already run jax or fleet-scale batched scoring.
+- ``auto`` — jax only if it is already imported (cost already paid).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from gpud_tpu.api.v1.types import Event, EventType, HealthStateType
+from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
+from gpud_tpu.metrics.registry import gauge
+from gpud_tpu.metrics.store import MetricsStore
+
+NAME = "accelerator-tpu-anomaly"
+
+_g_score = gauge("tpud_tpu_anomaly_score", "per-chip telemetry anomaly score")
+
+LABELS = {"component": NAME}
+
+# metric-name → feature column; all are per-chip gauges recorded by the
+# temperature/power/hbm components into the shared metrics pipeline
+FEATURE_METRICS: List[str] = [
+    "tpud_tpu_temperature_celsius",
+    "tpud_tpu_hbm_temperature_celsius",
+    "tpud_tpu_power_watts",
+    "tpud_tpu_duty_cycle_percent",
+    "tpud_tpu_tensorcore_util_percent",
+    "tpud_tpu_clock_mhz",
+    "tpud_tpu_hbm_used_bytes",
+]
+
+MIN_SAMPLES = 8          # scrape sweeps needed before scoring (warm-up)
+MAX_WINDOW_SAMPLES = 180 # cap at 3h of 1-minute sweeps (metrics retention)
+DEFAULT_LOOKBACK = 3 * 3600.0
+DEFAULT_SCORE_DEGRADED = 6.0  # well above the ~1-2 nominal band (see tests)
+
+
+def _score_windows(windows: np.ndarray, backend: str) -> Tuple[np.ndarray, str]:
+    """Returns (scores, resolved backend name actually used)."""
+    if backend == "auto":
+        import sys
+
+        backend = "jax" if "jax" in sys.modules else "numpy"
+    if backend == "jax":
+        from gpud_tpu.models.anomaly import robust_scores
+
+        return np.asarray(robust_scores(windows)), "jax"
+    from gpud_tpu.models.anomaly_np import robust_scores_np
+
+    return robust_scores_np(windows), "numpy"
+
+
+class TPUAnomalyComponent(PollingComponent):
+    NAME = NAME
+    TAGS = ["accelerator", "tpu", "analytics"]
+
+    def __init__(self, instance: TpudInstance) -> None:
+        super().__init__(instance)
+        self.tpu = instance.tpu_instance
+        self.metrics_store: Optional[MetricsStore] = (
+            MetricsStore(instance.db_rw) if instance.db_rw is not None else None
+        )
+        self._event_bucket = (
+            instance.event_store.bucket(NAME) if instance.event_store else None
+        )
+        self.backend = os.environ.get("TPUD_ANALYTICS_BACKEND", "auto")
+        self.lookback_seconds = DEFAULT_LOOKBACK
+        self.score_degraded = DEFAULT_SCORE_DEGRADED
+        self.min_samples = MIN_SAMPLES
+        self.burst_interval_seconds = 0.25  # scan-mode burst sampling cadence
+
+    def is_supported(self) -> bool:
+        return (
+            self.tpu is not None
+            and self.tpu.tpu_lib_exists()
+            and self.tpu.telemetry_supported()
+        )
+
+    # -- scan-mode burst sampling -----------------------------------------
+    def _burst_windows(self) -> Tuple[List[str], np.ndarray]:
+        """Scan mode has no metrics history (EventStore/DB are nil there,
+        reference: pkg/scan/scan.go:83-100), so take a short burst of live
+        telemetry samples instead — the 'read everything now' scan-mode
+        path, like xid reading the whole kmsg ring (SURVEY §3.2)."""
+        assert self.tpu is not None
+        frames: List[Dict[str, List[float]]] = []
+        chips: List[str] = []
+        for i in range(self.min_samples):
+            if i:
+                self._stop_event.wait(self.burst_interval_seconds)
+            tel = self.tpu.telemetry()
+            frame: Dict[str, List[float]] = {}
+            for cid, t in sorted(tel.items()):
+                frame[str(cid)] = [
+                    t.temperature_c,
+                    t.hbm_temperature_c,
+                    t.power_w,
+                    t.duty_cycle_pct,
+                    t.tensorcore_util_pct,
+                    t.clock_mhz,
+                    float(t.hbm_used_bytes),
+                ]
+            frames.append(frame)
+        # keep only frames matching the most complete chip set seen, so the
+        # array stays rectangular even if a chip vanishes (or appears late)
+        # mid-burst — chip loss alarms via chip-counts, not here
+        if not frames:
+            return [], np.zeros((0, 0, 0), dtype=np.float32)
+        full = max((set(f) for f in frames), key=len)
+        chips = sorted(full, key=lambda c: (len(c), c))
+        frames = [f for f in frames if set(f) == full]
+        if not chips or len(frames) < 2:
+            return [], np.zeros((0, 0, 0), dtype=np.float32)
+        windows = np.asarray(
+            [[f[c] for f in frames] for c in chips], dtype=np.float32
+        )
+        return chips, windows
+
+    # -- window assembly ---------------------------------------------------
+    def _build_windows(self, now: float) -> Tuple[List[str], np.ndarray]:
+        """Read recent telemetry from the metrics store into [C, T, F].
+
+        Scrape sweeps are atomic (one gather timestamp per sweep,
+        metrics/store.Syncer.sync_once), so rows are aligned on the
+        timestamps every (chip, feature) pair has.
+        """
+        assert self.metrics_store is not None
+        by: Dict[str, Dict[str, Dict[int, float]]] = {}
+        # one name-filtered read per feature so the (name, ts) index prunes
+        # the scan instead of walking every component's metrics
+        for name in FEATURE_METRICS:
+            for m in self.metrics_store.read(now - self.lookback_seconds, name=name):
+                chip = m.labels.get("chip")
+                if chip is None:
+                    continue
+                by.setdefault(chip, {}).setdefault(name, {})[m.unix_seconds] = m.value
+        if not by:
+            return [], np.zeros((0, 0, 0), dtype=np.float32)
+
+        common: Optional[set] = None
+        for feats in by.values():
+            for name in FEATURE_METRICS:
+                tss = set(feats.get(name, {}))
+                common = tss if common is None else common & tss
+        ts_sorted = sorted(common or ())[-MAX_WINDOW_SAMPLES:]
+        if len(ts_sorted) < self.min_samples:
+            return [], np.zeros((0, 0, 0), dtype=np.float32)
+
+        chips = sorted(by, key=lambda c: (len(c), c))  # numeric-ish order
+        windows = np.asarray(
+            [
+                [[by[chip][name][t] for name in FEATURE_METRICS] for t in ts_sorted]
+                for chip in chips
+            ],
+            dtype=np.float32,
+        )
+        return chips, windows
+
+    def _record_event(self, chip: str, score: float, now: float) -> None:
+        if self._event_bucket is None:
+            return
+        name = "tpu_telemetry_anomaly"
+        message = f"chip {chip} telemetry drifting (anomaly score {score:.1f})"
+        # dedupe: one event per chip per lookback window
+        for e in self._event_bucket.get(now - self.lookback_seconds):
+            if e.name == name and e.extra_info.get("chip") == chip:
+                return
+        self._event_bucket.insert(
+            Event(
+                component=NAME,
+                name=name,
+                type=EventType.WARNING,
+                message=message,
+                extra_info={"chip": chip, "score": f"{score:.2f}"},
+            )
+        )
+
+    def check_once(self) -> CheckResult:
+        if not self.is_supported():
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.HEALTHY,
+                reason="no TPU telemetry on this host",
+            )
+        now = self.time_now_fn()
+        if self.metrics_store is not None:
+            chips, windows = self._build_windows(now)
+        else:
+            chips, windows = self._burst_windows()
+        if not chips:
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.HEALTHY,
+                reason=f"warming up: <{self.min_samples} telemetry sweeps recorded",
+            )
+
+        scores, used_backend = _score_windows(windows, self.backend)
+        extra = {"samples": str(windows.shape[1]), "backend": used_backend}
+        drifting: List[Tuple[str, float]] = []
+        for chip, score in zip(chips, scores):
+            s = float(score)
+            _g_score.set(s, {"component": NAME, "chip": chip})
+            extra[f"chip{chip}_score"] = f"{s:.2f}"
+            if s >= self.score_degraded:
+                drifting.append((chip, s))
+
+        if drifting:
+            for chip, s in drifting:
+                self._record_event(chip, s, now)
+            names = ", ".join(
+                f"chip {c} (score {s:.1f})" for c, s in sorted(drifting)
+            )
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.DEGRADED,
+                reason=f"telemetry anomaly: {names}",
+                extra_info=extra,
+            )
+        return CheckResult(
+            self.NAME,
+            reason=(
+                f"telemetry nominal across {len(chips)} chips "
+                f"(max score {float(scores.max()):.1f})"
+            ),
+            extra_info=extra,
+        )
+
+    def events(self, since: float):
+        if self._event_bucket is None:
+            return []
+        return self._event_bucket.get(since)

@@ -65,6 +65,7 @@ class ICIStore:
         self.db = db
         self.retention_seconds = retention_seconds
         self.time_now_fn = time.time
+        self.native_enabled = True  # tests flip this off to force parity runs
         db.execute(
             f"""CREATE TABLE IF NOT EXISTS {TABLE} (
                 ts REAL NOT NULL,
@@ -144,7 +145,13 @@ class ICIStore:
     # -- scan --------------------------------------------------------------
     def scan(self, window_seconds: float) -> ScanResult:
         """Walk each link's snapshots in the window (post-tombstone) and
-        classify drops/flaps (reference: IB store Scan marks drops/flaps)."""
+        classify drops/flaps (reference: IB store Scan marks drops/flaps).
+
+        The transition/delta walk runs in the native C++ library when it is
+        loaded (native/tpud_native.cpp tpud_scan_links_ragged — one batched
+        pass over all links), with the pure-Python walk as the always-there
+        fallback; tests assert the two paths agree.
+        """
         now = self.time_now_fn()
         start = now - window_seconds
         res = ScanResult(window_start=start)
@@ -153,44 +160,106 @@ class ICIStore:
             f"FROM {TABLE} WHERE ts>=? ORDER BY link, ts ASC",
             (start,),
         )
-        cur: Optional[LinkScan] = None
-        prev_state: Optional[int] = None
-        prev_counters = None
-        tombstone = 0.0
         all_tombstones = self.tombstones()
         global_tombstone = all_tombstones.get("*", 0.0)
 
+        # group per link, dropping tombstone-masked rows up front so both
+        # scan backends see identical sequences
+        order: List[str] = []
+        seqs: Dict[str, list] = {}
+        tombstone = 0.0
+        cur_link: Optional[str] = None
         for link, ts, state, tx_err, rx_err, crc in rows:
-            if cur is None or link != cur.link:
-                cur = LinkScan(link=link, first_seen=ts)
-                res.links[link] = cur
-                prev_state = None
-                prev_counters = None
+            if link != cur_link:
+                cur_link = link
                 tombstone = max(global_tombstone, all_tombstones.get(link, 0.0))
+                if link not in seqs:
+                    order.append(link)
+                    seqs[link] = []
             if ts < tombstone:
                 continue
-            if cur.samples == 0:
-                cur.first_seen = ts
-            cur.samples += 1
-            cur.last_seen = ts
-            if prev_counters is not None:
-                # accumulate only positive steps: counters are monotonic in
-                # hardware but may reset on driver reload/reboot
-                cur.error_delta += max(0, (tx_err + rx_err) - (prev_counters[0] + prev_counters[1]))
-                cur.crc_delta += max(0, crc - prev_counters[2])
-            prev_counters = (tx_err, rx_err, crc)
-            if prev_state is not None:
-                if prev_state == 1 and state == 0:
-                    cur.drops += 1
-                elif prev_state == 0 and state == 1:
-                    cur.flaps += 1
-            prev_state = state
-            cur.last_state = LinkState.UP if state == 1 else LinkState.DOWN
-            cur.currently_down = state == 0
+            seqs[link].append((ts, state, tx_err + rx_err, crc))
         # links fully masked by a tombstone end up with zero samples — drop
         # them so they don't read as "down since forever"
-        res.links = {k: v for k, v in res.links.items() if v.samples > 0}
+        order = [l for l in order if seqs[l]]
+
+        classified = self._classify_native(order, seqs)
+        if classified is None:
+            classified = self._classify_python(order, seqs)
+
+        for link in order:
+            seq = seqs[link]
+            drops, flaps, currently_down, error_delta, crc_delta = classified[link]
+            res.links[link] = LinkScan(
+                link=link,
+                currently_down=currently_down,
+                drops=drops,
+                flaps=flaps,
+                crc_delta=crc_delta,
+                error_delta=error_delta,
+                last_state=LinkState.UP if seq[-1][1] == 1 else LinkState.DOWN,
+                last_seen=seq[-1][0],
+                first_seen=seq[0][0],
+                samples=len(seq),
+            )
         return res
+
+    def _classify_python(self, order: List[str], seqs: Dict[str, list]) -> Dict[str, tuple]:
+        out: Dict[str, tuple] = {}
+        for link in order:
+            drops = flaps = error_delta = crc_delta = 0
+            prev_state: Optional[int] = None
+            prev_err: Optional[int] = None
+            prev_crc: Optional[int] = None
+            state = 1
+            for _ts, state, err, crc in seqs[link]:
+                if prev_err is not None:
+                    # accumulate only positive steps: counters are monotonic
+                    # in hardware but may reset on driver reload/reboot
+                    error_delta += max(0, err - prev_err)
+                    crc_delta += max(0, crc - prev_crc)
+                prev_err, prev_crc = err, crc
+                if prev_state is not None:
+                    if prev_state == 1 and state == 0:
+                        drops += 1
+                    elif prev_state == 0 and state == 1:
+                        flaps += 1
+                prev_state = state
+            out[link] = (drops, flaps, state == 0, error_delta, crc_delta)
+        return out
+
+    def _classify_native(self, order: List[str], seqs: Dict[str, list]) -> Optional[Dict[str, tuple]]:
+        """Batched C++ scan; None when the native library is absent."""
+        if not self.native_enabled or not order:
+            return None if order else {}
+        from gpud_tpu import native
+
+        if not native.available():
+            return None
+        states: List[int] = []
+        errs: List[int] = []
+        crcs: List[int] = []
+        offsets: List[int] = [0]
+        for link in order:
+            for _ts, state, err, crc in seqs[link]:
+                states.append(1 if state == 1 else 0)
+                errs.append(err)
+                crcs.append(crc)
+            offsets.append(len(states))
+        both = native.scan_links_ragged2(states, errs, crcs, offsets)
+        if both is None:
+            return None
+        by_err, by_crc = both
+        out: Dict[str, tuple] = {}
+        for i, link in enumerate(order):
+            out[link] = (
+                by_err[i]["drops"],
+                by_err[i]["flaps"],
+                by_err[i]["currently_down"],
+                by_err[i]["counter_delta"],
+                by_crc[i]["counter_delta"],
+            )
+        return out
 
     def link_names(self) -> List[str]:
         return [r[0] for r in self.db.query(f"SELECT DISTINCT link FROM {TABLE}")]

@@ -71,10 +71,14 @@ def robust_scores(windows: jax.Array, alpha: float = 0.3) -> jax.Array:
     ewma = sm  # [C, T, F]
     resid = x[:, 1:, :] - ewma[:, :-1, :]  # one-step-ahead residuals
 
-    # robust scale per chip/feature: median absolute deviation
+    # robust scale per chip/feature: median absolute deviation, floored
+    # relative to the signal magnitude so near-constant features (fixed
+    # clock, HBM total) don't turn LSB jitter into huge z-scores
     med = jnp.median(resid, axis=1, keepdims=True)
-    mad = jnp.median(jnp.abs(resid - med), axis=1, keepdims=True) + 1e-6
-    z = jnp.abs(resid - med) / (1.4826 * mad)
+    mad = jnp.median(jnp.abs(resid - med), axis=1, keepdims=True)
+    xmag = jnp.median(jnp.abs(x), axis=1, keepdims=True)
+    scale = 1.4826 * mad + 1e-3 * (1.0 + xmag)
+    z = jnp.abs(resid - med) / scale
 
     # score: mean of the top-k residuals per chip (persistent deviation,
     # not single spikes)

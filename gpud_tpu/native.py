@@ -120,6 +120,19 @@ def parse_kmsg(line: str) -> Optional[Tuple[int, int, int, int, str]]:
     )
 
 
+def _scan_results(out) -> List[dict]:
+    return [
+        {
+            "drops": r.drops,
+            "flaps": r.flaps,
+            "currently_down": bool(r.currently_down),
+            "samples": r.samples,
+            "counter_delta": r.counter_delta,
+        }
+        for r in out
+    ]
+
+
 def scan_links_ragged(states: List[int], counters: List[int],
                       offsets: List[int]) -> Optional[List[dict]]:
     """Scan packed per-link sequences. Returns per-link dicts or None when
@@ -133,16 +146,32 @@ def scan_links_ragged(states: List[int], counters: List[int],
     off = (ctypes.c_int32 * len(offsets))(*offsets)
     out = (_LinkScan * n_links)()
     lib.tpud_scan_links_ragged(st, ct, off, n_links, out)
-    return [
-        {
-            "drops": r.drops,
-            "flaps": r.flaps,
-            "currently_down": bool(r.currently_down),
-            "samples": r.samples,
-            "counter_delta": r.counter_delta,
-        }
-        for r in out
-    ]
+    return _scan_results(out)
+
+
+def scan_links_ragged2(
+    states: List[int],
+    counters_a: List[int],
+    counters_b: List[int],
+    offsets: List[int],
+) -> Optional[Tuple[List[dict], List[dict]]]:
+    """Two-counter variant (error + CRC deltas over the same state walk);
+    packs states/offsets once instead of marshalling them per call."""
+    lib = load()
+    if lib is None:
+        return None
+    n_links = len(offsets) - 1
+    st = (ctypes.c_int8 * len(states))(*states)
+    off = (ctypes.c_int32 * len(offsets))(*offsets)
+    out_a = (_LinkScan * n_links)()
+    out_b = (_LinkScan * n_links)()
+    lib.tpud_scan_links_ragged(
+        st, (ctypes.c_int64 * len(counters_a))(*counters_a), off, n_links, out_a
+    )
+    lib.tpud_scan_links_ragged(
+        st, (ctypes.c_int64 * len(counters_b))(*counters_b), off, n_links, out_b
+    )
+    return _scan_results(out_a), _scan_results(out_b)
 
 
 class NativeDeduper:

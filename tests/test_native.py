@@ -119,3 +119,42 @@ def test_native_deduper_eviction():
     for i in range(100):
         nd.seen(f"k{i}", float(i))
     assert len(nd) <= 17
+
+
+def test_store_scan_native_vs_python_paths(tmp_db):
+    """ICIStore.scan's two classification backends must agree exactly,
+    including tombstone masking and counter resets."""
+    from gpud_tpu.components.tpu.ici_store import ICIStore
+    from gpud_tpu.tpu.instance import ICILinkSnapshot, LinkState
+
+    store = ICIStore(tmp_db)
+    store.time_now_fn = lambda: 1000.0
+
+    def links(down, crc, errs=0):
+        return [
+            ICILinkSnapshot(
+                chip_id=0, link_id=i,
+                state=LinkState.DOWN if i in down else LinkState.UP,
+                crc_errors=crc + i, tx_errors=errs, rx_errors=errs,
+            )
+            for i in range(4)
+        ]
+
+    store.insert_snapshot(links(set(), 0), ts=900)
+    store.insert_snapshot(links({1}, 10, errs=5), ts=920)
+    store.insert_snapshot(links(set(), 3), ts=940)  # crc counter reset
+    store.insert_snapshot(links({2, 3}, 25, errs=2), ts=960)
+    store.set_tombstone("chip0/ici3", ts=950)
+
+    store.native_enabled = False
+    py = store.scan(200.0)
+    store.native_enabled = True
+    if not native.available():
+        pytest.skip("native library unavailable")
+    nat = store.scan(200.0)
+    assert set(py.links) == set(nat.links)
+    for name in py.links:
+        a, b = py.links[name], nat.links[name]
+        assert (a.drops, a.flaps, a.currently_down) == (b.drops, b.flaps, b.currently_down), name
+        assert (a.crc_delta, a.error_delta, a.samples) == (b.crc_delta, b.error_delta, b.samples), name
+        assert (a.first_seen, a.last_seen, a.last_state) == (b.first_seen, b.last_seen, b.last_state), name
