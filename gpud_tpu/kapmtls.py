@@ -70,18 +70,38 @@ class CertManager:
                 f.write(key_pem)
             os.chmod(key_path, 0o600)
             old = None
+            active_repush = False
             if os.path.isdir(d):
-                # re-push of the active version: move the old dir aside
-                # first so `current` never dangles (rmtree-then-rename
-                # would leave a crash window with no credentials)
+                # re-push of an existing version: move the old dir aside so
+                # the version path is free for the new release
                 old = d + f".old-{int(time.time() * 1e6)}"
-                os.rename(d, old)
+                link = os.path.join(self.root, "current")
+                try:
+                    active_repush = os.path.realpath(link) == os.path.realpath(d)
+                except OSError:
+                    active_repush = False
+                if active_repush:
+                    # pivot `current` onto the fully-written tmp dir BEFORE
+                    # vacating the version path: every crash point below
+                    # except the final rename→retarget gap (two syscalls)
+                    # leaves `current` pointing at existing credentials
+                    self._retarget_current(os.path.relpath(tmp, self.root))
+                try:
+                    os.rename(d, old)
+                except OSError:
+                    if active_repush:
+                        self._retarget_current(os.path.join("releases", version))
+                    raise
             try:
                 os.rename(tmp, d)
             except OSError:
                 if old is not None:
                     os.rename(old, d)  # restore the previous release
+                if active_repush:
+                    self._retarget_current(os.path.join("releases", version))
                 raise
+            if active_repush:
+                self._retarget_current(os.path.join("releases", version))
             if old is not None:
                 import shutil
 
@@ -90,6 +110,21 @@ class CertManager:
             return str(e)
         audit("kapmtls_install", version=version)
         return None
+
+    def _retarget_current(self, target: str) -> None:
+        """Atomic symlink replace of ``current`` → *target* (relative to
+        root); cleans up the staging link on failure."""
+        link = os.path.join(self.root, "current")
+        tmp_link = link + f".tmp-{int(time.time() * 1e6)}"
+        try:
+            os.symlink(target, tmp_link)
+            os.replace(tmp_link, link)
+        except OSError:
+            try:
+                os.unlink(tmp_link)
+            except OSError:
+                pass
+            raise
 
     # -- activate / rollback ----------------------------------------------
     def activate(self, version: str) -> Optional[str]:
@@ -100,16 +135,9 @@ class CertManager:
             return f"release {version!r} not installed"
         if not self._release_ready(d):
             return f"release {version!r} failed readiness probe"
-        link = os.path.join(self.root, "current")
-        tmp_link = link + f".tmp-{int(time.time() * 1e6)}"
         try:
-            os.symlink(os.path.join("releases", version), tmp_link)
-            os.replace(tmp_link, link)
+            self._retarget_current(os.path.join("releases", version))
         except OSError as e:
-            try:
-                os.unlink(tmp_link)
-            except OSError:
-                pass
             return str(e)
         audit("kapmtls_activate", version=version)
         return None

@@ -60,6 +60,21 @@ def _components_filter(request: web.Request):
     return [c for c in raw.split(",") if c] or None
 
 
+def _qfloat(req: web.Request, key: str, default: float) -> float:
+    """Numeric query param; malformed input is a 400, not an unhandled 500
+    (reference returns 400 on bad query input)."""
+    raw = req.query.get(key)
+    if raw is None:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise web.HTTPBadRequest(
+            text=json.dumps({"error": f"invalid {key}: {raw!r}"}),
+            content_type="application/json",
+        )
+
+
 def build_app(srv: "Server") -> web.Application:
     app = web.Application()
     r = app.router
@@ -135,8 +150,8 @@ def build_app(srv: "Server") -> web.Application:
 
     async def events(req: web.Request) -> web.Response:
         now = time.time()
-        start = float(req.query.get("startTime", now - DEFAULT_EVENTS_LOOKBACK))
-        end = float(req.query.get("endTime", now))
+        start = _qfloat(req, "startTime", now - DEFAULT_EVENTS_LOOKBACK)
+        end = _qfloat(req, "endTime", now)
         comps = _components_filter(req)
         out = []
         for c in srv.registry.all():
@@ -154,7 +169,7 @@ def build_app(srv: "Server") -> web.Application:
 
     async def metrics_v1(req: web.Request) -> web.Response:
         now = time.time()
-        since = float(req.query.get("since", now - DEFAULT_METRICS_LOOKBACK))
+        since = _qfloat(req, "since", now - DEFAULT_METRICS_LOOKBACK)
         comps = _components_filter(req)
         ms = srv.metrics_store.read(since, components=comps)
         by_comp = {}
@@ -170,7 +185,7 @@ def build_app(srv: "Server") -> web.Application:
 
     async def info(req: web.Request) -> web.Response:
         now = time.time()
-        start = float(req.query.get("startTime", now - DEFAULT_EVENTS_LOOKBACK))
+        start = _qfloat(req, "startTime", now - DEFAULT_EVENTS_LOOKBACK)
         comps = _components_filter(req)
         ms = srv.metrics_store.read(start, components=comps)
         metrics_by_comp = {}
@@ -248,7 +263,7 @@ def build_app(srv: "Server") -> web.Application:
         """Wall-clock sampling profiler over ALL threads (cProfile is
         per-thread and would only see this handler sleeping; Go pprof — the
         reference — samples every goroutine, so sample _current_frames)."""
-        seconds = min(60.0, float(req.query.get("seconds", 5)))
+        seconds = min(60.0, _qfloat(req, "seconds", 5.0))
         interval = 0.01
 
         def run():

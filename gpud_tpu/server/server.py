@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+import stat
 import threading
 from typing import List, Optional
 
@@ -350,7 +351,16 @@ class Server:
             return
         fifo_path = self.config.fifo_file()
         try:
-            if not os.path.exists(fifo_path):
+            if os.path.exists(fifo_path):
+                # a leftover regular file would make open() return instantly
+                # and the watch loop busy-spin — recreate it as a FIFO
+                if not stat.S_ISFIFO(os.stat(fifo_path).st_mode):
+                    logger.warning(
+                        "token fifo path %s is not a FIFO; recreating", fifo_path
+                    )
+                    os.remove(fifo_path)
+                    os.mkfifo(fifo_path)
+            else:
                 os.mkfifo(fifo_path)
         except OSError as e:
             logger.warning("token fifo unavailable: %s", e)
@@ -374,6 +384,9 @@ class Server:
                                 self.session.stop()
                                 self.session = None
                         self._maybe_start_session()
+                    # empty token: loop straight back into the blocking
+                    # open — sleeping here would leave the FIFO readerless
+                    # and make a concurrent write_token fail with ENXIO
                 except OSError:
                     if self._fifo_stop.wait(1.0):
                         return

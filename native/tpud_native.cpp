@@ -16,8 +16,10 @@
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
+#include <list>
 #include <string>
 #include <unordered_map>
+#include <utility>
 
 extern "C" {
 
@@ -108,14 +110,25 @@ void tpud_scan_links_ragged(const int8_t* states, const int64_t* counters,
 }
 
 // ---------------------------------------------------------------------------
-// 3. TTL dedup cache (string key → expiry), bounded size with coarse
-//    eviction — mirrors gpud_tpu/kmsg/deduper.py semantics.
+// 3. TTL dedup cache (string key → expiry), bounded size with
+//    oldest-first (insertion-order) eviction — mirrors
+//    gpud_tpu/kmsg/deduper.py exactly: constant TTL means insertion order
+//    is expiry order, so the list front is always the next to expire.
 // ---------------------------------------------------------------------------
 
 struct TpudDeduper {
-  std::unordered_map<std::string, double> seen;
+  // front = oldest entry; map values point into the list
+  std::list<std::pair<std::string, double>> order;
+  std::unordered_map<std::string,
+                     std::list<std::pair<std::string, double>>::iterator>
+      seen;
   double ttl;
   size_t max_entries;
+
+  void evict_front() {
+    seen.erase(order.front().first);
+    order.pop_front();
+  }
 };
 
 void* tpud_deduper_new(double ttl_seconds, int64_t max_entries) {
@@ -132,19 +145,18 @@ void tpud_deduper_free(void* handle) {
 // returns 1 if already seen (within TTL), 0 otherwise (and records it)
 int tpud_deduper_seen(void* handle, const char* key, double now) {
   auto* d = static_cast<TpudDeduper*>(handle);
+  // expired entries all sit at the front (constant TTL)
+  while (!d->order.empty() && d->order.front().second <= now) d->evict_front();
   auto it = d->seen.find(key);
-  if (it != d->seen.end() && it->second > now) return 1;
-  if (d->seen.size() >= d->max_entries) {
-    // coarse eviction: drop expired entries; if still over, clear
-    for (auto i = d->seen.begin(); i != d->seen.end();) {
-      if (i->second <= now)
-        i = d->seen.erase(i);
-      else
-        ++i;
-    }
-    if (d->seen.size() >= d->max_entries) d->seen.clear();
+  if (it != d->seen.end()) {
+    if (it->second->second > now) return 1;
+    d->order.erase(it->second);
+    d->seen.erase(it);
   }
-  d->seen[key] = now + d->ttl;
+  d->order.emplace_back(key, now + d->ttl);
+  d->seen[d->order.back().first] = std::prev(d->order.end());
+  // over-capacity: evict oldest-first, never the whole cache
+  while (d->seen.size() > d->max_entries) d->evict_front();
   return 0;
 }
 

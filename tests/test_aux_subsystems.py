@@ -181,3 +181,58 @@ def test_processes_component_mock():
     c = TPUProcessesComponent(TpudInstance(tpu_instance=MockBackend(accelerator_type="v5e-8")))
     cr = c.check()
     assert cr.health_state_type() == "Healthy"
+
+
+def test_kapmtls_repush_active_version_keeps_current_valid(tmp_path, monkeypatch):
+    """Re-pushing the active version must end with `current` resolving to
+    the new release, and at every retarget `current` points at an existing
+    dir (the install pivots through the tmp dir)."""
+    import os
+
+    import gpud_tpu.kapmtls as kap
+
+    mgr = CertManager(root=str(tmp_path))
+    cert, key = _self_signed_pem()
+    assert mgr.install("v1", cert, key) is None
+    assert mgr.activate("v1") is None
+
+    targets = []
+    orig = CertManager._retarget_current
+
+    def spy(self, target):
+        targets.append(target)
+        orig(self, target)
+        # invariant: current always resolves to an existing directory
+        assert os.path.isdir(os.path.realpath(os.path.join(self.root, "current")))
+
+    monkeypatch.setattr(CertManager, "_retarget_current", spy)
+    cert2, key2 = _self_signed_pem()
+    assert mgr.install("v1", cert2, key2) is None
+    # pivot → tmp, then back to the canonical path
+    assert targets[0].startswith("releases/v1.tmp-")
+    assert targets[-1] == os.path.join("releases", "v1")
+    st = mgr.status()
+    assert st.current_version == "v1" and st.ready
+    # new content actually installed
+    got = open(os.path.join(str(tmp_path), "current", "client.crt")).read()
+    assert got == cert2
+    # no stray .old-* / .tmp-* dirs left behind
+    leftover = [p for p in os.listdir(os.path.join(str(tmp_path), "releases")) if "." in p]
+    assert leftover == []
+
+
+def test_kapmtls_repush_inactive_version_no_retarget(tmp_path, monkeypatch):
+    mgr = CertManager(root=str(tmp_path))
+    cert, key = _self_signed_pem()
+    assert mgr.install("v1", cert, key) is None
+    assert mgr.install("v2", cert, key) is None
+    assert mgr.activate("v2") is None
+    calls = []
+    monkeypatch.setattr(
+        CertManager,
+        "_retarget_current",
+        lambda self, t: calls.append(t),
+    )
+    assert mgr.install("v1", cert, key) is None  # re-push inactive v1
+    assert calls == []
+    assert mgr.status().current_version == "v2"

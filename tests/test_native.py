@@ -115,10 +115,31 @@ def test_native_deduper_parity():
 
 
 def test_native_deduper_eviction():
+    """Full-cache eviction is oldest-first (LRU), matching the Python
+    Deduper — recent duplicates must still be recognized under sustained
+    volume, not readmitted after a wholesale clear."""
     nd = native.NativeDeduper(ttl_seconds=1e9, max_entries=16)
     for i in range(100):
         nd.seen(f"k{i}", float(i))
-    assert len(nd) <= 17
+    assert len(nd) == 16
+    # the 16 most recent keys survive; older ones were evicted
+    for i in range(84, 100):
+        assert nd.seen(f"k{i}", 100.0) is True, i
+    assert nd.seen("k83", 100.0) is False
+
+
+def test_native_deduper_eviction_parity_with_python():
+    from gpud_tpu.kmsg.deduper import Deduper
+
+    clock = [0.0]
+    py = Deduper(ttl_seconds=50.0, max_entries=8, time_now_fn=lambda: clock[0])
+    nd = native.NativeDeduper(ttl_seconds=50.0, max_entries=8)
+    # mixed stream: repeats, TTL expiries, capacity pressure
+    stream = [f"k{i % 12}" for i in range(40)] + [f"j{i}" for i in range(20)]
+    for step, key in enumerate(stream):
+        clock[0] = step * 7.0
+        assert py.seen_before(key, 0.0) == nd.seen(key, clock[0]), (step, key)
+        assert len(py) == len(nd), (step, key)
 
 
 def test_store_scan_native_vs_python_paths(tmp_db):
