@@ -43,6 +43,9 @@ class CatalogEntry:
     reboot_threshold: int = 1
     # does this error impact workloads (drives Unhealthy vs informational)?
     critical: bool = True
+    # lines matching this are NOT this error (e.g. AER lines from known
+    # non-TPU drivers); keeps host-wide kernel formats device-scoped
+    exclude: Optional[Pattern] = None
 
 
 def _e(
@@ -54,6 +57,7 @@ def _e(
     repair: tuple,
     reboot_threshold: int = 1,
     critical: bool = True,
+    exclude: str = "",
 ) -> CatalogEntry:
     return CatalogEntry(
         code=code,
@@ -64,7 +68,13 @@ def _e(
         repair_actions=repair,
         reboot_threshold=reboot_threshold,
         critical=critical,
+        exclude=re.compile(exclude, re.IGNORECASE) if exclude else None,
     )
+
+
+# AER/PCIe kernel formats are host-wide; lines clearly attributed to common
+# non-TPU device drivers must not be classified as TPU errors
+_NON_TPU_DRIVERS = r"\b(nvme|ahci|e1000\w*|mlx\d\w*|ixgbe|igb|r8169|virtio|xhci|usb)\b"
 
 
 _REBOOT = (RepairActionType.REBOOT_SYSTEM,)
@@ -73,97 +83,253 @@ _REBOOT_HW = (RepairActionType.REBOOT_SYSTEM, RepairActionType.HARDWARE_INSPECTI
 _NONE = (RepairActionType.IGNORE_NO_ACTION_REQUIRED,)
 _APP = (RepairActionType.CHECK_USER_APP_AND_TPU,)
 
+# NOTE: match() is first-hit-wins, so within each section entries are
+# ordered most-specific-first (e.g. "uncorrectable" before "correctable",
+# which it contains as a substring; "retrain limit" before the generic
+# retrain/flap entry).
 CATALOG: List[CatalogEntry] = [
-    # --- driver-level chip failures --------------------------------------
+    # --- driver-level chip failures (accel / gasket / apex driver) --------
     _e(1, "tpu_chip_lost",
        r"(accel\d+.*(device lost|not responding|fell off the bus)|TPU-ERR: tpu_chip_lost)",
        EventType.FATAL,
        "TPU chip stopped responding to the driver",
        _REBOOT_HW, reboot_threshold=2),
-    _e(2, "tpu_driver_timeout",
-       r"(accel\d*.*(command |request |ioctl )?timeout|google_tpu.*timeout|TPU-ERR: tpu_driver_timeout)",
-       EventType.CRITICAL,
-       "TPU driver command timeout",
-       _REBOOT, reboot_threshold=2),
     _e(3, "tpu_driver_crash",
        r"(accel\d*.*(firmware (crash|fault)|fatal error)|google_tpu.*(oops|panic|BUG)|TPU-ERR: tpu_driver_crash)",
        EventType.FATAL,
        "TPU driver/firmware crashed",
        _REBOOT_HW, reboot_threshold=2),
+    _e(7, "tpu_reset_failed",
+       r"((accel|gasket|apex).*reset.*(fail|timed? ?out)|TPU-ERR: tpu_reset_failed)",
+       EventType.FATAL,
+       "TPU chip reset attempt failed",
+       _REBOOT_HW, reboot_threshold=1),
     _e(4, "tpu_chip_reset_required",
        r"(accel\d+.*reset required|TPU-ERR: tpu_chip_reset_required)",
        EventType.CRITICAL,
        "TPU chip requires reset",
        _REBOOT, reboot_threshold=3),
+    _e(15, "tpu_sram_parity",
+       r"((accel|TPU).*(SRAM|scratchpad).*parity|SRAM parity error|TPU-ERR: tpu_sram_parity)",
+       EventType.FATAL,
+       "on-chip SRAM parity error",
+       _REBOOT_HW, reboot_threshold=1),
+    _e(6, "tpu_core_wedged",
+       r"((accel\d*|TPU|tensor ?core).*wedge|TPU-ERR: tpu_core_wedged)",
+       EventType.FATAL,
+       "TensorCore wedged — compute pipeline stuck",
+       _REBOOT_HW, reboot_threshold=2),
+    _e(16, "tpu_scalar_core_fault",
+       r"(scalar core.*(fault|halt|hang|exception)|TPU-ERR: tpu_scalar_core_fault)",
+       EventType.CRITICAL,
+       "scalar core fault/halt",
+       _REBOOT, reboot_threshold=2),
+    _e(5, "tpu_page_fault",
+       r"((accel|gasket|apex).*((page|mmu) ?fault|page table error)|TPU-ERR: tpu_page_fault)",
+       EventType.CRITICAL,
+       "TPU MMU/page fault — often a bad workload access pattern",
+       _APP, reboot_threshold=2),
+    _e(9, "tpu_interrupt_timeout",
+       r"((accel|gasket|apex).*(interrupt|IRQ|MSI-?X?).*(timeout|lost|storm|not received)|TPU-ERR: tpu_interrupt_timeout)",
+       EventType.CRITICAL,
+       "TPU interrupt delivery timeout/lost",
+       _REBOOT, reboot_threshold=2),
+    _e(13, "tpu_dma_error",
+       r"((accel|gasket|apex).*DMA.*(error|fault|timeout|abort)|TPU-ERR: tpu_dma_error)",
+       EventType.CRITICAL,
+       "TPU DMA engine error",
+       _REBOOT_HW, reboot_threshold=2),
+    _e(14, "tpu_firmware_load_failed",
+       r"((accel|gasket|apex).*firmware.*(load|download|image).*fail|TPU-ERR: tpu_firmware_load_failed)",
+       EventType.CRITICAL,
+       "TPU firmware load failed",
+       _REBOOT_HW, reboot_threshold=1),
+    _e(8, "tpu_driver_init_failed",
+       r"((gasket|apex|accel).*(probe|init\w*).*fail|TPU-ERR: tpu_driver_init_failed)",
+       EventType.CRITICAL,
+       "TPU driver probe/initialization failed",
+       _REBOOT, reboot_threshold=2),
+    _e(2, "tpu_driver_timeout",
+       r"(accel\d*.*(command |request |ioctl )?timeout|google_tpu.*timeout|TPU-ERR: tpu_driver_timeout)",
+       EventType.CRITICAL,
+       "TPU driver command timeout",
+       _REBOOT, reboot_threshold=2),
     # --- HBM / memory -----------------------------------------------------
     _e(10, "tpu_hbm_ecc_uncorrectable",
        r"((uncorrectable|double[- ]bit).*(HBM|ECC|memory error)|HBM.*uncorrectable|TPU-ERR: tpu_hbm_ecc_uncorrectable)",
        EventType.FATAL,
        "uncorrectable HBM ECC error",
        _REBOOT_HW, reboot_threshold=1),
+    _e(18, "tpu_edac_uncorrectable",
+       r"(EDAC.*(\bUE\b|[Uu]ncorrect)|TPU-ERR: tpu_edac_uncorrectable)",
+       EventType.FATAL,
+       "EDAC uncorrectable memory error",
+       _REBOOT_HW, reboot_threshold=1),
+    _e(24, "tpu_hbm_row_remap_pending",
+       r"(HBM.*row.*(remap|retire)|row remap.*pending|TPU-ERR: tpu_hbm_row_remap_pending)",
+       EventType.CRITICAL,
+       "HBM row remap/retirement pending — reboot to apply",
+       _REBOOT, reboot_threshold=1),
     _e(11, "tpu_hbm_ecc_correctable",
        r"((correctable|single[- ]bit).*(HBM|ECC)|HBM.*correctable|TPU-ERR: tpu_hbm_ecc_correctable)",
        EventType.WARNING,
        "correctable HBM ECC error (no action; tracked for trends)",
        _NONE, reboot_threshold=0, critical=False),
+    _e(19, "tpu_edac_correctable",
+       r"(EDAC.*(\bCE\b|correct)|TPU-ERR: tpu_edac_correctable)",
+       EventType.WARNING,
+       "EDAC correctable memory error (tracked for trends)",
+       _NONE, reboot_threshold=0, critical=False),
+    # memory-anchored only: "mce: [Hardware Error]: Machine check events
+    # logged" replays at every boot on any host with MCE history and must
+    # not alarm
+    _e(17, "tpu_hbm_mce",
+       r"(Machine [Cc]heck.*(memory|HBM)|mce:.*memory (read|write|scrub)\w* error|TPU-ERR: tpu_hbm_mce)",
+       EventType.FATAL,
+       "machine-check memory error (HBM path)",
+       _REBOOT_HW, reboot_threshold=1),
     _e(12, "tpu_hbm_oom",
        r"(HBM (allocation failure|out of memory)|RESOURCE_EXHAUSTED.*HBM|TPU-ERR: tpu_hbm_oom)",
        EventType.WARNING,
        "HBM allocation failure — likely workload oversubscription",
        _APP, reboot_threshold=0, critical=False),
     # --- ICI fabric -------------------------------------------------------
+    _e(23, "tpu_ici_cable_fault",
+       r"(ICI.*cable (fault|error|unplugged)|TPU-ERR: tpu_ici_cable_fault)",
+       EventType.FATAL,
+       "ICI cable fault",
+       _HW, reboot_threshold=0),
     _e(20, "tpu_ici_link_down",
        r"(ICI (link|port).*(down|inactive|lost)|interchip interconnect.*down|TPU-ERR: tpu_ici_link_down)",
        EventType.CRITICAL,
        "ICI link down — slice fabric degraded",
+       _REBOOT_HW, reboot_threshold=2),
+    _e(28, "tpu_ici_retrain_limit",
+       r"(ICI.*retrain.*(limit|exceeded|storm)|TPU-ERR: tpu_ici_retrain_limit)",
+       EventType.CRITICAL,
+       "ICI link retrain limit exceeded — link quality failing",
+       _HW, reboot_threshold=1),
+    _e(25, "tpu_ici_width_degraded",
+       r"(ICI.*(width|lanes?).*(degrad|reduc)|TPU-ERR: tpu_ici_width_degraded)",
+       EventType.WARNING,
+       "ICI link running at reduced width",
+       _HW, reboot_threshold=2, critical=False),
+    _e(27, "tpu_ici_routing_error",
+       r"(ICI.*routing.*(error|corrupt|invalid)|TPU-ERR: tpu_ici_routing_error)",
+       EventType.CRITICAL,
+       "ICI routing error — fabric table corrupt",
+       _REBOOT, reboot_threshold=2),
+    _e(22, "tpu_ici_crc_errors",
+       r"(ICI.*CRC error|interchip.*checksum|TPU-ERR: tpu_ici_crc_errors)",
+       EventType.WARNING,
+       "ICI CRC errors — cable/connector suspect",
+       _HW, reboot_threshold=2, critical=False),
+    _e(26, "tpu_ici_port_error",
+       r"(ICI port.*(error|fault)|TPU-ERR: tpu_ici_port_error)",
+       EventType.CRITICAL,
+       "ICI port error",
        _REBOOT_HW, reboot_threshold=2),
     _e(21, "tpu_ici_link_flap",
        r"(ICI (link|port).*(flap|retrain|re-?established)|TPU-ERR: tpu_ici_link_flap)",
        EventType.WARNING,
        "ICI link flapped",
        _NONE, reboot_threshold=3, critical=False),
-    _e(22, "tpu_ici_crc_errors",
-       r"(ICI.*CRC error|interchip.*checksum|TPU-ERR: tpu_ici_crc_errors)",
-       EventType.WARNING,
-       "ICI CRC errors — cable/connector suspect",
-       _HW, reboot_threshold=2, critical=False),
-    _e(23, "tpu_ici_cable_fault",
-       r"(ICI.*cable (fault|error|unplugged)|TPU-ERR: tpu_ici_cable_fault)",
-       EventType.FATAL,
-       "ICI cable fault",
-       _HW, reboot_threshold=0),
     # --- thermal / power --------------------------------------------------
-    _e(30, "tpu_thermal_trip",
-       r"((TPU|accel).*(thermal (trip|shutdown|throttl)|overtemp)|TPU-ERR: tpu_thermal_trip)",
-       EventType.CRITICAL,
-       "TPU thermal trip/throttle",
-       _HW, reboot_threshold=2),
     _e(31, "tpu_power_fault",
        r"((TPU|accel).*(power (fault|brownout|supply failure))|TPU-ERR: tpu_power_fault)",
        EventType.FATAL,
        "TPU power delivery fault",
        _HW, reboot_threshold=1),
+    _e(34, "tpu_vrm_fault",
+       r"((VRM|voltage regulator).*(fault|overcurrent|failure)|TPU-ERR: tpu_vrm_fault)",
+       EventType.FATAL,
+       "voltage-regulator fault on TPU power path",
+       _HW, reboot_threshold=1),
+    _e(30, "tpu_thermal_trip",
+       r"((TPU|accel).*(thermal (trip|shutdown|throttl)|overtemp)|TPU-ERR: tpu_thermal_trip)",
+       EventType.CRITICAL,
+       "TPU thermal trip/throttle",
+       _HW, reboot_threshold=2),
+    _e(33, "tpu_power_throttle",
+       r"((TPU|accel).*power.*throttl|power (cap|limit).*(throttl|engaged)|TPU-ERR: tpu_power_throttle)",
+       EventType.WARNING,
+       "TPU power throttling engaged",
+       _NONE, reboot_threshold=0, critical=False),
+    # TPU-attributed lines only — generic ACPI thermal_zone trips fire on
+    # CPU/board zones of healthy hosts
+    _e(32, "tpu_thermal_warning",
+       r"((TPU|accel).*temperature.*(above|exceed|warning)|TPU-ERR: tpu_thermal_warning)",
+       EventType.WARNING,
+       "TPU temperature above warning threshold",
+       _NONE, reboot_threshold=0, critical=False),
     # --- PCIe -------------------------------------------------------------
     _e(40, "tpu_pcie_uncorrectable",
        r"(pcieport.*AER.*(uncorrect|fatal)|TPU-ERR: tpu_pcie_uncorrectable)",
        EventType.CRITICAL,
        "PCIe uncorrectable error on TPU path",
        _REBOOT_HW, reboot_threshold=2),
+    _e(43, "tpu_pcie_surprise_down",
+       r"(pcie\w*.*[Ss]urprise ([Ll]ink )?[Dd]own|TPU-ERR: tpu_pcie_surprise_down)",
+       EventType.FATAL,
+       "PCIe surprise link down — device dropped off the bus",
+       _REBOOT_HW, reboot_threshold=1, exclude=_NON_TPU_DRIVERS),
+    _e(44, "tpu_pcie_completion_timeout",
+       r"((pcie\w*|AER).*[Cc]ompletion [Tt]imeout|TPU-ERR: tpu_pcie_completion_timeout)",
+       EventType.CRITICAL,
+       "PCIe completion timeout on TPU path",
+       _REBOOT, reboot_threshold=2, exclude=_NON_TPU_DRIVERS),
+    _e(42, "tpu_pcie_link_downgrade",
+       r"(pcie.*(link.*(downgrad|degrad)|speed dropped|downtrain)|TPU-ERR: tpu_pcie_link_downgrade)",
+       EventType.WARNING,
+       "PCIe link trained below expected speed/width",
+       _HW, reboot_threshold=2, critical=False),
     _e(41, "tpu_pcie_correctable",
        r"(pcieport.*AER.*correct|TPU-ERR: tpu_pcie_correctable)",
        EventType.WARNING,
        "PCIe correctable errors on TPU path",
        _NONE, reboot_threshold=0, critical=False),
+    # --- IOMMU ------------------------------------------------------------
+    # device-attributed formats only: the generic "DMAR: DRHD: handling
+    # fault status" status line appears on healthy hosts (observed in this
+    # sandbox) and must not alarm. Even the attributed formats name a BDF
+    # the catalog cannot map to the TPU, so this stays informational —
+    # an event trail to correlate, not a health flip.
+    _e(56, "tpu_iommu_fault",
+       r"(DMAR: \[DMA (Read|Write)\].*Request device|AMD-Vi.*IO_PAGE_FAULT|iommu.*page fault.*(accel|apex|tpu)|TPU-ERR: tpu_iommu_fault)",
+       EventType.WARNING,
+       "IOMMU DMA fault (device attribution best-effort; correlate BDF with the TPU)",
+       _NONE, reboot_threshold=0, critical=False,
+       exclude=_NON_TPU_DRIVERS),
     # --- runtime ----------------------------------------------------------
     _e(50, "tpu_runtime_fatal",
        r"(libtpu.*(fatal|SIGSEGV|check failure)|tpu_runtime.*fatal|TPU-ERR: tpu_runtime_fatal)",
        EventType.CRITICAL,
        "TPU runtime (libtpu) fatal error",
        _APP, reboot_threshold=2),
+    _e(53, "tpu_runtime_init_failed",
+       r"((libtpu|TPU platform|tpu_runtime).*init\w*.*fail|TPU-ERR: tpu_runtime_init_failed)",
+       EventType.CRITICAL,
+       "TPU runtime initialization failed",
+       _REBOOT, reboot_threshold=2),
+    _e(52, "tpu_runtime_hang",
+       r"(libtpu.*(hang|stuck|deadline exceeded)|TPU runtime.*(hang|stall)|TPU-ERR: tpu_runtime_hang)",
+       EventType.CRITICAL,
+       "TPU runtime hang/stall",
+       _APP, reboot_threshold=2),
+    _e(54, "tpu_barrier_timeout",
+       r"(megascale.*barrier.*timeout|TPU-ERR: tpu_barrier_timeout)",
+       EventType.WARNING,
+       "multi-slice barrier timeout — a peer slice is slow/unreachable",
+       _APP, reboot_threshold=0, critical=False),
     _e(51, "tpu_megascale_dcn_error",
        r"(megascale.*(error|unreachable|timeout)|DCN transport.*(error|fail)|TPU-ERR: tpu_megascale_dcn_error)",
        EventType.CRITICAL,
        "multi-slice DCN transport error",
+       _APP, reboot_threshold=2, critical=False),
+    _e(55, "tpu_slice_degraded",
+       r"(slice.*(degraded|missing worker|unhealthy worker)|TPU-ERR: tpu_slice_degraded)",
+       EventType.CRITICAL,
+       "slice health degraded — worker missing/unhealthy",
        _APP, reboot_threshold=2, critical=False),
 ]
 
@@ -182,6 +348,18 @@ def lookup_code(code: int) -> Optional[CatalogEntry]:
 _CHIP_RE = re.compile(r"(?:chip[ =]?|accel)(\d+)", re.IGNORECASE)
 
 
+def extract_chip(line: str) -> Optional[int]:
+    """Best-effort chip attribution from a kmsg line (``accel3``,
+    ``chip=3``, ``chip 3``); None when the line names no chip."""
+    m = _CHIP_RE.search(line)
+    if m:
+        try:
+            return int(m.group(1))
+        except ValueError:
+            return None
+    return None
+
+
 @dataclass
 class MatchedError:
     entry: CatalogEntry
@@ -194,14 +372,9 @@ def match(line: str) -> Optional[MatchedError]:
     ordered most-specific-first within each class)."""
     for entry in CATALOG:
         if entry.pattern.search(line):
-            chip = None
-            m = _CHIP_RE.search(line)
-            if m:
-                try:
-                    chip = int(m.group(1))
-                except ValueError:
-                    chip = None
-            return MatchedError(entry=entry, chip_id=chip, raw=line)
+            if entry.exclude is not None and entry.exclude.search(line):
+                continue
+            return MatchedError(entry=entry, chip_id=extract_chip(line), raw=line)
     return None
 
 

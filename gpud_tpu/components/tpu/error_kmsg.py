@@ -37,11 +37,14 @@ UPDATE_INTERVAL = 30.0  # state re-evaluation ticker (reference: component.go 30
 
 
 def kmsg_match(line: str) -> Optional[tuple]:
-    """MatchFunc for the shared kmsg watcher."""
+    """MatchFunc for the shared kmsg watcher; forwards the chip attribution
+    the matcher extracted so evolve_health's per-chip tracks read it from
+    extra_info instead of re-parsing the line every evaluation."""
     m = catalog.match(line)
     if m is None:
         return None
-    return (m.entry.name, m.entry.event_type, line.strip())
+    extra = {"chip": str(m.chip_id)} if m.chip_id is not None else None
+    return (m.entry.name, m.entry.event_type, line.strip(), extra)
 
 
 class TPUErrorKmsgComponent(Component):
@@ -127,6 +130,11 @@ class TPUErrorKmsgComponent(Component):
                             name=m.entry.name,
                             type=m.entry.event_type,
                             message=msg.message,
+                            extra_info=(
+                                {"chip": str(m.chip_id)}
+                                if m.chip_id is not None
+                                else {}
+                            ),
                         )
                     )
             ev = evolve_health(found)

@@ -25,20 +25,43 @@ from gpud_tpu.api.v1.types import (
     RepairActionType,
     SuggestedActions,
 )
-from gpud_tpu.components.tpu.catalog import CatalogEntry, lookup
+from gpud_tpu.components.tpu.catalog import CatalogEntry, extract_chip, lookup
 
 EVENT_NAME_REBOOT = "reboot"
 EVENT_NAME_SET_HEALTHY = "SetHealthy"
 
 
+def _event_chip(ev: Event) -> Optional[int]:
+    """Chip attribution for an error event: explicit extra_info first, then
+    best-effort parse of the raw kmsg line in the message (the reference
+    tracks per-DeviceUUID the same way; xid events carry the device in
+    their payload)."""
+    raw = ev.extra_info.get("chip") if ev.extra_info else None
+    if raw is not None:
+        try:
+            return int(raw)
+        except (TypeError, ValueError):
+            pass
+    return extract_chip(ev.message or "")
+
+
 @dataclass
 class _ErrorTrack:
     entry: CatalogEntry
+    chip_id: Optional[int] = None
     occurrences: int = 0
     reboots_since_first: int = 0
     recurred_after_reboot: bool = False
     last_event: Optional[Event] = None
     pending_reboot_seen: bool = False  # a reboot happened after the last occurrence
+
+    @property
+    def display(self) -> str:
+        return (
+            f"{self.entry.name}(chip {self.chip_id})"
+            if self.chip_id is not None
+            else self.entry.name
+        )
 
 
 @dataclass
@@ -53,9 +76,13 @@ def evolve_health(merged_events: List[Event]) -> EvaluatedHealth:
     """``merged_events`` may arrive in any order; they are sorted
     oldest→newest here (reference: health_state.go:60+ walks merged reboot
     + xid events the same way). Error events must carry the catalog name in
-    ``Event.name``."""
+    ``Event.name``.
+
+    Tracks are keyed by (error name, chip id): a recurring error on chip 3
+    and a first occurrence on chip 5 escalate independently, the way the
+    reference keys on DeviceUUID (xid events carry the device)."""
     events = sorted(merged_events, key=lambda e: e.time)
-    tracks: Dict[str, _ErrorTrack] = {}
+    tracks: Dict[Tuple[str, Optional[int]], _ErrorTrack] = {}
 
     for ev in events:
         if ev.name == EVENT_NAME_SET_HEALTHY:
@@ -70,10 +97,11 @@ def evolve_health(merged_events: List[Event]) -> EvaluatedHealth:
         entry = lookup(ev.name)
         if entry is None:
             continue
-        tr = tracks.get(ev.name)
+        key = (ev.name, _event_chip(ev))
+        tr = tracks.get(key)
         if tr is None:
-            tr = _ErrorTrack(entry=entry)
-            tracks[ev.name] = tr
+            tr = _ErrorTrack(entry=entry, chip_id=key[1])
+            tracks[key] = tr
         tr.occurrences += 1
         tr.last_event = ev
         if tr.pending_reboot_seen:
@@ -87,11 +115,11 @@ def evolve_health(merged_events: List[Event]) -> EvaluatedHealth:
     # Resolution semantics: an error with a reboot after its last occurrence
     # and no recurrence is considered addressed (reference merges reboot
     # events so a clean reboot clears the suggestion path).
-    active: Dict[str, _ErrorTrack] = {}
-    for name, tr in tracks.items():
+    active: Dict[Tuple[str, Optional[int]], _ErrorTrack] = {}
+    for key, tr in tracks.items():
         if tr.pending_reboot_seen and not tr.recurred_after_reboot:
             continue  # rebooted, hasn't recurred → resolved
-        active[name] = tr
+        active[key] = tr
 
     if not active:
         return EvaluatedHealth(
@@ -104,8 +132,10 @@ def evolve_health(merged_events: List[Event]) -> EvaluatedHealth:
     descs: List[str] = []
     counts: Dict[str, int] = {}
     any_escalated = False
-    for name, tr in sorted(active.items(), key=lambda kv: -kv[1].entry.code):
-        counts[name] = tr.occurrences
+    for _key, tr in sorted(
+        active.items(), key=lambda kv: (-kv[1].entry.code, kv[0][1] is None, kv[0][1])
+    ):
+        counts[tr.display] = tr.occurrences
         if tr.entry.critical:
             worst = HealthStateType.UNHEALTHY
         escalate = (
@@ -116,17 +146,18 @@ def evolve_health(merged_events: List[Event]) -> EvaluatedHealth:
         if escalate:
             any_escalated = True
             reasons.append(
-                f"{name} recurred after {tr.reboots_since_first} reboot(s) "
+                f"{tr.display} recurred after {tr.reboots_since_first} reboot(s) "
                 f"(x{tr.occurrences})"
             )
             if RepairActionType.HARDWARE_INSPECTION not in repair:
                 repair.append(RepairActionType.HARDWARE_INSPECTION)
         else:
-            reasons.append(f"{name} (x{tr.occurrences})")
+            reasons.append(f"{tr.display} (x{tr.occurrences})")
             for act in tr.entry.repair_actions:
                 if act not in repair:
                     repair.append(act)
-        descs.append(tr.entry.description)
+        if tr.entry.description not in descs:
+            descs.append(tr.entry.description)
 
     # once an error escalated, rebooting is known not to help: replace the
     # reboot suggestion with inspection (reference: health_state.go

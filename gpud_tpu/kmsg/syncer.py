@@ -22,7 +22,8 @@ from gpud_tpu.log import get_logger
 
 logger = get_logger(__name__)
 
-# a match function returns (event_name, event_type, message) or None
+# a match function returns (event_name, event_type, message) or
+# (event_name, event_type, message, extra_info_dict) or None
 MatchFunc = Callable[[str], Optional[tuple]]
 
 
@@ -45,16 +46,18 @@ class Syncer:
         matched = self.match_fn(msg.message)
         if matched is None:
             return None
-        name, ev_type, text = matched
+        name, ev_type, text = matched[:3]
+        extra = dict(matched[3]) if len(matched) > 3 and matched[3] else {}
         if self.deduper.seen_before(msg.message, msg.time):
             return None
+        extra.update({"kmsg": msg.message, "priority": msg.priority_name})
         ev = Event(
             component=self.bucket.name(),
             time=msg.time,
             name=name,
             type=ev_type,
             message=text,
-            extra_info={"kmsg": msg.message, "priority": msg.priority_name},
+            extra_info=extra,
         )
         # event-level dedupe against the store as well (restart safety;
         # reference: xid/component.go:545-570 Find-before-Insert)

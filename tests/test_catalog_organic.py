@@ -1,0 +1,213 @@
+"""Catalog coverage with organic kmsg formats (not just TPU-ERR: injection
+lines) — every entry must match at least one realistic driver/kernel line,
+and first-hit-wins ordering must keep substring-colliding entries apart
+(reference: xid catalog tests over real NVRM lines)."""
+
+import pytest
+
+from gpud_tpu.api.v1.types import HealthStateType, RepairActionType
+from gpud_tpu.components.tpu import catalog
+from gpud_tpu.components.tpu.health_state import evolve_health
+from gpud_tpu.api.v1.types import Event
+
+# entry name → organic sample lines (driver/kernel vocabulary, no TPU-ERR:)
+ORGANIC = {
+    "tpu_chip_lost": [
+        "accel3: device lost, marking offline",
+        "accel0: PCI device fell off the bus",
+    ],
+    "tpu_driver_crash": [
+        "accel1: firmware crash detected, dumping state",
+        "google_tpu: kernel BUG at drivers/accel/tpu.c:1024",
+    ],
+    "tpu_reset_failed": [
+        "accel2: chip reset failed after 3 attempts",
+        "apex 0000:00:05.0: reset timed out",
+    ],
+    "tpu_chip_reset_required": ["accel0: reset required to recover"],
+    "tpu_sram_parity": ["accel0: SRAM parity error in vector memory bank 2"],
+    "tpu_core_wedged": ["accel1: TensorCore wedged, initiating recovery"],
+    "tpu_scalar_core_fault": ["accel0: scalar core halt at pc=0x4ac0"],
+    "tpu_page_fault": [
+        "accel0: MMU fault on read at 0xdeadbeef",
+        "gasket gasket0: page table error mapping host memory",
+    ],
+    "tpu_interrupt_timeout": [
+        "accel2: interrupt timeout waiting for completion",
+        "gasket: MSI-X vector 4 lost",
+    ],
+    "tpu_dma_error": ["apex 0000:00:05.0: DMA error on channel 1"],
+    "tpu_firmware_load_failed": ["accel0: firmware image load failed (-110)"],
+    "tpu_driver_init_failed": ["gasket: apex probe failed with -12"],
+    "tpu_driver_timeout": ["accel0: ioctl timeout after 5000ms"],
+    "tpu_hbm_ecc_uncorrectable": [
+        "accel1: uncorrectable HBM ECC error at bank 3",
+        "HBM2e channel 4: double-bit ECC error",
+    ],
+    "tpu_edac_uncorrectable": ["EDAC MC0: 1 UE memory read error on chip 2"],
+    "tpu_hbm_row_remap_pending": ["accel0: HBM row 0x1f2 remap pending reboot"],
+    "tpu_hbm_ecc_correctable": ["accel2: correctable HBM ECC error, count=14"],
+    "tpu_edac_correctable": ["EDAC MC0: 7 CE memory scrub corrected"],
+    "tpu_hbm_mce": ["mce: [Hardware Error]: Machine Check: memory read error bank 5"],
+    "tpu_hbm_oom": ["libtpu: RESOURCE_EXHAUSTED: failed to allocate 2.1G in HBM"],
+    "tpu_ici_cable_fault": ["ICI: cable fault on connector J4"],
+    "tpu_ici_link_down": [
+        "ICI link 5 down on chip 2",
+        "accel0: interchip interconnect trunk down",
+    ],
+    "tpu_ici_retrain_limit": ["ICI link 1 retrain limit exceeded (32 in 60s)"],
+    "tpu_ici_width_degraded": ["ICI link 0 width degraded to 2 lanes"],
+    "tpu_ici_routing_error": ["ICI fabric routing table corrupt, entry 0x40"],
+    "tpu_ici_crc_errors": ["ICI link 3: CRC error burst, 1024 in window"],
+    "tpu_ici_port_error": ["ICI port 2 error: remote not responding"],
+    "tpu_ici_link_flap": ["ICI link 4 retrained, speed restored"],
+    "tpu_power_fault": ["accel0: power fault on 12V rail"],
+    "tpu_vrm_fault": ["VRM overcurrent on TPU socket 1"],
+    "tpu_thermal_trip": ["accel1: thermal throttle engaged at 96C"],
+    "tpu_power_throttle": ["power cap throttling engaged for package 0"],
+    "tpu_thermal_warning": ["accel0: temperature above warning threshold (88C)"],
+    "tpu_pcie_uncorrectable": [
+        "pcieport 0000:00:04.0: AER: Uncorrected (Fatal) error received"
+    ],
+    "tpu_pcie_surprise_down": ["pcieport 0000:00:04.0: Surprise Down error"],
+    "tpu_pcie_completion_timeout": [
+        "pcieport 0000:00:04.0: AER: Completion Timeout (First)"
+    ],
+    "tpu_pcie_link_downgrade": [
+        "pcie 0000:00:04.0: link speed dropped to 8.0 GT/s"
+    ],
+    "tpu_pcie_correctable": [
+        "pcieport 0000:00:04.0: AER: Corrected error received"
+    ],
+    "tpu_iommu_fault": [
+        "DMAR: [DMA Read] Request device [00:05.0] fault addr 0xfffff000",
+        "AMD-Vi: Event logged [IO_PAGE_FAULT device=00:05.0 domain=0x000a]",
+    ],
+    "tpu_runtime_fatal": ["libtpu.so: check failure: tile assignment invalid"],
+    "tpu_runtime_init_failed": ["libtpu: TPU platform initialization failed"],
+    "tpu_runtime_hang": ["libtpu: execution deadline exceeded, stack dump follows"],
+    "tpu_barrier_timeout": ["megascale: barrier timeout waiting for slice 3"],
+    "tpu_megascale_dcn_error": ["megascale: peer slice unreachable via DCN"],
+    "tpu_slice_degraded": ["slice health: missing worker 12 of 16"],
+}
+
+
+def test_catalog_size_and_coverage_table_complete():
+    assert len(catalog.CATALOG) >= 40
+    assert set(ORGANIC) == {e.name for e in catalog.CATALOG}
+
+
+@pytest.mark.parametrize("name", sorted(ORGANIC))
+def test_organic_lines_match_expected_entry(name):
+    for line in ORGANIC[name]:
+        m = catalog.match(line)
+        assert m is not None, f"no match for organic line: {line!r}"
+        assert m.entry.name == name, (
+            f"{line!r} matched {m.entry.name}, expected {name}"
+        )
+
+
+@pytest.mark.parametrize("name", sorted(ORGANIC))
+def test_injection_lines_match_their_entry(name):
+    m = catalog.match(catalog.injection_line(name, chip_id=3))
+    assert m is not None and m.entry.name == name
+    assert m.chip_id == 3
+
+
+def test_substring_collisions_resolved_by_order():
+    # "uncorrectable" contains "correctable"; UE before CE; retrain limit
+    # before the generic retrain/flap entry
+    assert catalog.match("HBM uncorrectable ECC").entry.name == "tpu_hbm_ecc_uncorrectable"
+    assert catalog.match("EDAC MC0: UE error").entry.name == "tpu_edac_uncorrectable"
+    assert (
+        catalog.match("ICI link 0 retrain limit exceeded").entry.name
+        == "tpu_ici_retrain_limit"
+    )
+    assert catalog.match("ICI link 0 retrained ok").entry.name == "tpu_ici_link_flap"
+
+
+# benign host-wide kernel lines that used to (or could) false-positive —
+# none may match any catalog entry
+BENIGN = [
+    "mce: [Hardware Error]: Machine check events logged",
+    "mce: [Hardware Error]: CPU 2: Machine Check: 0 Bank 6: status",
+    "nvme 0000:01:00.0: AER: Completion Timeout error",
+    "pcieport 0000:00:1c.5: nvme: Surprise Down Error (First)",
+    "thermal thermal_zone0: trip point 1 crossed",
+    "DMAR: DRHD: handling fault status reg 2",
+    "DMAR: [DMA Read] Request device [02:00.0] nvme fault addr 0x0",
+    "xhci_hcd 0000:00:14.0: Completion Timeout on ep 0x81",
+]
+
+
+@pytest.mark.parametrize("line", BENIGN)
+def test_benign_host_lines_do_not_match(line):
+    m = catalog.match(line)
+    assert m is None, f"{line!r} misclassified as {m.entry.name if m else ''}"
+
+
+def test_chip_extraction_variants():
+    assert catalog.extract_chip("accel7: device lost") == 7
+    assert catalog.extract_chip("error on chip 3 bank 1") == 3
+    assert catalog.extract_chip("TPU-ERR: x chip=5") == 5
+    assert catalog.extract_chip("no chip here") is None
+
+
+# ---------------------------------------------------------------------------
+# per-chip escalation (VERDICT: two-chip scenario, independent tracks)
+# ---------------------------------------------------------------------------
+
+def _err(name, t, chip=None):
+    msg = f"accel{chip}: synthetic" if chip is not None else "synthetic"
+    return Event(component="x", time=t, name=name, type="Fatal", message=msg)
+
+
+def _reboot(t):
+    return Event(component="x", time=t, name="reboot", type="Warning", message="")
+
+
+def test_two_chips_escalate_independently():
+    """chip 0: error → reboot → recurrence (escalates to HW inspection);
+    chip 1: single first occurrence of the same error name (reboot only).
+    One shared reboot affects both tracks, but only chip 0 recurred."""
+    evs = [
+        _err("tpu_chip_lost", 100, chip=0),
+        _reboot(200),
+        _err("tpu_chip_lost", 300, chip=0),  # recurred after 1 reboot
+        _reboot(400),
+        _err("tpu_chip_lost", 500, chip=0),  # recurred after 2 reboots ⇒ escalate
+        _err("tpu_chip_lost", 550, chip=1),  # fresh on chip 1
+    ]
+    out = evolve_health(evs)
+    assert out.health == HealthStateType.UNHEALTHY
+    assert "tpu_chip_lost(chip 0) recurred after 2 reboot(s)" in out.reason
+    assert "tpu_chip_lost(chip 1) (x1)" in out.reason
+    assert out.active_errors["tpu_chip_lost(chip 0)"] == 3
+    assert out.active_errors["tpu_chip_lost(chip 1)"] == 1
+    # escalation strips the reboot suggestion
+    assert RepairActionType.HARDWARE_INSPECTION in out.suggested_actions.repair_actions
+    assert RepairActionType.REBOOT_SYSTEM not in out.suggested_actions.repair_actions
+
+
+def test_reboot_resolves_only_non_recurring_chip():
+    """chip 0 recurs after the reboot, chip 1 does not: chip 1's track is
+    resolved, chip 0 stays active."""
+    evs = [
+        _err("tpu_chip_lost", 100, chip=0),
+        _err("tpu_chip_lost", 110, chip=1),
+        _reboot(200),
+        _err("tpu_chip_lost", 300, chip=0),
+    ]
+    out = evolve_health(evs)
+    assert "chip 0" in out.reason
+    assert "chip 1" not in out.reason
+    assert list(out.active_errors) == ["tpu_chip_lost(chip 0)"]
+
+
+def test_chipless_events_share_one_track():
+    evs = [
+        _err("tpu_runtime_fatal", 100),
+        _err("tpu_runtime_fatal", 200),
+    ]
+    out = evolve_health(evs)
+    assert out.active_errors == {"tpu_runtime_fatal": 2}
