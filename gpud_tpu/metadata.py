@@ -27,6 +27,9 @@ KEY_EXPECTED_CHIP_COUNT = "expected_chip_count"
 KEY_ACCELERATOR_TYPE = "accelerator_type"
 KEY_ICI_THRESHOLDS = "ici_thresholds"  # legacy name, unused
 KEY_CONFIG_OVERRIDES = "config_overrides"
+# persisted auth-failure record (reference: session auth-failure
+# persistence, session_v2.go:359): "<unix_ts>|<reason>"
+KEY_LAST_AUTH_FAILURE = "last_auth_failure"
 
 
 class Metadata:

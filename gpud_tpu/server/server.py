@@ -13,6 +13,7 @@ import asyncio
 import os
 import stat
 import threading
+import time
 from typing import List, Optional
 
 from aiohttp import web
@@ -339,6 +340,11 @@ class Server:
                 token=token,
                 machine_proof=self.metadata.get(md.KEY_MACHINE_PROOF),
                 dispatch_fn=self.dispatcher,
+            )
+            # persist auth failures so operators can distinguish "control
+            # plane revoked us" from network flakiness across restarts
+            self.session.on_auth_failure = lambda reason: self.metadata.set(
+                md.KEY_LAST_AUTH_FAILURE, f"{int(time.time())}|{reason[:200]}"
             )
             self.session.start()
             logger.info("control-plane session started to %s", endpoint)

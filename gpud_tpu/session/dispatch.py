@@ -35,6 +35,10 @@ DEFAULT_BOOTSTRAP_TIMEOUT = 10 * 60.0
 # exit code asking the supervisor (systemd/DaemonSet) to restart us with
 # new plugin specs (reference: session_process_request.go:137-141)
 RESTART_EXIT_CODE = 245
+# a finished diagnostic bundle answers matching re-polls for this long;
+# far above the CP poll cadence (so a script runs once per request) but
+# bounded so a later identical request gets fresh data
+DIAGNOSTIC_CACHE_SECONDS = 300.0
 
 
 class Dispatcher:
@@ -47,6 +51,7 @@ class Dispatcher:
 
         self.exit_fn: Callable[[int], None] = _os._exit  # noqa: SLF001
         self._gossip_inflight = threading.Event()
+        self._diagnostic_inflight = threading.Event()
 
     def __call__(self, req: Dict) -> Dict:
         method = req.get("method", "")
@@ -120,6 +125,83 @@ class Dispatcher:
             result["status"] = "ok"
         return result
 
+    @staticmethod
+    def _decode_script(b64: str):
+        """Shared base64-script decode → (script, error) (bootstrap +
+        diagnostic use the same contract)."""
+        try:
+            script = base64.b64decode(b64, validate=True).decode("utf-8")
+        except Exception:  # noqa: BLE001
+            return "", "invalid base64 script"
+        if not script.strip():
+            return "", "empty script"
+        return script, None
+
+    @staticmethod
+    def _script_result(r) -> Dict:
+        return {"exit_code": r.exit_code, "output": r.output[-4096:], "error": r.error}
+
+    def _m_diagnostic(self, req: Dict) -> Dict:
+        """Diagnostic bundle: states + recent events + machine info, plus an
+        optional base64 diagnostic script (reference:
+        session_process_request.go:104). Async like gossip — collection can
+        hang on NFS stat or a slow script, so the serve loop returns
+        immediately and the control plane re-polls for the finished bundle.
+
+        Scripted requests are answered only by a bundle produced for the
+        SAME script (matched on the base64), and a finished bundle is not
+        re-collected by the completion poll — a non-idempotent diagnostic
+        script must run exactly once per request."""
+        b64 = req.get("script_base64", "")
+        script = ""
+        if b64:
+            script, err = self._decode_script(b64)
+            if err:
+                return {"error": err}
+        since = float(req.get("since", time.time() - 3 * 3600))
+        timeout = float(req.get("timeout_seconds", DEFAULT_BOOTSTRAP_TIMEOUT))
+
+        last = getattr(self.server, "last_diagnostic", None)
+        if (
+            last
+            and last.get("script_b64", "") == b64
+            and time.time() - last.get("collected_at", 0) < DIAGNOSTIC_CACHE_SECONDS
+        ):
+            # this exact request already has a fresh finished bundle; a
+            # repeat request after the cache window re-collects (and
+            # re-runs the script — that recurrence is a new intent)
+            return {"status": "ok", "diagnostic": last}
+        if self._diagnostic_inflight.is_set():
+            return {"status": "busy" if script else "started"}
+
+        def work():
+            try:
+                bundle: Dict = {"collected_at": time.time(), "script_b64": b64}
+                bundle["states"] = self._m_states({})["states"]
+                bundle["events"] = self._m_events({"since": since})["events"]
+                try:
+                    mi = machineinfo.get_machine_info(
+                        tpu=self.server.tpu_instance,
+                        machine_id=self.server.machine_id,
+                    )
+                    bundle["machine_info"] = mi.to_dict()
+                except Exception as e:  # noqa: BLE001
+                    bundle["machine_info_error"] = str(e)
+                if script:
+                    audit("diagnostic_script", length=len(script))
+                    bundle["script"] = self._script_result(
+                        run_bash_script(script, timeout=timeout)
+                    )
+                self.server.last_diagnostic = bundle
+            except Exception:  # noqa: BLE001
+                logger.exception("diagnostic bundle failed")
+            finally:
+                self._diagnostic_inflight.clear()
+
+        self._diagnostic_inflight.set()
+        threading.Thread(target=work, daemon=True).start()
+        return {"status": "started"}
+
     # -- actions -----------------------------------------------------------
     def _m_reboot(self, req: Dict) -> Dict:
         delay = float(req.get("delay_seconds", 0))
@@ -180,21 +262,12 @@ class Dispatcher:
 
     def _m_bootstrap(self, req: Dict) -> Dict:
         """base64 script exec (reference: session bootstrap)."""
-        b64 = req.get("script_base64", "")
-        try:
-            script = base64.b64decode(b64, validate=True).decode("utf-8")
-        except Exception:  # noqa: BLE001
-            return {"error": "invalid base64 script"}
-        if not script.strip():
-            return {"error": "empty script"}
+        script, err = self._decode_script(req.get("script_base64", ""))
+        if err:
+            return {"error": err}
         timeout = float(req.get("timeout_seconds", DEFAULT_BOOTSTRAP_TIMEOUT))
         audit("bootstrap_script", length=len(script))
-        r = run_bash_script(script, timeout=timeout)
-        return {
-            "exit_code": r.exit_code,
-            "output": r.output[-4096:],
-            "error": r.error,
-        }
+        return self._script_result(run_bash_script(script, timeout=timeout))
 
     # -- config/token ------------------------------------------------------
     def _m_updateConfig(self, req: Dict) -> Dict:

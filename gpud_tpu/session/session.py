@@ -38,6 +38,47 @@ PIPE_INTERVAL = 3.0       # reference: server.go:616
 BACKOFF_INITIAL = 1.0
 BACKOFF_MAX = 60.0
 BACKOFF_FACTOR = 2.0
+# while auth-parked, how often to re-check whether the token changed
+AUTH_RECHECK_INTERVAL = 5.0
+
+# anchored so incidental digits ("port=4013") and local OS errors
+# ("[Errno 13] Permission denied") never classify as auth failures
+import re as _re
+
+_AUTH_ERROR_RE = _re.compile(
+    r"(\b40[13]\b"
+    r"|unauthenticated"
+    r"|unauthorized"
+    r"|permission_denied"     # grpc enum spelling only, not OS errors
+    r"|invalid token"
+    r"|invalid machine proof)",
+    _re.IGNORECASE,
+)
+
+
+def is_auth_error(reason) -> bool:
+    """Classify a connect failure as an auth failure (revoked/invalid
+    token) vs a network blip (reference: session_reconnect.go:38-226 +
+    session_v2.go:359 classify Unauthenticated/401). Prefers structured
+    fields (HTTP status, grpc code); text matching is anchored."""
+    resp = getattr(reason, "response", None)
+    if resp is not None:
+        code = getattr(resp, "status_code", None)
+        if code in (401, 403):
+            return True
+        if code is not None:
+            return False  # a definite non-auth HTTP status
+    code_fn = getattr(reason, "code", None)
+    if callable(code_fn):
+        try:  # grpc.RpcError
+            name = getattr(code_fn(), "name", "")
+            if name in ("UNAUTHENTICATED", "PERMISSION_DENIED"):
+                return True
+            if name:
+                return False  # a definite non-auth grpc code
+        except Exception:  # noqa: BLE001
+            pass
+    return bool(_AUTH_ERROR_RE.search(str(reason)))
 
 HEADER_SESSION_TYPE = "X-TPUD-Session-Type"
 HEADER_MACHINE_ID = "X-TPUD-Machine-ID"
@@ -99,6 +140,11 @@ class Session:
         self._connected = threading.Event()
         self.reconnect_count = 0
         self.last_connect_error: str = ""
+        # auth-failure classification (reference: session_reconnect.go
+        # 38-226): a revoked token parks the reconnect loop instead of
+        # hammering the control plane with the normal backoff forever
+        self.auth_failed = False
+        self.on_auth_failure: Optional[Callable[[str], None]] = None
 
         # protocol auto: try v2 gRPC, fall back to legacy v1 dual streams
         # (reference: session_v2.go:49-80); injected transports pin v1
@@ -146,6 +192,11 @@ class Session:
             except Exception as e:  # noqa: BLE001
                 self.last_connect_error = str(e)
                 logger.warning("session connect failed: %s", e)
+                if is_auth_error(e):
+                    if self._park_on_auth_failure(str(e)):
+                        return
+                    backoff = BACKOFF_INITIAL
+                    continue
                 if self.time_sleep_fn(self.jitter_fn(backoff)):
                     return
                 backoff = min(backoff * BACKOFF_FACTOR, BACKOFF_MAX)
@@ -163,9 +214,37 @@ class Session:
                     pass
             if self._stop.is_set():
                 return
+            # a 401/Unauthenticated may also arrive mid-stream via
+            # signal_reconnect's reason rather than a connect exception
+            if is_auth_error(self.last_connect_error):
+                if self._park_on_auth_failure(self.last_connect_error):
+                    return
+                backoff = BACKOFF_INITIAL
+                continue
             if self.time_sleep_fn(self.jitter_fn(backoff)):
                 return
             backoff = min(backoff * BACKOFF_FACTOR, BACKOFF_MAX)
+
+    def _park_on_auth_failure(self, reason: str) -> bool:
+        """Suspend reconnecting until the token changes (new token via
+        updateToken/FIFO) or the session stops. Returns True when stop was
+        requested (caller should exit the keep-alive loop)."""
+        self.auth_failed = True
+        failed_token = self.token
+        logger.warning(
+            "session auth failure (%s); suspending reconnect until the "
+            "token changes", reason,
+        )
+        if self.on_auth_failure is not None:
+            try:
+                self.on_auth_failure(reason)
+            except Exception:  # noqa: BLE001
+                logger.exception("on_auth_failure callback failed")
+        while not self._stop.is_set() and self.token == failed_token:
+            if self.time_sleep_fn(AUTH_RECHECK_INTERVAL):
+                return True
+        self.auth_failed = False
+        return self._stop.is_set()
 
     def _connect(self):
         """Open the transport per protocol preference; returns stop fns."""

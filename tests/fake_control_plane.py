@@ -22,6 +22,8 @@ class FakeControlPlane:
         self._started = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.connected = threading.Event()
+        self.reject_auth = False   # return 401 on session streams
+        self.auth_rejects = 0
 
     # -- server ------------------------------------------------------------
     async def _login(self, req: web.Request) -> web.Response:
@@ -36,6 +38,9 @@ class FakeControlPlane:
         )
 
     async def _session(self, req: web.Request) -> web.StreamResponse:
+        if self.reject_auth:
+            self.auth_rejects += 1
+            return web.Response(status=401, text="unauthorized")
         stype = req.headers.get("X-TPUD-Session-Type", "")
         machine = req.headers.get("X-TPUD-Machine-ID", "")
         if stype == "read":

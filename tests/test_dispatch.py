@@ -229,3 +229,65 @@ def test_gossip(dispatch):
             return
         time.sleep(0.05)
     raise AssertionError("gossip never completed")
+
+
+def test_diagnostic_bundle(dispatch, srv):
+    out1 = dispatch({"method": "diagnostic"})
+    assert out1["status"] in ("started", "ok")
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        out2 = dispatch({"method": "diagnostic"})
+        if out2["status"] == "ok":
+            d = out2["diagnostic"]
+            assert d["states"] and isinstance(d["states"], list)
+            assert isinstance(d["events"], list)
+            assert d["machine_info"]["machine_id"] or "machine_info_error" in d
+            assert "collected_at" in d
+            return
+        time.sleep(0.05)
+    raise AssertionError("diagnostic never completed")
+
+
+def test_diagnostic_with_script_runs_exactly_once(dispatch, srv, tmp_path):
+    """Re-polling a scripted diagnostic must return the finished bundle
+    with the script output, without re-executing the script."""
+    srv.last_diagnostic = None
+    marker = tmp_path / "runs"
+    raw = f"echo run >> {marker}; echo diag-ok"
+    script = base64.b64encode(raw.encode()).decode()
+    deadline = time.time() + 5
+    got = None
+    while time.time() < deadline:
+        out = dispatch({"method": "diagnostic", "script_base64": script})
+        if out.get("status") == "ok":
+            got = out["diagnostic"]
+            break
+        assert out.get("status") in ("started", "busy")
+        time.sleep(0.05)
+    assert got is not None, "diagnostic script never completed"
+    assert got["script"]["exit_code"] == 0
+    assert "diag-ok" in got["script"]["output"]
+    # a few more completion polls — the script must not run again
+    for _ in range(3):
+        out = dispatch({"method": "diagnostic", "script_base64": script})
+        assert out["status"] == "ok"
+    assert marker.read_text().count("run") == 1
+
+
+def test_diagnostic_script_not_answered_by_scriptless_bundle(dispatch, srv):
+    srv.last_diagnostic = {"collected_at": time.time(), "script_b64": ""}
+    script = base64.b64encode(b"true").decode()
+    out = dispatch({"method": "diagnostic", "script_base64": script})
+    # stale scriptless cache must not satisfy a scripted request
+    assert out.get("status") in ("started", "busy")
+    assert "diagnostic" not in out
+
+
+def test_diagnostic_rejects_bad_script(dispatch):
+    assert "error" in dispatch(
+        {"method": "diagnostic", "script_base64": "!!notb64!!"}
+    )
+    empty = base64.b64encode(b"  \n").decode()
+    assert dispatch({"method": "diagnostic", "script_base64": empty}) == {
+        "error": "empty script"
+    }

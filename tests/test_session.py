@@ -126,3 +126,95 @@ def test_frame_json_roundtrip():
     assert back.req_id == "a" and back.data == {"x": 1}
     assert Frame.from_json("not json") is None
     assert Frame.from_json('"a string"') is None
+
+
+class AuthFailTransport(LoopbackTransport):
+    """Rejects connects with a 401-shaped error until the token changes."""
+
+    def __init__(self, good_token="t2"):
+        super().__init__()
+        self.good_token = good_token
+
+    def start_reader(self, session):
+        self.connects += 1
+        self._session = session
+        if session.token != self.good_token:
+            raise ConnectionError("HTTP 401 Unauthorized: invalid token")
+
+        def stop():
+            self.reader_stops += 1
+
+        return stop
+
+
+def test_auth_failure_parks_reconnect_until_token_changes():
+    """A revoked token must not cause a retry storm (reference:
+    session_reconnect.go:38-226): the loop parks, records the failure,
+    and resumes only when the token changes (updateToken path)."""
+    from gpud_tpu.session.session import AUTH_RECHECK_INTERVAL  # noqa: F401
+
+    tr = AuthFailTransport(good_token="t2")
+    failures = []
+    s = _mk_session(tr)
+    s.on_auth_failure = failures.append
+    # fast park loop for the test
+    s.time_sleep_fn = lambda secs: s._stop.wait(min(secs, 0.02))
+    s.start()
+    assert _wait(lambda: s.auth_failed)
+    connects_at_park = tr.connects
+    # parked: no further connect attempts while the token is unchanged
+    time.sleep(0.3)
+    assert tr.connects == connects_at_park, "retry storm while auth-parked"
+    assert failures and "401" in failures[0]
+    # token rotated (what _m_updateToken / the FIFO does) → reconnects
+    s.token = "t2"
+    assert _wait(lambda: s.connected)
+    assert not s.auth_failed
+    s.stop()
+
+
+def test_network_errors_still_retry_with_backoff():
+    tr = LoopbackTransport(fail_connects=3)
+    s = _mk_session(tr)
+    s.time_sleep_fn = lambda secs: s._stop.wait(min(secs, 0.02))
+    s.start()
+    assert _wait(lambda: s.connected)
+    assert tr.connects >= 4
+    assert not s.auth_failed
+    s.stop()
+
+
+def test_is_auth_error_classification():
+    from gpud_tpu.session.session import is_auth_error
+
+    class Resp:
+        status_code = 401
+
+    class HTTPError(Exception):
+        def __init__(self):
+            self.response = Resp()
+
+    assert is_auth_error(HTTPError())
+    assert is_auth_error("grpc UNAUTHENTICATED: bad creds")
+    assert is_auth_error("403 Forbidden")
+    assert not is_auth_error("connection refused")
+    assert not is_auth_error("read timeout")
+
+
+def test_is_auth_error_rejects_lookalikes():
+    from gpud_tpu.session.session import is_auth_error
+
+    # incidental digits and OS permission errors are NOT auth failures
+    assert not is_auth_error("connection refused to http://cp:4013/api")
+    assert not is_auth_error("[Errno 13] Permission denied: '/var/run/x'")
+    # a definite non-auth HTTP status short-circuits text matching
+    class Resp:
+        status_code = 503
+    class HTTPError(Exception):
+        def __init__(self):
+            self.response = Resp()
+        def __str__(self):
+            return "503 unavailable (was 401 earlier)"
+    assert not is_auth_error(HTTPError())
+    # anchored matches still hit
+    assert is_auth_error("401 Client Error: Unauthorized for url")

@@ -86,3 +86,58 @@ def test_set_healthy_over_session(stack):
     )
     resp = cp.wait_response("q4")
     assert resp["data"]["status"] == "ok"
+
+
+def test_diagnostic_over_session(stack):
+    cp, srv = stack
+    cp.connected.wait(10)
+    deadline = time.time() + 8
+    while time.time() < deadline:
+        rid = f"qd{int(time.time() * 1000)}"
+        cp.send_request("e2e-machine", rid, {"method": "diagnostic"})
+        resp = cp.wait_response(rid)
+        assert resp is not None
+        if resp["data"].get("status") == "ok":
+            d = resp["data"]["diagnostic"]
+            assert d["states"] and "collected_at" in d
+            return
+        time.sleep(0.1)
+    raise AssertionError("diagnostic never completed over the session")
+
+
+def test_auth_park_over_real_http(tmp_path):
+    """Revoked token against the real HTTP transport: the session must
+    classify the 401, stop retrying, and resume after a token rotation."""
+    from gpud_tpu.session.session import Session
+
+    cp = FakeControlPlane()
+    cp.reject_auth = True
+    cp.start()
+    try:
+        s = Session(
+            endpoint=f"http://127.0.0.1:{cp.port}",
+            machine_id="auth-m",
+            token="revoked",
+            dispatch_fn=lambda req: {"ok": True},
+            jitter_fn=lambda b: 0.01,
+            protocol="v1",
+        )
+        s.time_sleep_fn = lambda secs: s._stop.wait(min(secs, 0.05))
+        s.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not s.auth_failed:
+            time.sleep(0.01)
+        assert s.auth_failed, "401 not classified as auth failure"
+        rejects_at_park = cp.auth_rejects
+        time.sleep(0.5)
+        assert cp.auth_rejects == rejects_at_park, "retry storm on 401"
+        # token rotated and access restored
+        cp.reject_auth = False
+        s.token = "fresh"
+        deadline = time.time() + 5
+        while time.time() < deadline and not s.connected:
+            time.sleep(0.01)
+        assert s.connected and not s.auth_failed
+        s.stop()
+    finally:
+        cp.stop()
