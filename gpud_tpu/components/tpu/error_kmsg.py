@@ -58,6 +58,8 @@ class TPUErrorKmsgComponent(Component):
         )
         self.reboot_event_store = instance.reboot_event_store
         self.lookback_seconds = DEFAULT_LOOKBACK_SECONDS
+        # per-error-name reboot-threshold overrides pushed via updateConfig
+        self.reboot_threshold_overrides: dict = {}
         self.time_now_fn = time.time
         self._stop = threading.Event()
         self._ticker: Optional[threading.Thread] = None
@@ -137,14 +139,14 @@ class TPUErrorKmsgComponent(Component):
                             ),
                         )
                     )
-            ev = evolve_health(found)
+            ev = evolve_health(found, self.reboot_threshold_overrides)
             return CheckResult(
                 self.NAME,
                 health=ev.health,
                 reason=ev.reason or "no TPU errors in kmsg ring buffer",
                 suggested_actions=ev.suggested_actions,
             )
-        ev = evolve_health(self._merged_events())
+        ev = evolve_health(self._merged_events(), self.reboot_threshold_overrides)
         extra = {name: str(n) for name, n in ev.active_errors.items()}
         return CheckResult(
             self.NAME,

@@ -72,7 +72,10 @@ class EvaluatedHealth:
     active_errors: Dict[str, int] = field(default_factory=dict)
 
 
-def evolve_health(merged_events: List[Event]) -> EvaluatedHealth:
+def evolve_health(
+    merged_events: List[Event],
+    threshold_overrides: Optional[Dict[str, int]] = None,
+) -> EvaluatedHealth:
     """``merged_events`` may arrive in any order; they are sorted
     oldest→newest here (reference: health_state.go:60+ walks merged reboot
     + xid events the same way). Error events must carry the catalog name in
@@ -138,10 +141,16 @@ def evolve_health(merged_events: List[Event]) -> EvaluatedHealth:
         counts[tr.display] = tr.occurrences
         if tr.entry.critical:
             worst = HealthStateType.UNHEALTHY
+        # control-plane-pushed per-error-name thresholds win over the
+        # catalog default (reference: XID thresholds via updateConfig,
+        # session.go:222-227)
+        thr = (threshold_overrides or {}).get(
+            tr.entry.name, tr.entry.reboot_threshold
+        )
         escalate = (
-            tr.entry.reboot_threshold > 0
+            thr > 0
             and tr.recurred_after_reboot
-            and tr.reboots_since_first >= tr.entry.reboot_threshold
+            and tr.reboots_since_first >= thr
         )
         if escalate:
             any_escalated = True

@@ -25,6 +25,7 @@ from gpud_tpu.api.v1.types import (
 )
 from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
 from gpud_tpu.components.tpu.ici_store import ICIStore, ScanResult
+from gpud_tpu.metadata import KEY_ICI_MAX_LINKS_SEEN, Metadata
 from gpud_tpu.components.tpu.shared import sampler_for
 from gpud_tpu.metrics.registry import gauge
 
@@ -65,7 +66,22 @@ class TPUICIComponent(PollingComponent):
         self.auto_clear_window = DEFAULT_AUTO_CLEAR_WINDOW
         self.time_now_fn = time.time
         self._last_purge = 0.0
+        # explicit expected-link-count override (pushed via updateConfig);
+        # 0 = derive from topology / observed high-water mark
+        self.expected_links = 0
+        # high-water mark persists in metadata: a daemon restart on a host
+        # with partial driver exposure must not forget that more links were
+        # once visible (a vanished link still alarms after restart)
+        self._metadata = None
         self._max_links_seen = 0
+        if instance.db_rw is not None:
+            self._metadata = Metadata(instance.db_rw)
+            try:
+                self._max_links_seen = int(
+                    self._metadata.get(KEY_ICI_MAX_LINKS_SEEN) or 0
+                )
+            except ValueError:
+                self._max_links_seen = 0
 
     def is_supported(self) -> bool:
         return (
@@ -81,11 +97,21 @@ class TPUICIComponent(PollingComponent):
         baseline is the most links ever observed — a link *vanishing* from
         a previously-larger set still alarms, but a consistently partial
         mapping doesn't page operators forever."""
+        if self.expected_links > 0:
+            # operator/control-plane pinned the expectation (e.g. after a
+            # legitimately smaller re-deployment) — overrides both the
+            # topology estimate and the observed high-water mark
+            return self.expected_links
         topo = self.tpu.topology() if self.tpu else None
         if topo is None:
             return 0
         topo_expected = len(self.tpu.devices()) * topo.ici_links_per_chip
-        self._max_links_seen = max(self._max_links_seen, reported)
+        if reported > self._max_links_seen:
+            self._max_links_seen = reported
+            if self._metadata is not None:
+                self._metadata.set(
+                    KEY_ICI_MAX_LINKS_SEEN, str(self._max_links_seen)
+                )
         if self._max_links_seen >= topo_expected:
             return topo_expected
         return self._max_links_seen
@@ -250,7 +276,11 @@ class TPUICIComponent(PollingComponent):
 
     def set_healthy(self) -> None:
         """Tombstone all link history so the scan starts fresh
-        (reference: IB tombstone on admin action)."""
+        (reference: IB tombstone on admin action). Deliberately does NOT
+        touch the expected-links baseline: clearing a flap alarm must not
+        silently accept a vanished link as the new normal — a smaller
+        topology is accepted explicitly via the ``expected_links``
+        updateConfig override."""
         if self.store is not None:
             self.store.set_tombstone("*", ts=self.time_now_fn())
         if self._event_bucket is not None:

@@ -30,6 +30,9 @@ KEY_CONFIG_OVERRIDES = "config_overrides"
 # persisted auth-failure record (reference: session auth-failure
 # persistence, session_v2.go:359): "<unix_ts>|<reason>"
 KEY_LAST_AUTH_FAILURE = "last_auth_failure"
+# ICI expected-link baseline: most links ever observed on this host, so a
+# link that vanished across a daemon restart still alarms
+KEY_ICI_MAX_LINKS_SEEN = "ici_max_links_seen"
 
 
 class Metadata:

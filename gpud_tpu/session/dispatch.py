@@ -336,7 +336,8 @@ class Dispatcher:
             comp = self.server.registry.get("accelerator-tpu-ici")
             if comp is not None:
                 for key in ("flap_threshold", "crc_delta_degraded",
-                            "auto_clear_window", "scan_window"):
+                            "auto_clear_window", "scan_window",
+                            "expected_links"):
                     if key not in ici_cfg:
                         continue
                     try:
@@ -346,6 +347,79 @@ class Dispatcher:
                         applied.setdefault("ici", {})[key] = val
                     except (TypeError, ValueError) as e:
                         errors.append(f"ici.{key}: {e}")
+        nfs_cfg = cfgs.get("nfs_groups")
+        if nfs_cfg is not None and not isinstance(nfs_cfg, list):
+            errors.append("nfs_groups: must be a list of group objects")
+            nfs_cfg = None
+        if isinstance(nfs_cfg, list):
+            from gpud_tpu.nfs_checker import GroupConfig
+
+            comp = self.server.registry.get("nfs")
+            groups = []
+            group_errs = []
+            for i, g in enumerate(nfs_cfg):
+                if not isinstance(g, dict) or not g.get("dir"):
+                    group_errs.append(f"nfs_groups[{i}]: dir required")
+                    continue
+                try:
+                    gc = GroupConfig(
+                        dir=str(g["dir"]),
+                        ttl_seconds=float(g.get("ttl_seconds", 300.0)),
+                        expected_members=int(g.get("expected_members", 0)),
+                    )
+                except (TypeError, ValueError) as e:
+                    group_errs.append(f"nfs_groups[{i}]: {e}")
+                    continue
+                verr = gc.validate()
+                if verr:
+                    group_errs.append(f"nfs_groups[{i}]: {verr}")
+                    continue
+                groups.append(gc)
+            if group_errs:
+                # all-or-nothing: a partially-applied group list would
+                # silently stop monitoring the rejected groups
+                errors.extend(group_errs)
+            elif comp is None:
+                # valid push must not vanish silently on a host where the
+                # component is disabled — signal the no-op to the CP
+                errors.append("nfs_groups: nfs component disabled on this host")
+            else:
+                comp.group_configs = groups
+                updated.append("nfs_groups")
+                applied["nfs_groups"] = [
+                    {
+                        "dir": gc.dir,
+                        "ttl_seconds": gc.ttl_seconds,
+                        "expected_members": gc.expected_members,
+                    }
+                    for gc in groups
+                ]
+        thr_cfg = cfgs.get("error_thresholds")
+        if thr_cfg is not None and not isinstance(thr_cfg, dict):
+            errors.append("error_thresholds: must be an object of name->threshold")
+            thr_cfg = None
+        if isinstance(thr_cfg, dict):
+            from gpud_tpu.components.tpu import catalog as tpu_catalog
+
+            comp = self.server.registry.get("accelerator-tpu-error-kmsg")
+            if comp is None and thr_cfg:
+                errors.append(
+                    "error_thresholds: error-kmsg component disabled on this host"
+                )
+            for name, raw_thr in thr_cfg.items() if comp is not None else ():
+                if tpu_catalog.lookup(name) is None:
+                    errors.append(f"error_thresholds.{name}: unknown error name")
+                    continue
+                try:
+                    thr = int(raw_thr)
+                    if thr < 0:
+                        raise ValueError("must be >= 0")
+                except (TypeError, ValueError) as e:
+                    errors.append(f"error_thresholds.{name}: {e}")
+                    continue
+                comp.reboot_threshold_overrides[name] = thr
+                updated.append(f"error_thresholds.{name}")
+                applied.setdefault("error_thresholds", {})[name] = thr
         t_cfg = cfgs.get("temperature")
         if t_cfg is not None and not isinstance(t_cfg, dict):
             errors.append("temperature: must be an object")

@@ -291,3 +291,80 @@ def test_diagnostic_rejects_bad_script(dispatch):
     assert dispatch({"method": "diagnostic", "script_base64": empty}) == {
         "error": "empty script"
     }
+
+
+def test_update_config_nfs_groups(dispatch, srv, tmp_path):
+    """NFS group configs are pushable (reference: session.go:224 NFS group
+    setters) — all-or-nothing validation, applied to the component, and
+    re-applied after restart."""
+    nfs = srv.registry.get("nfs")
+    assert not nfs.is_supported()  # no groups configured at boot
+    gdir = str(tmp_path / "shared")
+    out = dispatch({"method": "updateConfig", "configs": {"nfs_groups": [
+        {"dir": gdir, "ttl_seconds": 60, "expected_members": 2},
+    ]}})
+    assert "nfs_groups" in out["updated"] and "errors" not in out
+    assert nfs.is_supported()
+    assert nfs.group_configs[0].dir == gdir
+    assert nfs.group_configs[0].expected_members == 2
+
+    # invalid group rejects the whole list (no partial silent drops)
+    out2 = dispatch({"method": "updateConfig", "configs": {"nfs_groups": [
+        {"dir": gdir}, {"ttl_seconds": 5},
+    ]}})
+    assert any("dir required" in e for e in out2["errors"])
+    assert len(nfs.group_configs) == 1  # unchanged
+
+    # restart replay
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    kmsg = tmp_path / "k.fix"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=srv.config.data_dir, port=0, tls=False, kmsg_path=str(kmsg),
+    )
+    s2 = Server(config=cfg)
+    s2.start()
+    try:
+        assert s2.registry.get("nfs").group_configs[0].dir == gdir
+    finally:
+        s2.stop()
+        nfs.group_configs = []
+        from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
+
+        srv.metadata.delete(KEY_CONFIG_OVERRIDES)
+
+
+def test_update_config_error_thresholds(dispatch, srv, tmp_path):
+    """Per-error-name reboot thresholds are pushable (reference: XID
+    thresholds via updateConfig); unknown names error per-key without
+    blocking valid ones; persisted across restart."""
+    ek = srv.registry.get("accelerator-tpu-error-kmsg")
+    out = dispatch({"method": "updateConfig", "configs": {"error_thresholds": {
+        "tpu_chip_lost": 5, "not_a_real_error": 1, "tpu_hbm_ecc_uncorrectable": -2,
+    }}})
+    assert "error_thresholds.tpu_chip_lost" in out["updated"]
+    assert any("unknown error name" in e for e in out["errors"])
+    assert any("tpu_hbm_ecc_uncorrectable" in e for e in out["errors"])
+    assert ek.reboot_threshold_overrides == {"tpu_chip_lost": 5}
+
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    kmsg = tmp_path / "k.fix"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=srv.config.data_dir, port=0, tls=False, kmsg_path=str(kmsg),
+    )
+    s2 = Server(config=cfg)
+    s2.start()
+    try:
+        ek2 = s2.registry.get("accelerator-tpu-error-kmsg")
+        assert ek2.reboot_threshold_overrides == {"tpu_chip_lost": 5}
+    finally:
+        s2.stop()
+        ek.reboot_threshold_overrides = {}
+        from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
+
+        srv.metadata.delete(KEY_CONFIG_OVERRIDES)

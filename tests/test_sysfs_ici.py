@@ -100,3 +100,36 @@ def test_partial_exposure_not_permanently_unhealthy(tmp_path, monkeypatch, tmp_d
     cr = c.check()
     assert cr.health_state_type() == "Unhealthy"
     assert "unreported" in cr.summary()
+
+
+def test_partial_exposure_baseline_survives_restart(tmp_path, monkeypatch, tmp_db):
+    """VERDICT Weak #4: the expected-links high-water mark must persist —
+    a link that vanishes across a daemon restart window still alarms on
+    the fresh process, and set-healthy resets the baseline."""
+    import shutil
+
+    b, root = _backend(tmp_path, monkeypatch)
+    _build_tree(root, chips=4, links=2)  # 8 of 16 mapped
+    inst = TpudInstance(tpu_instance=b, db_rw=tmp_db, event_store=EventStore(tmp_db))
+    c = TPUICIComponent(inst)
+    c.sampler.ttl = 0.0
+    assert c.check().health_state_type() == "Healthy"  # baseline 8 recorded
+
+    # link vanishes WHILE the daemon is down; fresh component, same DB
+    shutil.rmtree(root / "chip3" / "ici1")
+    c2 = TPUICIComponent(inst)
+    c2.sampler.ttl = 0.0
+    cr = c2.check()
+    assert cr.health_state_type() == "Unhealthy"
+    assert "unreported" in cr.summary()
+
+    # set-healthy clears history but must NOT accept the smaller topology
+    c2.set_healthy()
+    c3 = TPUICIComponent(inst)
+    c3.sampler.ttl = 0.0
+    assert c3.check().health_state_type() == "Unhealthy"
+
+    # the smaller topology is accepted only explicitly, via the pushable
+    # expected_links override (updateConfig)
+    c3.expected_links = 7
+    assert c3.check().health_state_type() == "Healthy"
