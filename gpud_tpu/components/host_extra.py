@@ -232,7 +232,9 @@ class ContainerdComponent(PollingComponent):
     def __init__(self, instance: TpudInstance) -> None:
         super().__init__(instance)
         self._consecutive_misses = 0
+        self._cri_misses = 0
         self.socket_path = self.SOCKET
+        self.cri_target = ""  # tests point this at a fake CRI server
 
     def is_supported(self) -> bool:
         return os.path.exists(self.socket_path) or run_command(
@@ -242,7 +244,7 @@ class ContainerdComponent(PollingComponent):
     def check_once(self) -> CheckResult:
         if os.path.exists(self.socket_path):
             self._consecutive_misses = 0
-            return CheckResult(self.NAME, reason="containerd socket present")
+            return self._check_cri()
         self._consecutive_misses += 1
         if self._consecutive_misses < self.SOCKET_MISS_THRESHOLD:
             return CheckResult(
@@ -256,6 +258,59 @@ class ContainerdComponent(PollingComponent):
             self.NAME,
             health=HealthStateType.UNHEALTHY,
             reason=f"containerd socket missing {self._consecutive_misses} consecutive checks",
+        )
+
+    def _check_cri(self) -> CheckResult:
+        """Socket exists: list pods/containers over CRI gRPC (reference:
+        components/containerd CRI ListContainers via k8s.io/cri-api).
+        An unresponsive runtime behind a live socket is Degraded — the
+        socket file alone proves nothing about the daemon — but only after
+        consecutive failures (same damping as the socket-missing path: a
+        single slow ListContainers during image GC must not page)."""
+        from gpud_tpu import cri
+
+        if not cri.grpc_available():
+            # grpcio is an optional extra; without it this check keeps the
+            # pre-CRI socket-presence semantics rather than false-alarming
+            return CheckResult(
+                self.NAME,
+                reason="containerd socket present (CRI client unavailable: no grpcio)",
+            )
+        result = cri.probe(self.socket_path, target=self.cri_target)
+        if result is None:
+            self._cri_misses += 1
+            if self._cri_misses < self.SOCKET_MISS_THRESHOLD:
+                return CheckResult(
+                    self.NAME,
+                    reason=(
+                        f"containerd socket present but CRI unresponsive "
+                        f"({self._cri_misses}/{self.SOCKET_MISS_THRESHOLD} strikes)"
+                    ),
+                )
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.DEGRADED,
+                reason=(
+                    f"containerd socket present but CRI unresponsive "
+                    f"{self._cri_misses} consecutive checks"
+                ),
+            )
+        self._cri_misses = 0
+        containers = result["containers"]
+        running = sum(1 for c in containers if c["state"] == "running")
+        ver = result["version"].get("runtime_version", "")
+        return CheckResult(
+            self.NAME,
+            reason=(
+                f"containerd {ver or 'up'}: {running}/{len(containers)} "
+                f"containers running, {len(result['sandboxes'])} pods"
+            ),
+            extra_info={
+                "containers_total": str(len(containers)),
+                "containers_running": str(running),
+                "pods": str(len(result["sandboxes"])),
+                "runtime_version": ver,
+            },
         )
 
 

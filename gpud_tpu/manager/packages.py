@@ -12,6 +12,13 @@ Contract per package dir ``<packages>/<name>/``:
                      ``installed_version`` on success.
 - ``version``      — target version (pushed by the control plane).
 - ``status.sh``    — optional health probe; exit 0 = running.
+- ``delete``       — deletion marker (pushed by the control plane); the
+                     delete loop runs ``uninstall.sh`` (if present) and
+                     removes the package dir (reference: deleteRunner,
+                     package_controller.go:274-294 — there the package's
+                     script answers needDelete; our contract is file-
+                     marker-driven like ``version``).
+- ``uninstall.sh`` — optional cleanup hook run before dir removal.
 """
 
 from __future__ import annotations
@@ -26,7 +33,10 @@ from gpud_tpu.process import run_command
 
 logger = get_logger(__name__)
 
-RECONCILE_INTERVAL = 60.0
+# reference reconciles at 3s with an fsnotify informer; the no-op pass
+# here is a handful of stat()s, so a 15s poll keeps pushes responsive
+# without a watcher thread (footprint discipline, SURVEY §7)
+RECONCILE_INTERVAL = 15.0
 INSTALL_TIMEOUT = 15 * 60.0
 
 
@@ -103,6 +113,13 @@ class PackageManager:
 
     # -- reconcile ---------------------------------------------------------
     def reconcile_once(self) -> None:
+        # delete pass scans ALL subdirs, not just installable ones — a
+        # partial push without init.sh must still honor its delete marker
+        if os.path.isdir(self.packages_dir):
+            for name in sorted(os.listdir(self.packages_dir)):
+                d = os.path.join(self.packages_dir, name)
+                if os.path.isdir(d) and os.path.exists(os.path.join(d, "delete")):
+                    self._delete(name, d)
         for name in self.package_names():
             d = os.path.join(self.packages_dir, name)
             target = _read(os.path.join(d, "version"))
@@ -110,6 +127,35 @@ class PackageManager:
             if not target or target == current:
                 continue
             self._install(name, d, target)
+
+    def _delete(self, name: str, pkg_dir: str) -> None:
+        """Reference: deleteRunner (package_controller.go:274-294) — run
+        the package's cleanup hook, then drop the package entirely."""
+        with self._mu:
+            if self._installing.get(name):
+                return  # let the in-flight install finish first
+            self._installing[name] = True
+        logger.info("deleting package %s", name)
+        try:
+            hook = os.path.join(pkg_dir, "uninstall.sh")
+            if os.path.isfile(hook):
+                r = run_command(
+                    ["bash", hook], timeout=INSTALL_TIMEOUT,
+                    env={"PACKAGE_DIR": pkg_dir},
+                )
+                if r.exit_code != 0:
+                    logger.warning(
+                        "package %s uninstall hook failed (exit %d): %s — "
+                        "removing anyway", name, r.exit_code, r.output[-500:],
+                    )
+            import shutil
+
+            shutil.rmtree(pkg_dir, ignore_errors=True)
+            logger.info("package %s deleted", name)
+        finally:
+            with self._mu:
+                self._installing.pop(name, None)
+                self._progress.pop(name, None)
 
     def _install(self, name: str, pkg_dir: str, target: str) -> None:
         with self._mu:

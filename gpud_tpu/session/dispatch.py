@@ -342,6 +342,11 @@ class Dispatcher:
                         continue
                     try:
                         val = type(getattr(comp, key))(ici_cfg[key])
+                        # all ici keys are thresholds/windows/counts — a
+                        # negative would be reported 'applied' but do
+                        # nothing (or misbehave)
+                        if val < 0:
+                            raise ValueError("must be >= 0")
                         setattr(comp, key, val)
                         updated.append(f"ici.{key}")
                         applied.setdefault("ici", {})[key] = val
@@ -409,6 +414,13 @@ class Dispatcher:
             for name, raw_thr in thr_cfg.items() if comp is not None else ():
                 if tpu_catalog.lookup(name) is None:
                     errors.append(f"error_thresholds.{name}: unknown error name")
+                    continue
+                if raw_thr is None:
+                    # null removes the override: back to the catalog
+                    # default (incl. future catalog changes)
+                    comp.reboot_threshold_overrides.pop(name, None)
+                    updated.append(f"error_thresholds.{name}")
+                    applied.setdefault("error_thresholds", {})[name] = None
                     continue
                 try:
                     thr = int(raw_thr)

@@ -1,0 +1,237 @@
+"""CRI client: protobuf wire codec + a fake CRI gRPC server (fixture-
+driven, reference: components/containerd/mock_cri_test.go)."""
+
+import threading
+from concurrent import futures
+
+import grpc
+import pytest
+
+from gpud_tpu import cri
+from gpud_tpu.cri import (
+    CRIClient,
+    encode_field_bytes,
+    encode_field_str,
+    encode_field_varint,
+    parse_message,
+)
+
+
+# ---------------------------------------------------------------------------
+# codec
+# ---------------------------------------------------------------------------
+
+def test_codec_roundtrip():
+    msg = (
+        encode_field_str(1, "abc")
+        + encode_field_varint(6, 1)
+        + encode_field_bytes(3, encode_field_str(1, "inner"))
+        + encode_field_varint(7, 1700000000)
+    )
+    f = parse_message(msg)
+    assert f[1] == [b"abc"]
+    assert f[6] == [1]
+    assert parse_message(f[3][0])[1] == [b"inner"]
+    assert f[7] == [1700000000]
+
+
+def test_codec_rejects_truncated():
+    msg = encode_field_str(1, "abcdef")
+    with pytest.raises(ValueError):
+        parse_message(msg[:-2])
+
+
+# ---------------------------------------------------------------------------
+# fake CRI server
+# ---------------------------------------------------------------------------
+
+def _container(cid, name, state, image="img:1"):
+    return encode_field_bytes(
+        1,
+        encode_field_str(1, cid)
+        + encode_field_str(2, f"sandbox-{cid}")
+        + encode_field_bytes(3, encode_field_str(1, name))
+        + encode_field_bytes(4, encode_field_str(1, image))
+        + encode_field_varint(6, state)
+        + encode_field_varint(7, 1700000000)
+        + encode_field_bytes(
+            8, encode_field_str(1, "io.kubernetes.pod.name") + encode_field_str(2, name)
+        ),
+    )
+
+
+def _sandbox(sid, name, ns, state):
+    return encode_field_bytes(
+        1,
+        encode_field_str(1, sid)
+        + encode_field_bytes(
+            2, encode_field_str(1, name) + encode_field_str(3, ns)
+        )
+        + encode_field_varint(3, state)
+        + encode_field_varint(4, 1700000001),
+    )
+
+
+class FakeCRI(grpc.GenericRpcHandler):
+    def __init__(self, api="v1", unimplemented_v1=False):
+        self.api = api
+        self.unimplemented_v1 = unimplemented_v1
+        self.calls = []
+
+    def service(self, details):
+        method = details.method
+        self.calls.append(method)
+        if self.unimplemented_v1 and method.startswith("/runtime.v1."):
+            return None  # grpc answers UNIMPLEMENTED
+        if not method.startswith(f"/runtime.{self.api}."):
+            return None
+
+        def handler(req, ctx):
+            if method.endswith("/Version"):
+                return (
+                    encode_field_str(1, "0.1.0")
+                    + encode_field_str(2, "containerd")
+                    + encode_field_str(3, "1.7.0")
+                    + encode_field_str(4, "v1")
+                )
+            if method.endswith("/ListContainers"):
+                return _container("c1", "tpu-worker", 1) + _container(
+                    "c2", "sidecar", 2
+                )
+            if method.endswith("/ListPodSandbox"):
+                return _sandbox("s1", "tpu-pod", "default", 0)
+            ctx.abort(grpc.StatusCode.UNIMPLEMENTED, "nope")
+
+        return grpc.unary_unary_rpc_method_handler(
+            handler,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )
+
+
+@pytest.fixture()
+def fake_cri():
+    def boot(api="v1", unimplemented_v1=False):
+        fake = FakeCRI(api=api, unimplemented_v1=unimplemented_v1)
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+        server.add_generic_rpc_handlers((fake,))
+        port = server.add_insecure_port("127.0.0.1:0")
+        server.start()
+        return fake, server, f"127.0.0.1:{port}"
+
+    servers = []
+
+    def factory(**kw):
+        fake, server, target = boot(**kw)
+        servers.append(server)
+        return fake, target
+
+    yield factory
+    for s in servers:
+        s.stop(grace=None)
+
+
+def test_version_and_lists(fake_cri):
+    _fake, target = fake_cri()
+    c = CRIClient(target=target)
+    v = c.version()
+    assert v["runtime_name"] == "containerd"
+    assert v["runtime_version"] == "1.7.0"
+    containers = c.list_containers()
+    assert [x["name"] for x in containers] == ["tpu-worker", "sidecar"]
+    assert containers[0]["state"] == "running"
+    assert containers[1]["state"] == "exited"
+    assert containers[0]["labels"]["io.kubernetes.pod.name"] == "tpu-worker"
+    pods = c.list_pod_sandboxes()
+    assert pods == [
+        {
+            "id": "s1",
+            "name": "tpu-pod",
+            "namespace": "default",
+            "state": "ready",
+            "created_at": 1700000001,
+        }
+    ]
+    c.close()
+
+
+def test_v1alpha2_fallback(fake_cri):
+    _fake, target = fake_cri(api="v1alpha2", unimplemented_v1=True)
+    c = CRIClient(target=target)
+    assert c.version()["runtime_name"] == "containerd"
+    assert c._api_version == "v1alpha2"
+    c.close()
+
+
+def test_probe_unresponsive_returns_none():
+    assert cri.probe(target="127.0.0.1:1", timeout=0.5) is None
+
+
+# ---------------------------------------------------------------------------
+# containerd component over CRI
+# ---------------------------------------------------------------------------
+
+def test_containerd_component_uses_cri(fake_cri, tmp_path):
+    from gpud_tpu.components.base import TpudInstance
+    from gpud_tpu.components.host_extra import ContainerdComponent
+
+    _fake, target = fake_cri()
+    c = ContainerdComponent(TpudInstance())
+    sock = tmp_path / "containerd.sock"
+    sock.write_text("")  # presence is what the component stats
+    c.socket_path = str(sock)
+    c.cri_target = target
+    cr = c.check()
+    assert cr.health_state_type() == "Healthy"
+    assert "1/2 containers running" in cr.reason
+    assert cr.extra_info["pods"] == "1"
+
+
+def test_containerd_component_degraded_when_cri_dead(tmp_path):
+    from gpud_tpu.api.v1.types import HealthStateType
+    from gpud_tpu.components.base import TpudInstance
+    from gpud_tpu.components.host_extra import ContainerdComponent
+
+    c = ContainerdComponent(TpudInstance())
+    sock = tmp_path / "containerd.sock"
+    sock.write_text("")
+    c.socket_path = str(sock)
+    c.cri_target = "127.0.0.1:1"  # nothing listening
+    for _ in range(c.SOCKET_MISS_THRESHOLD):
+        cr = c.check()
+    assert cr.health_state_type() == HealthStateType.DEGRADED
+    assert "CRI unresponsive" in cr.reason
+
+
+def test_containerd_cri_failure_damped(tmp_path):
+    """One transient CRI failure must not flip health; only consecutive
+    failures degrade (same damping as the socket-missing path)."""
+    from gpud_tpu.api.v1.types import HealthStateType
+    from gpud_tpu.components.base import TpudInstance
+    from gpud_tpu.components.host_extra import ContainerdComponent
+
+    c = ContainerdComponent(TpudInstance())
+    sock = tmp_path / "containerd.sock"
+    sock.write_text("")
+    c.socket_path = str(sock)
+    c.cri_target = "127.0.0.1:1"
+    for i in range(1, c.SOCKET_MISS_THRESHOLD):
+        cr = c.check()
+        assert cr.health_state_type() == HealthStateType.HEALTHY, i
+        assert "strikes" in cr.reason
+    assert c.check().health_state_type() == HealthStateType.DEGRADED
+
+
+def test_containerd_healthy_without_grpc(tmp_path, monkeypatch):
+    from gpud_tpu import cri as cri_mod
+    from gpud_tpu.components.base import TpudInstance
+    from gpud_tpu.components.host_extra import ContainerdComponent
+
+    monkeypatch.setattr(cri_mod, "grpc_available", lambda: False)
+    c = ContainerdComponent(TpudInstance())
+    sock = tmp_path / "containerd.sock"
+    sock.write_text("")
+    c.socket_path = str(sock)
+    cr = c.check()
+    assert cr.health_state_type() == "Healthy"
+    assert "CRI client unavailable" in cr.reason

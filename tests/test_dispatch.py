@@ -368,3 +368,25 @@ def test_update_config_error_thresholds(dispatch, srv, tmp_path):
         from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
 
         srv.metadata.delete(KEY_CONFIG_OVERRIDES)
+
+
+def test_update_config_rejects_negative_ici_values(dispatch, srv):
+    out = dispatch({"method": "updateConfig",
+                    "configs": {"ici": {"expected_links": -1}}})
+    assert any("expected_links" in e and ">= 0" in e for e in out["errors"])
+    assert out["updated"] == []
+    assert srv.registry.get("accelerator-tpu-ici").expected_links == 0
+
+
+def test_update_config_error_threshold_null_removes_override(dispatch, srv):
+    ek = srv.registry.get("accelerator-tpu-error-kmsg")
+    dispatch({"method": "updateConfig",
+              "configs": {"error_thresholds": {"tpu_chip_lost": 9}}})
+    assert ek.reboot_threshold_overrides == {"tpu_chip_lost": 9}
+    out = dispatch({"method": "updateConfig",
+                    "configs": {"error_thresholds": {"tpu_chip_lost": None}}})
+    assert "error_thresholds.tpu_chip_lost" in out["updated"]
+    assert ek.reboot_threshold_overrides == {}
+    from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
+
+    srv.metadata.delete(KEY_CONFIG_OVERRIDES)

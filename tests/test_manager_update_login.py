@@ -176,3 +176,49 @@ def test_detect_gcp_absent():
         raise OSError("no route")
 
     assert detect_gcp(get_fn=fake_get) is None
+
+
+def test_package_delete_marker_removes_package(tmp_path):
+    """Delete loop (reference: deleteRunner, package_controller.go:274-294):
+    a pushed delete marker runs the uninstall hook then drops the dir."""
+    d = _mk_pkg(tmp_path, "togo")
+    trace = tmp_path / "uninstalled"
+    (d / "uninstall.sh").write_text(f"#!/bin/bash\necho bye > {trace}\n")
+    pm = PackageManager(str(tmp_path / "packages"))
+    pm.reconcile_once()  # installs
+    assert (d / "installed_version").read_text() == "1.0"
+
+    (d / "delete").write_text("")
+    pm.reconcile_once()
+    assert not d.exists()
+    assert trace.read_text().strip() == "bye"
+    assert pm.package_names() == []
+    assert pm.status() == []
+
+
+def test_package_delete_without_hook(tmp_path):
+    d = _mk_pkg(tmp_path, "plain")
+    pm = PackageManager(str(tmp_path / "packages"))
+    (d / "delete").write_text("")
+    pm.reconcile_once()
+    assert not d.exists()
+
+
+def test_package_delete_failing_hook_still_removes(tmp_path):
+    d = _mk_pkg(tmp_path, "stubborn")
+    (d / "uninstall.sh").write_text("#!/bin/bash\nexit 7\n")
+    pm = PackageManager(str(tmp_path / "packages"))
+    (d / "delete").write_text("")
+    pm.reconcile_once()
+    assert not d.exists()
+
+
+def test_package_delete_marker_without_init_sh(tmp_path):
+    """A partial push (no init.sh) must still honor its delete marker."""
+    d = tmp_path / "packages" / "broken"
+    d.mkdir(parents=True)
+    (d / "delete").write_text("")
+    pm = PackageManager(str(tmp_path / "packages"))
+    assert pm.package_names() == []  # invisible to the install pass
+    pm.reconcile_once()
+    assert not d.exists()
