@@ -233,6 +233,7 @@ class ContainerdComponent(PollingComponent):
         super().__init__(instance)
         self._consecutive_misses = 0
         self._cri_misses = 0
+        self._cri_client = None  # persistent: keeps channel + learned API version
         self.socket_path = self.SOCKET
         self.cri_target = ""  # tests point this at a fake CRI server
 
@@ -245,6 +246,10 @@ class ContainerdComponent(PollingComponent):
         if os.path.exists(self.socket_path):
             self._consecutive_misses = 0
             return self._check_cri()
+        # socket gone: CRI strikes are no longer consecutive — a restarted
+        # containerd gets a fresh damping window
+        self._cri_misses = 0
+        self._drop_cri_client()
         self._consecutive_misses += 1
         if self._consecutive_misses < self.SOCKET_MISS_THRESHOLD:
             return CheckResult(
@@ -259,6 +264,18 @@ class ContainerdComponent(PollingComponent):
             health=HealthStateType.UNHEALTHY,
             reason=f"containerd socket missing {self._consecutive_misses} consecutive checks",
         )
+
+    def _drop_cri_client(self) -> None:
+        if self._cri_client is not None:
+            try:
+                self._cri_client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._cri_client = None
+
+    def close(self) -> None:
+        self._drop_cri_client()
+        super().close()
 
     def _check_cri(self) -> CheckResult:
         """Socket exists: list pods/containers over CRI gRPC (reference:
@@ -276,7 +293,23 @@ class ContainerdComponent(PollingComponent):
                 self.NAME,
                 reason="containerd socket present (CRI client unavailable: no grpcio)",
             )
-        result = cri.probe(self.socket_path, target=self.cri_target)
+        if self._cri_client is None:
+            self._cri_client = cri.CRIClient(
+                self.socket_path, target=self.cri_target
+            )
+        try:
+            result = self._cri_client.snapshot()
+        except cri.CRIUnservedError:
+            # CRI plugin disabled (containerd as Docker's backend etc.) —
+            # a configuration, not a failure; keep socket-presence health
+            self._cri_misses = 0
+            return CheckResult(
+                self.NAME,
+                reason="containerd socket present (CRI not served)",
+            )
+        except Exception:  # noqa: BLE001 — any transport failure is a miss
+            result = None
+            self._drop_cri_client()  # channel may be poisoned
         if result is None:
             self._cri_misses += 1
             if self._cri_misses < self.SOCKET_MISS_THRESHOLD:

@@ -180,7 +180,20 @@ class CRIClient:
             ):
                 self._api_version = "v1alpha2"
                 return self._call(method, request)
+            if e.code() == grpc.StatusCode.UNIMPLEMENTED:
+                # neither API served: CRI plugin disabled, not a failure
+                raise CRIUnservedError(f"{method}: CRI not served") from e
             raise CRIError(f"{method}: {e.code().name}: {e.details()}") from e
+
+    def snapshot(self) -> Dict:
+        """Version + container/sandbox listing in one call set; raises
+        CRIUnservedError when the runtime deliberately doesn't serve CRI,
+        CRIError/RpcError on real failures."""
+        return {
+            "version": self.version(),
+            "containers": self.list_containers(),
+            "sandboxes": self.list_pod_sandboxes(),
+        }
 
     # -- RPCs -------------------------------------------------------------
     def version(self) -> Dict[str, str]:
@@ -237,6 +250,12 @@ class CRIError(Exception):
     pass
 
 
+class CRIUnservedError(CRIError):
+    """The runtime answered, but with UNIMPLEMENTED on every CRI API —
+    the CRI plugin is disabled (e.g. containerd as Docker's backend), which
+    is a configuration, not a health failure."""
+
+
 def grpc_available() -> bool:
     """grpcio is an optional extra; callers must not read its absence as a
     runtime failure."""
@@ -250,17 +269,13 @@ def grpc_available() -> bool:
 
 def probe(socket_path: str = DEFAULT_SOCKET, timeout: float = DEFAULT_TIMEOUT,
           target: str = "") -> Optional[Dict]:
-    """One-shot: version + container/sandbox counts, or None on failure."""
+    """One-shot snapshot; ``{"unserved": True}`` when CRI is deliberately
+    not served, None on transport failure."""
     client = CRIClient(socket_path, timeout, target=target)
     try:
-        info = client.version()
-        containers = client.list_containers()
-        sandboxes = client.list_pod_sandboxes()
-        return {
-            "version": info,
-            "containers": containers,
-            "sandboxes": sandboxes,
-        }
+        return client.snapshot()
+    except CRIUnservedError:
+        return {"unserved": True}
     except Exception as e:  # noqa: BLE001 — callers treat None as unresponsive
         logger.debug("CRI probe failed: %s", e)
         return None

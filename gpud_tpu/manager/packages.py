@@ -138,7 +138,11 @@ class PackageManager:
         logger.info("deleting package %s", name)
         try:
             hook = os.path.join(pkg_dir, "uninstall.sh")
-            if os.path.isfile(hook):
+            hook_done = os.path.join(pkg_dir, "uninstall_done")
+            # run the hook at most once even when dir removal fails and the
+            # delete retries every reconcile — uninstall hooks are often
+            # non-idempotent (stop a service, deregister, ...)
+            if os.path.isfile(hook) and not os.path.exists(hook_done):
                 r = run_command(
                     ["bash", hook], timeout=INSTALL_TIMEOUT,
                     env={"PACKAGE_DIR": pkg_dir},
@@ -148,10 +152,18 @@ class PackageManager:
                         "package %s uninstall hook failed (exit %d): %s — "
                         "removing anyway", name, r.exit_code, r.output[-500:],
                     )
+                with open(hook_done, "w", encoding="utf-8"):
+                    pass
             import shutil
 
-            shutil.rmtree(pkg_dir, ignore_errors=True)
-            logger.info("package %s deleted", name)
+            try:
+                shutil.rmtree(pkg_dir)
+                logger.info("package %s deleted", name)
+            except OSError as e:
+                # marker survives → retried next reconcile (hook skipped)
+                logger.warning(
+                    "package %s dir removal failed (%s); will retry", name, e
+                )
         finally:
             with self._mu:
                 self._installing.pop(name, None)

@@ -344,8 +344,9 @@ class Dispatcher:
                         val = type(getattr(comp, key))(ici_cfg[key])
                         # all ici keys are thresholds/windows/counts — a
                         # negative would be reported 'applied' but do
-                        # nothing (or misbehave)
-                        if val < 0:
+                        # nothing (or misbehave); `not >=` also rejects NaN
+                        # (json.loads accepts the NaN token)
+                        if not val >= 0:
                             raise ValueError("must be >= 0")
                         setattr(comp, key, val)
                         updated.append(f"ici.{key}")

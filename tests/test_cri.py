@@ -235,3 +235,47 @@ def test_containerd_healthy_without_grpc(tmp_path, monkeypatch):
     cr = c.check()
     assert cr.health_state_type() == "Healthy"
     assert "CRI client unavailable" in cr.reason
+
+
+def test_containerd_cri_unserved_keeps_socket_health(fake_cri, tmp_path):
+    """containerd with the CRI plugin disabled (UNIMPLEMENTED on both
+    APIs) is a configuration, not a failure — health falls back to
+    socket presence."""
+    from gpud_tpu.components.base import TpudInstance
+    from gpud_tpu.components.host_extra import ContainerdComponent
+
+    # serve NOTHING on either API: every method → UNIMPLEMENTED
+    _fake, target = fake_cri(api="v9-none")
+    c = ContainerdComponent(TpudInstance())
+    sock = tmp_path / "containerd.sock"
+    sock.write_text("")
+    c.socket_path = str(sock)
+    c.cri_target = target
+    for _ in range(5):
+        cr = c.check()
+        assert cr.health_state_type() == "Healthy"
+    assert "CRI not served" in cr.reason
+    c.close()
+
+
+def test_containerd_cri_strikes_reset_when_socket_goes(tmp_path):
+    """A containerd restart (socket gone then back) gets a fresh CRI
+    damping window — stale strikes are not 'consecutive'."""
+    from gpud_tpu.components.base import TpudInstance
+    from gpud_tpu.components.host_extra import ContainerdComponent
+
+    c = ContainerdComponent(TpudInstance())
+    sock = tmp_path / "containerd.sock"
+    sock.write_text("")
+    c.socket_path = str(sock)
+    c.cri_target = "127.0.0.1:1"
+    c.check()
+    c.check()
+    assert c._cri_misses == 2
+    sock.unlink()
+    c.check()  # socket missing → strikes reset
+    assert c._cri_misses == 0
+    sock.write_text("")
+    cr = c.check()  # first new failure: a strike, not Degraded
+    assert cr.health_state_type() == "Healthy"
+    c.close()

@@ -390,3 +390,10 @@ def test_update_config_error_threshold_null_removes_override(dispatch, srv):
     from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
 
     srv.metadata.delete(KEY_CONFIG_OVERRIDES)
+
+
+def test_update_config_rejects_nan_ici_value(dispatch, srv):
+    out = dispatch({"method": "updateConfig",
+                    "configs": {"ici": {"scan_window": float("nan")}}})
+    assert any("scan_window" in e for e in out["errors"])
+    assert out["updated"] == []

@@ -1,0 +1,229 @@
+"""ICI sticky-state scenario matrix.
+
+Models the reference's dedicated sticky-window test files
+(component_sticky_comprehensive_test.go, component_sticky_drop_test.go,
+component_recovery_sticky_test.go, component_production_scenarios_test.go):
+drop→recover→set-healthy→re-drop lifecycles, auto-clear interplay,
+counter resets across driver reloads/reboots, window aging, dormant-link
+filtering, and multi-link severity mixing.
+"""
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components.base import FailureInjector, TpudInstance
+from gpud_tpu.components.tpu.ici import TPUICIComponent
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.tpu.instance import (
+    ICILinkSnapshot,
+    InjectedInstance,
+    LinkState,
+    MockBackend,
+)
+
+H = HealthStateType.HEALTHY
+D = HealthStateType.DEGRADED
+U = HealthStateType.UNHEALTHY
+
+
+class Scenario:
+    """A clock-driven ICI component over an injectable backend."""
+
+    def __init__(self, tmp_db, auto_clear=0.0):
+        self.inj = FailureInjector()
+        tpu = InjectedInstance(MockBackend(accelerator_type="v5e-8"), self.inj)
+        inst = TpudInstance(
+            tpu_instance=tpu, db_rw=tmp_db, event_store=EventStore(tmp_db)
+        )
+        self.c = TPUICIComponent(inst)
+        self.c.sampler.ttl = 0.0
+        self.now = [10_000.0]
+        self.c.time_now_fn = lambda: self.now[0]
+        self.c.store.time_now_fn = lambda: self.now[0]
+        self.c.auto_clear_window = auto_clear
+
+    def tick(self, seconds=60.0, down=()):
+        self.inj.ici_links_down[:] = list(down)
+        self.now[0] += seconds
+        return self.c.check()
+
+    def health(self, seconds=60.0, down=()):
+        return self.tick(seconds, down).health_state_type()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: drop → recover → set-healthy → re-drop
+# ---------------------------------------------------------------------------
+
+def test_full_lifecycle_redrop_is_fresh_incident(tmp_db):
+    s = Scenario(tmp_db)
+    assert s.health() == H
+    assert s.health(down=["chip0/ici0"]) == U          # drop
+    assert s.health() != H                             # recovered but sticky
+    s.c.set_healthy()
+    assert s.health() == H                             # slate cleared
+    # re-drop after set-healthy: alarms again AND emits a fresh event
+    assert s.health(down=["chip0/ici0"]) == U
+    downs = [e for e in s.c.events(0) if e.name == "ici_link_down"]
+    assert len(downs) == 2, "re-drop after set-healthy must be a new incident"
+
+
+def test_set_healthy_while_still_down_keeps_alarming(tmp_db):
+    """set-healthy clears history, not reality: a link that is STILL down
+    re-alarms on the next poll."""
+    s = Scenario(tmp_db)
+    assert s.health(down=["chip0/ici1"]) == U
+    s.c.set_healthy()
+    assert s.health(down=["chip0/ici1"]) == U
+
+
+def test_multiple_set_healthy_cycles(tmp_db):
+    s = Scenario(tmp_db)
+    for _ in range(3):
+        assert s.health(down=["chip1/ici2"]) == U
+        assert s.health() != H          # sticky after each recovery
+        s.c.set_healthy()
+        assert s.health() == H
+
+
+# ---------------------------------------------------------------------------
+# auto-clear interplay
+# ---------------------------------------------------------------------------
+
+def test_auto_clear_reset_by_new_flap(tmp_db):
+    """A new flap inside the clean window restarts the auto-clear clock."""
+    s = Scenario(tmp_db, auto_clear=300.0)
+    s.health(seconds=10, down=["chip0/ici0"])   # drop
+    s.health(seconds=10)                        # recover (flap)
+    assert s.health(seconds=100) != H           # only ~100s clean
+    s.health(seconds=10, down=["chip0/ici0"])   # flaps again inside window
+    s.health(seconds=10)
+    assert s.health(seconds=100) != H           # ~100s since the NEW flap
+    assert s.health(seconds=100) != H, "clean clock must restart after the new flap"
+    assert s.health(seconds=150) == H           # full clean window elapsed
+
+
+def test_auto_clear_does_not_clear_current_down(tmp_db):
+    """Auto-clear applies to history, never to a link that is down NOW."""
+    s = Scenario(tmp_db, auto_clear=60.0)
+    s.health(down=["chip0/ici0"])
+    for _ in range(10):
+        assert s.health(down=["chip0/ici0"]) == U
+
+
+def test_sticky_forever_when_auto_clear_disabled(tmp_db):
+    s = Scenario(tmp_db, auto_clear=0.0)
+    s.health(down=["chip0/ici0"])
+    s.health()
+    for _ in range(20):
+        assert s.health(seconds=120) != H  # 40 min clean, still sticky
+
+
+# ---------------------------------------------------------------------------
+# window aging: old incidents fall out of the scan window
+# ---------------------------------------------------------------------------
+
+def test_drop_ages_out_of_scan_window(tmp_db):
+    s = Scenario(tmp_db)
+    s.c.scan_window = 600.0
+    s.health(down=["chip0/ici0"])
+    s.health()                      # recover → sticky inside window
+    assert s.health() != H
+    # advance past the window with periodic clean snapshots
+    for _ in range(8):
+        s.health(seconds=120)
+    assert s.health() == H, "incident outside the scan window must age out"
+
+
+# ---------------------------------------------------------------------------
+# counter resets (driver reload / reboot)
+# ---------------------------------------------------------------------------
+
+def _snap(name_to_crc, ts, store):
+    links = []
+    for cid in range(2):
+        for lid in range(4):
+            nm = f"chip{cid}/ici{lid}"
+            links.append(
+                ICILinkSnapshot(
+                    chip_id=cid, link_id=lid, state=LinkState.UP,
+                    crc_errors=name_to_crc.get(nm, 0),
+                )
+            )
+    store.insert_snapshot(links, ts=ts)
+
+
+def test_counter_reset_across_reboot_no_false_alarm(tmp_db):
+    """CRC counters resetting to zero (driver reload/reboot) must not read
+    as a negative or huge delta."""
+    s = Scenario(tmp_db)
+    _snap({"chip0/ici0": 5000}, s.now[0] - 300, s.c.store)
+    _snap({"chip0/ici0": 5010}, s.now[0] - 200, s.c.store)
+    _snap({"chip0/ici0": 3}, s.now[0] - 100, s.c.store)   # reset
+    res = s.c.store.scan(600.0)
+    # only positive steps count; the reset step (5010→3) contributes
+    # nothing — post-reset counting resumes from the new baseline
+    assert res.links["chip0/ici0"].crc_delta == 10
+    assert s.health() == H
+
+
+def test_counter_reset_then_real_burst_still_alarms(tmp_db):
+    s = Scenario(tmp_db)
+    s.c.crc_delta_degraded = 100
+    _snap({"chip0/ici0": 9000}, s.now[0] - 300, s.c.store)
+    _snap({"chip0/ici0": 0}, s.now[0] - 200, s.c.store)    # reset
+    _snap({"chip0/ici0": 500}, s.now[0] - 100, s.c.store)  # real burst
+    cr = s.tick()
+    assert cr.health_state_type() == D
+    assert "CRC" in cr.reason
+
+
+# ---------------------------------------------------------------------------
+# dormant / tombstoned links
+# ---------------------------------------------------------------------------
+
+def test_tombstoned_link_not_reported_as_down_forever(tmp_db):
+    """A link whose entire history predates its tombstone must vanish from
+    the scan rather than read 'down since forever' (reference: dormant
+    port filtering)."""
+    s = Scenario(tmp_db)
+    s.health(down=["chip0/ici0"])
+    s.c.store.set_tombstone("chip0/ici0", ts=s.now[0] + 1)
+    res = s.c.store.scan(600.0)
+    assert "chip0/ici0" not in res.links
+    assert "chip0/ici1" in res.links
+
+
+def test_per_link_tombstone_leaves_others_sticky(tmp_db):
+    s = Scenario(tmp_db)
+    s.health(down=["chip0/ici0", "chip1/ici1"])
+    s.health()  # both recover → both sticky
+    s.c.store.set_tombstone("chip0/ici0", ts=s.now[0] + 1)
+    cr = s.tick()
+    assert cr.health_state_type() != H
+    assert "chip1/ici1" in cr.reason
+    assert "chip0/ici0" not in cr.reason
+
+
+# ---------------------------------------------------------------------------
+# severity mixing across links
+# ---------------------------------------------------------------------------
+
+def test_heavy_flapper_dominates_light_flapper(tmp_db):
+    s = Scenario(tmp_db)
+    s.c.flap_threshold = 3
+    # chip0/ici0 flaps 3x (heavy), chip1/ici3 once (light)
+    for _ in range(3):
+        s.health(seconds=10, down=["chip0/ici0"])
+        s.health(seconds=10)
+    s.health(seconds=10, down=["chip1/ici3"])
+    s.health(seconds=10)
+    cr = s.tick(seconds=10)
+    assert cr.health_state_type() == U  # heavy flapper escalates
+    assert "chip0/ici0" in cr.reason and "chip1/ici3" in cr.reason
+
+
+def test_light_flappers_only_degraded(tmp_db):
+    s = Scenario(tmp_db)
+    s.c.flap_threshold = 3
+    s.health(seconds=10, down=["chip0/ici2"])
+    s.health(seconds=10)
+    assert s.health(seconds=10) == D

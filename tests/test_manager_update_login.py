@@ -222,3 +222,33 @@ def test_package_delete_marker_without_init_sh(tmp_path):
     assert pm.package_names() == []  # invisible to the install pass
     pm.reconcile_once()
     assert not d.exists()
+
+
+def test_package_delete_hook_runs_once_when_rmtree_fails(tmp_path, monkeypatch):
+    """If dir removal fails, the delete retries next reconcile but the
+    (non-idempotent) uninstall hook must not re-run."""
+    import shutil as _shutil
+
+    d = _mk_pkg(tmp_path, "wedged")
+    trace = tmp_path / "hook_runs"
+    (d / "uninstall.sh").write_text(f"#!/bin/bash\necho x >> {trace}\n")
+    (d / "delete").write_text("")
+    pm = PackageManager(str(tmp_path / "packages"))
+
+    calls = []
+    real_rmtree = _shutil.rmtree
+
+    def failing_rmtree(path, **kw):
+        calls.append(path)
+        if len(calls) < 3:
+            raise OSError("device busy")
+        real_rmtree(path, **kw)
+
+    monkeypatch.setattr(_shutil, "rmtree", failing_rmtree)
+    pm.reconcile_once()  # hook runs, rmtree fails
+    assert d.exists()
+    pm.reconcile_once()  # rmtree fails again, hook skipped
+    assert d.exists()
+    pm.reconcile_once()  # rmtree succeeds
+    assert not d.exists()
+    assert trace.read_text().count("x") == 1
