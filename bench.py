@@ -121,6 +121,7 @@ def bench_sysfs_ici_detection(trials: int = 12) -> None:
                                ("rx_bytes", "0"), ("crc_errors", "0")):
                 with open(os.path.join(d, fname), "w") as f:
                     f.write(val)
+    prior_ici_root = os.environ.get("TPUD_ICI_SYSFS_ROOT")
     os.environ["TPUD_ICI_SYSFS_ROOT"] = ici_root
     comp = None
     db = None
@@ -182,7 +183,10 @@ def bench_sysfs_ici_detection(trials: int = 12) -> None:
             comp.close()
         if db is not None:
             db.close()
-        os.environ.pop("TPUD_ICI_SYSFS_ROOT", None)
+        if prior_ici_root is None:
+            os.environ.pop("TPUD_ICI_SYSFS_ROOT", None)
+        else:
+            os.environ["TPUD_ICI_SYSFS_ROOT"] = prior_ici_root
 
 
 def bench_tpu_scan() -> None:

@@ -138,11 +138,13 @@ class PackageManager:
         logger.info("deleting package %s", name)
         try:
             hook = os.path.join(pkg_dir, "uninstall.sh")
-            hook_done = os.path.join(pkg_dir, "uninstall_done")
             # run the hook at most once even when dir removal fails and the
             # delete retries every reconcile — uninstall hooks are often
-            # non-idempotent (stop a service, deregister, ...)
-            if os.path.isfile(hook) and not os.path.exists(hook_done):
+            # non-idempotent (stop a service, deregister, ...). The "done"
+            # signal is removing the hook script itself: unlike a marker
+            # file inside the dir, a partially-failed rmtree can only move
+            # this in the safe direction (hook gone → never re-run).
+            if os.path.isfile(hook):
                 r = run_command(
                     ["bash", hook], timeout=INSTALL_TIMEOUT,
                     env={"PACKAGE_DIR": pkg_dir},
@@ -152,7 +154,9 @@ class PackageManager:
                         "package %s uninstall hook failed (exit %d): %s — "
                         "removing anyway", name, r.exit_code, r.output[-500:],
                     )
-                with open(hook_done, "w", encoding="utf-8"):
+                try:
+                    os.unlink(hook)
+                except OSError:
                     pass
             import shutil
 

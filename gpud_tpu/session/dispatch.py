@@ -465,14 +465,42 @@ class Dispatcher:
         return {"token": self.server.metadata.get(KEY_TOKEN)}
 
     def _m_logout(self, req: Dict) -> Dict:
-        """Deregister from the control plane (reference: delete/logout)."""
+        """Deregister from the control plane: purge credentials
+        (reference: logout.go:14-36 purges metadata + stops the daemon)."""
         from gpud_tpu import metadata as md
 
         for key in (md.KEY_TOKEN, md.KEY_MACHINE_PROOF, md.KEY_MACHINE_ID):
             self.server.metadata.delete(key)
         return {"status": "ok"}
 
-    _m_delete = _m_logout
+    def _m_delete(self, req: Dict) -> Dict:
+        """Machine deletion cleanup: mark every managed package for
+        deletion so the package manager's delete loop collects them
+        (reference: session_serve.go:188-218 createNeedDeleteFiles —
+        'needDelete' there, our contract's 'delete' marker here)."""
+        import os as _os
+
+        pkgs_dir = self.server.config.packages_dir()
+        marked = []
+        errors = []
+        if _os.path.isdir(pkgs_dir):
+            for name in sorted(_os.listdir(pkgs_dir)):
+                d = _os.path.join(pkgs_dir, name)
+                if not _os.path.isdir(d):
+                    continue
+                try:
+                    with open(_os.path.join(d, "delete"), "w", encoding="utf-8"):
+                        pass
+                    marked.append(name)
+                except OSError as e:
+                    # keep going: one unwritable dir must not block the
+                    # cleanup of every other package
+                    errors.append(f"{name}: {e}")
+        audit("session_delete", packages=len(marked), errors=len(errors))
+        out: Dict = {"status": "ok", "packages_marked": marked}
+        if errors:
+            out["errors"] = errors
+        return out
 
     # -- packages / update / plugins --------------------------------------
     def _m_packageStatus(self, req: Dict) -> Dict:

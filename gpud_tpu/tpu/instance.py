@@ -315,7 +315,93 @@ def _int_set(spec: str) -> set:
 # Sysfs backend (real TPU VM, side-band — no libtpu open)
 # ---------------------------------------------------------------------------
 
-class SysfsBackend(TPUInstance):
+class SysfsICILinksMixin:
+    """ICI link reads from the deployment-mapped sysfs layout
+    (``TPUD_ICI_SYSFS_ROOT``). Shared by every side-band backend: ICI
+    exposure is a driver/sysfs property, independent of how chips were
+    enumerated (device nodes or the tpu-info CLI)."""
+
+    def _ici_root(self) -> str:
+        return os.environ.get(ENV_ICI_SYSFS_ROOT, "")
+
+    def ici_supported(self) -> bool:
+        root = self._ici_root()
+        return bool(root) and os.path.isdir(root)
+
+    def ici_links(self) -> List[ICILinkSnapshot]:
+        root = self._ici_root()
+        if not root or not os.path.isdir(root):
+            return []
+        out: List[ICILinkSnapshot] = []
+        for chip_dir in sorted(glob.glob(os.path.join(root, "chip[0-9]*"))):
+            cm = re.search(r"chip(\d+)$", chip_dir)
+            if not cm:
+                continue
+            cid = int(cm.group(1))
+            for link_dir in sorted(glob.glob(os.path.join(chip_dir, "ici[0-9]*"))):
+                lm = re.search(r"ici(\d+)$", link_dir)
+                if not lm:
+                    continue
+                snap = self._read_link(cid, int(lm.group(1)), link_dir)
+                if snap is not None:
+                    out.append(snap)
+        return out
+
+    @staticmethod
+    def _read_link(cid: int, lid: int, link_dir: str) -> Optional[ICILinkSnapshot]:
+        """One link sample, or None when this poll's reads are unreliable.
+
+        A transient read failure must be *skipped*, never reported as down:
+        an OSError mapped to "down" would record a CRITICAL drop event, a
+        sticky flap, and a reboot suggestion from one failed file read;
+        likewise a counter read falling back to 0 would fake a huge
+        positive delta (and a CRC alarm) when the next read recovers.
+        FileNotFoundError on a counter means "not mapped" (consistently 0);
+        any other failure poisons the sample → skip.
+        """
+        try:
+            with open(os.path.join(link_dir, "state"), "r", encoding="ascii") as f:
+                state_raw = f.read().strip().lower()
+        except OSError:
+            return None  # unreadable this poll — skip, don't fake "down"
+        if state_raw in ("up", "active", "1"):
+            state = LinkState.UP
+        elif state_raw in ("down", "inactive", "0"):
+            state = LinkState.DOWN
+        else:
+            logger.warning(
+                "unrecognized ICI state %r at %s; skipping sample",
+                state_raw, link_dir,
+            )
+            return None
+
+        def _num(name: str) -> int:
+            path = os.path.join(link_dir, name)
+            try:
+                with open(path, "r", encoding="ascii") as f:
+                    return int(f.read().strip())
+            except FileNotFoundError:
+                return 0  # counter not mapped by this deployment
+            except (OSError, ValueError) as e:
+                raise _UnreliableSample(str(e)) from e
+
+        try:
+            return ICILinkSnapshot(
+                chip_id=cid,
+                link_id=lid,
+                state=state,
+                tx_bytes=_num("tx_bytes"),
+                rx_bytes=_num("rx_bytes"),
+                tx_errors=_num("tx_errors"),
+                rx_errors=_num("rx_errors"),
+                crc_errors=_num("crc_errors"),
+                replays=_num("replays"),
+            )
+        except _UnreliableSample:
+            return None
+
+
+class SysfsBackend(SysfsICILinksMixin, TPUInstance):
     """Enumerates the Google TPU driver's device nodes without opening
     libtpu (side-band monitoring only). Roots are parameterized so sysfs
     fixture trees drive tests (SURVEY §4.4 fixture-directory pattern)."""
@@ -395,86 +481,6 @@ class SysfsBackend(TPUInstance):
 
     def telemetry_supported(self) -> bool:
         return False  # sysfs telemetry not exposed by current drivers
-
-    # -- ICI links via the mapped sysfs layout ----------------------------
-    def _ici_root(self) -> str:
-        return os.environ.get(ENV_ICI_SYSFS_ROOT, "")
-
-    def ici_supported(self) -> bool:
-        root = self._ici_root()
-        return bool(root) and os.path.isdir(root)
-
-    def ici_links(self) -> List[ICILinkSnapshot]:
-        root = self._ici_root()
-        if not root or not os.path.isdir(root):
-            return []
-        out: List[ICILinkSnapshot] = []
-        for chip_dir in sorted(glob.glob(os.path.join(root, "chip[0-9]*"))):
-            cm = re.search(r"chip(\d+)$", chip_dir)
-            if not cm:
-                continue
-            cid = int(cm.group(1))
-            for link_dir in sorted(glob.glob(os.path.join(chip_dir, "ici[0-9]*"))):
-                lm = re.search(r"ici(\d+)$", link_dir)
-                if not lm:
-                    continue
-                snap = self._read_link(cid, int(lm.group(1)), link_dir)
-                if snap is not None:
-                    out.append(snap)
-        return out
-
-    @staticmethod
-    def _read_link(cid: int, lid: int, link_dir: str) -> Optional[ICILinkSnapshot]:
-        """One link sample, or None when this poll's reads are unreliable.
-
-        A transient read failure must be *skipped*, never reported as down:
-        an OSError mapped to "down" would record a CRITICAL drop event, a
-        sticky flap, and a reboot suggestion from one failed file read;
-        likewise a counter read falling back to 0 would fake a huge
-        positive delta (and a CRC alarm) when the next read recovers.
-        FileNotFoundError on a counter means "not mapped" (consistently 0);
-        any other failure poisons the sample → skip.
-        """
-        try:
-            with open(os.path.join(link_dir, "state"), "r", encoding="ascii") as f:
-                state_raw = f.read().strip().lower()
-        except OSError:
-            return None  # unreadable this poll — skip, don't fake "down"
-        if state_raw in ("up", "active", "1"):
-            state = LinkState.UP
-        elif state_raw in ("down", "inactive", "0"):
-            state = LinkState.DOWN
-        else:
-            logger.warning(
-                "unrecognized ICI state %r at %s; skipping sample",
-                state_raw, link_dir,
-            )
-            return None
-
-        def _num(name: str) -> int:
-            path = os.path.join(link_dir, name)
-            try:
-                with open(path, "r", encoding="ascii") as f:
-                    return int(f.read().strip())
-            except FileNotFoundError:
-                return 0  # counter not mapped by this deployment
-            except (OSError, ValueError) as e:
-                raise _UnreliableSample(str(e)) from e
-
-        try:
-            return ICILinkSnapshot(
-                chip_id=cid,
-                link_id=lid,
-                state=state,
-                tx_bytes=_num("tx_bytes"),
-                rx_bytes=_num("rx_bytes"),
-                tx_errors=_num("tx_errors"),
-                rx_errors=_num("rx_errors"),
-                crc_errors=_num("crc_errors"),
-                replays=_num("replays"),
-            )
-        except _UnreliableSample:
-            return None
 
 
 class _UnreliableSample(Exception):

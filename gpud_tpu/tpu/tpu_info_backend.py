@@ -20,7 +20,12 @@ from typing import Callable, Dict, List, Optional
 
 from gpud_tpu.log import get_logger
 from gpud_tpu.process import RunResult, run_command
-from gpud_tpu.tpu.instance import TPUChip, TPUChipTelemetry, TPUInstance
+from gpud_tpu.tpu.instance import (
+    SysfsICILinksMixin,
+    TPUChip,
+    TPUChipTelemetry,
+    TPUInstance,
+)
 from gpud_tpu.tpu.topology import GENERATIONS, normalize_generation
 
 logger = get_logger(__name__)
@@ -51,8 +56,10 @@ def default_runner(args: List[str], timeout: float = ENUMERATE_TIMEOUT) -> RunRe
     return run_command([TPU_INFO_BIN] + args, timeout=timeout)
 
 
-class TpuInfoBackend(TPUInstance):
-    """Side-band enumeration + telemetry via the tpu-info CLI."""
+class TpuInfoBackend(SysfsICILinksMixin, TPUInstance):
+    """Side-band enumeration + telemetry via the tpu-info CLI; ICI links
+    ride the shared sysfs exposure (SysfsICILinksMixin) since the CLI
+    prints no per-link interconnect state."""
 
     def __init__(
         self,

@@ -397,3 +397,27 @@ def test_update_config_rejects_nan_ici_value(dispatch, srv):
                     "configs": {"ici": {"scan_window": float("nan")}}})
     assert any("scan_window" in e for e in out["errors"])
     assert out["updated"] == []
+
+
+def test_session_delete_marks_packages(dispatch, srv, tmp_path):
+    """'delete' ≠ 'logout': delete marks every managed package for the
+    delete loop (reference: session_serve.go createNeedDeleteFiles);
+    logout purges credentials."""
+    import os
+
+    pkgs = srv.config.packages_dir()
+    for n in ("alpha", "beta"):
+        os.makedirs(os.path.join(pkgs, n), exist_ok=True)
+        with open(os.path.join(pkgs, n, "init.sh"), "w") as f:
+            f.write("#!/bin/bash\ntrue\n")
+    out = dispatch({"method": "delete"})
+    assert out["status"] == "ok"
+    assert out["packages_marked"] == ["alpha", "beta"]
+    assert os.path.exists(os.path.join(pkgs, "alpha", "delete"))
+    # credentials untouched by delete (that's logout's job)
+    dispatch({"method": "updateToken", "token": "keepme"})
+    dispatch({"method": "delete"})
+    assert dispatch({"method": "getToken"})["token"] == "keepme"
+    import shutil
+
+    shutil.rmtree(pkgs, ignore_errors=True)

@@ -245,8 +245,9 @@ def test_package_delete_hook_runs_once_when_rmtree_fails(tmp_path, monkeypatch):
         real_rmtree(path, **kw)
 
     monkeypatch.setattr(_shutil, "rmtree", failing_rmtree)
-    pm.reconcile_once()  # hook runs, rmtree fails
+    pm.reconcile_once()  # hook runs (and is consumed), rmtree fails
     assert d.exists()
+    assert not (d / "uninstall.sh").exists()  # done-signal: hook removed
     pm.reconcile_once()  # rmtree fails again, hook skipped
     assert d.exists()
     pm.reconcile_once()  # rmtree succeeds

@@ -105,3 +105,31 @@ def test_explicit_accelerator_type_wins():
     b = TpuInfoBackend(accelerator_type="v4-16", run_fn=_runner(FIXTURE_V4))
     assert b.accelerator_type() == "v4-16"
     assert b.topology().hosts == 2
+
+
+def test_tpu_info_backend_ici_via_sysfs(tmp_path, monkeypatch):
+    """ICI links ride the shared sysfs exposure even when chips were
+    enumerated via the CLI (the CLI prints no per-link state)."""
+    from gpud_tpu.tpu.instance import LinkState
+
+    root = tmp_path / "ici"
+    for c in range(2):
+        for l in range(2):
+            d = root / f"chip{c}" / f"ici{l}"
+            d.mkdir(parents=True)
+            (d / "state").write_text("down" if (c, l) == (1, 0) else "up")
+            (d / "crc_errors").write_text("7")
+    monkeypatch.setenv("TPUD_ICI_SYSFS_ROOT", str(root))
+    b = TpuInfoBackend(run_fn=_runner(FIXTURE_V4))
+    assert b.ici_supported()
+    links = {x.name: x for x in b.ici_links()}
+    assert len(links) == 4
+    assert links["chip1/ici0"].state == LinkState.DOWN
+    assert links["chip0/ici0"].crc_errors == 7
+
+
+def test_tpu_info_backend_ici_unsupported_without_root(monkeypatch):
+    monkeypatch.delenv("TPUD_ICI_SYSFS_ROOT", raising=False)
+    b = TpuInfoBackend(run_fn=_runner(FIXTURE_V4))
+    assert not b.ici_supported()
+    assert b.ici_links() == []
