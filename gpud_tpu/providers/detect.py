@@ -115,19 +115,83 @@ def detect_azure(get_fn: Callable = _http_get) -> Optional[DetectResult]:
         return None
 
 
+def detect_oci(get_fn: Callable = _http_get) -> Optional[DetectResult]:
+    """OCI IMDS v2 (reference: pkg/providers/oci/imds/imds.go:14 —
+    opc/v2 with the Bearer Oracle header)."""
+    base = "http://169.254.169.254/opc/v2"
+    h = {"Authorization": "Bearer Oracle"}
+    try:
+        region = get_fn(f"{base}/instance/canonicalRegionName", h)
+    except Exception:  # noqa: BLE001
+        return None
+    res = DetectResult(provider="oci", region=region)
+    try:
+        res.instance_type = get_fn(f"{base}/instance/shape", h)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        res.zone = get_fn(f"{base}/instance/availabilityDomain", h)
+    except Exception:  # noqa: BLE001
+        pass
+    return res
+
+
+# nebius/nscale mount instance identity as files, not an IMDS endpoint
+# (reference: pkg/providers/nebius/nebius.go:10, nscale.go — both read
+# /mnt/cloud-metadata)
+CLOUD_METADATA_PATH = "/mnt/cloud-metadata"
+
+
+def detect_metadata_mount(root: str = "") -> Optional[DetectResult]:
+    import os
+
+    base = root or CLOUD_METADATA_PATH
+    if not os.path.isdir(base):
+        return None
+
+    def read(name: str) -> str:
+        try:
+            with open(os.path.join(base, name), "r", encoding="utf-8") as f:
+                return f.read().strip()
+        except OSError:
+            return ""
+
+    parent = read("parent-id")
+    instance = read("instance-id")
+    if not parent or not instance:
+        return None
+    cluster = read("gpu-cluster-id")
+    parts = [parent] + ([cluster] if cluster else []) + [instance]
+    # both nebius and nscale use this mount; distinguish on best-effort
+    # markers, defaulting to nebius (reference keeps them as two detectors
+    # over the same path)
+    provider = "nscale" if read("org-id") else "nebius"
+    return DetectResult(
+        provider=provider,
+        raw={"instance_id": "/".join(parts)},
+    )
+
+
 DETECTORS: List[Callable[[], Optional[DetectResult]]] = [
     detect_gcp,
     detect_aws,
     detect_azure,
+    detect_oci,
+    detect_metadata_mount,
 ]
 
 
 def detect(timeout: float = 5.0) -> DetectResult:
     """Try all detectors concurrently; first hit wins, GCP preferred
-    (reference: detect.go runs per-cloud fetchers and falls back to ASN)."""
-    with concurrent.futures.ThreadPoolExecutor(max_workers=len(DETECTORS)) as ex:
+    (reference: detect.go runs per-cloud fetchers and falls back to ASN).
+
+    ``timeout`` is a real wall-clock bound: straggler detectors (e.g.
+    blackholed IMDS on firewalled hosts) are abandoned, not joined — their
+    threads die with their own HTTP timeouts."""
+    ex = concurrent.futures.ThreadPoolExecutor(max_workers=len(DETECTORS))
+    results: Dict[str, DetectResult] = {}
+    try:
         futures = {ex.submit(d): d.__name__ for d in DETECTORS}
-        results: Dict[str, DetectResult] = {}
         try:
             for fut in concurrent.futures.as_completed(futures, timeout=timeout):
                 r = fut.result()
@@ -135,7 +199,9 @@ def detect(timeout: float = 5.0) -> DetectResult:
                     results[r.provider] = r
         except concurrent.futures.TimeoutError:
             pass
-    for preferred in ("gcp", "aws", "azure"):
+    finally:
+        ex.shutdown(wait=False, cancel_futures=True)
+    for preferred in ("gcp", "aws", "azure", "oci", "nebius", "nscale"):
         if preferred in results:
             return results[preferred]
     # no IMDS answered: fall back to the ASN lookup. public_ip() only knows

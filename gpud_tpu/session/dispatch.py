@@ -477,7 +477,11 @@ class Dispatcher:
         """Machine deletion cleanup: mark every managed package for
         deletion so the package manager's delete loop collects them
         (reference: session_serve.go:188-218 createNeedDeleteFiles —
-        'needDelete' there, our contract's 'delete' marker here)."""
+        'needDelete' there, our contract's 'delete' marker here).
+
+        Deliberately does NOT purge credentials: that is logout's job, and
+        the reference control plane sends both methods for a machine
+        deletion (delete → package cleanup, logout → creds purge + stop)."""
         import os as _os
 
         pkgs_dir = self.server.config.packages_dir()

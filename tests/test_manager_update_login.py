@@ -253,3 +253,39 @@ def test_package_delete_hook_runs_once_when_rmtree_fails(tmp_path, monkeypatch):
     pm.reconcile_once()  # rmtree succeeds
     assert not d.exists()
     assert trace.read_text().count("x") == 1
+
+
+def test_detect_oci_with_fake_imds():
+    from gpud_tpu.providers.detect import detect_oci
+
+    def fake_get(url, headers, timeout=1.5):
+        assert headers == {"Authorization": "Bearer Oracle"}
+        if url.endswith("canonicalRegionName"):
+            return "us-ashburn-1"
+        if url.endswith("shape"):
+            return "BM.GPU.H100.8"
+        if url.endswith("availabilityDomain"):
+            return "AD-1"
+        raise AssertionError(url)
+
+    r = detect_oci(get_fn=fake_get)
+    assert r.provider == "oci"
+    assert r.region == "us-ashburn-1"
+    assert r.instance_type == "BM.GPU.H100.8"
+    assert r.zone == "AD-1"
+
+
+def test_detect_metadata_mount(tmp_path):
+    from gpud_tpu.providers.detect import detect_metadata_mount
+
+    assert detect_metadata_mount(root=str(tmp_path / "nope")) is None
+    (tmp_path / "parent-id").write_text("proj-1\n")
+    (tmp_path / "instance-id").write_text("inst-9\n")
+    r = detect_metadata_mount(root=str(tmp_path))
+    assert r.provider == "nebius"
+    assert r.raw["instance_id"] == "proj-1/inst-9"
+    (tmp_path / "gpu-cluster-id").write_text("clu-2")
+    (tmp_path / "org-id").write_text("org-7")
+    r = detect_metadata_mount(root=str(tmp_path))
+    assert r.provider == "nscale"
+    assert r.raw["instance_id"] == "proj-1/clu-2/inst-9"
