@@ -8,6 +8,8 @@ telemetry sample.
 
 from __future__ import annotations
 
+import collections
+
 from gpud_tpu.api.v1.types import HealthStateType
 from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
 from gpud_tpu.components.tpu.shared import sampler_for
@@ -19,6 +21,18 @@ _g_power = gauge("tpud_tpu_power_watts", "TPU chip power draw")
 _g_duty = gauge("tpud_tpu_duty_cycle_percent", "TensorCore duty cycle")
 _g_util = gauge("tpud_tpu_tensorcore_util_percent", "TensorCore utilization")
 _g_clock = gauge("tpud_tpu_clock_mhz", "TPU core clock")
+# sampled-over-interval analog of the reference's GPM metrics (SM occupancy
+# sampled over a GPM window, gpm/component.go:34): a point-in-time duty
+# cycle aliases badly against bursty training steps, so a windowed mean
+# over recent samples is exported alongside the instantaneous value. The
+# window is time-based (not poll-count) so on-demand triggered checks
+# can't evict real history with duplicate cached samples.
+_g_duty_avg = gauge(
+    "tpud_tpu_duty_cycle_avg_percent",
+    "TensorCore duty cycle averaged over the sampling window",
+)
+
+SAMPLING_WINDOW_SECONDS = 300.0  # ≈5 polls at the default cadence
 
 
 class TPUPowerComponent(PollingComponent):
@@ -29,6 +43,13 @@ class TPUPowerComponent(PollingComponent):
         super().__init__(instance)
         self.tpu = instance.tpu_instance
         self.sampler = sampler_for(self.tpu)
+        import threading
+        import time
+
+        self.sampling_window_seconds = SAMPLING_WINDOW_SECONDS
+        self.time_now_fn = time.time
+        self._hist_mu = threading.Lock()  # triggered checks race the poller
+        self._duty_hist: dict = {}  # chip_id → deque of (ts, duty) samples
 
     def is_supported(self) -> bool:
         return (
@@ -45,14 +66,31 @@ class TPUPowerComponent(PollingComponent):
                 reason="no TPU telemetry on this host",
             )
         tel = self.sampler.telemetry()
+        now = self.time_now_fn()
         total_w = 0.0
         extra = {}
+        with self._hist_mu:
+            # prune chips gone from telemetry: hours-old samples from a
+            # reset chip must not blend into its average when it returns
+            for gone in set(self._duty_hist) - set(tel):
+                del self._duty_hist[gone]
         for cid, t in sorted(tel.items()):
             labels = {"component": NAME, "chip": str(cid)}
             _g_power.set(t.power_w, labels)
             _g_duty.set(t.duty_cycle_pct, labels)
             _g_util.set(t.tensorcore_util_pct, labels)
             _g_clock.set(t.clock_mhz, labels)
+            with self._hist_mu:
+                hist = self._duty_hist.setdefault(cid, collections.deque())
+                # one sample per sampler refresh: a triggered check inside
+                # the sampler TTL re-reads the same cached value
+                if not hist or now - hist[-1][0] >= self.sampler.ttl:
+                    hist.append((now, t.duty_cycle_pct))
+                cutoff = now - self.sampling_window_seconds
+                while hist and hist[0][0] < cutoff:
+                    hist.popleft()
+                avg = sum(v for _ts, v in hist) / len(hist)
+            _g_duty_avg.set(avg, labels)
             total_w += t.power_w
             extra[f"chip{cid}_power_w"] = f"{t.power_w:.1f}"
             extra[f"chip{cid}_duty_pct"] = f"{t.duty_cycle_pct:.1f}"

@@ -310,6 +310,45 @@ class Dispatcher:
                 existing[k] = v
         self.server.metadata.set(KEY_CONFIG_OVERRIDES, _json.dumps(existing))
 
+    def _apply_numeric_section(
+        self,
+        section: str,
+        comp_name: str,
+        cfgs: Dict,
+        key_min: Dict[str, float],
+        updated: list,
+        applied: Dict,
+        errors: list,
+    ) -> None:
+        """Shared coerce/validate/apply/record loop for a section of
+        numeric component attributes. Values are coerced to the attribute's
+        current type; `not >=` rejects NaN (json.loads accepts the NaN
+        token) as well as below-minimum values; a valid push against a
+        disabled component errors instead of vanishing silently."""
+        cfg = cfgs.get(section)
+        if cfg is None:
+            return
+        if not isinstance(cfg, dict):
+            errors.append(f"{section}: must be an object")
+            return
+        comp = self.server.registry.get(comp_name)
+        if comp is None:
+            if cfg:
+                errors.append(f"{section}: component disabled on this host")
+            return
+        for key, minv in key_min.items():
+            if key not in cfg:
+                continue
+            try:
+                val = type(getattr(comp, key))(cfg[key])
+                if not val >= minv:
+                    raise ValueError(f"must be >= {minv}")
+                setattr(comp, key, val)
+                updated.append(f"{section}.{key}")
+                applied.setdefault(section, {})[key] = val
+            except (TypeError, ValueError) as e:
+                errors.append(f"{section}.{key}: {e}")
+
     def apply_config_overrides(self, cfgs: Dict):
         """Apply overrides key-by-key; one invalid value must not block the
         rest. Returns (updated_names, applied_subset, errors)."""
@@ -328,31 +367,17 @@ class Dispatcher:
                     applied["expected_chip_count"] = n
             except (TypeError, ValueError) as e:
                 errors.append(f"expected_chip_count: {e}")
-        ici_cfg = cfgs.get("ici")
-        if ici_cfg is not None and not isinstance(ici_cfg, dict):
-            errors.append("ici: must be an object")
-            ici_cfg = None
-        if isinstance(ici_cfg, dict):
-            comp = self.server.registry.get("accelerator-tpu-ici")
-            if comp is not None:
-                for key in ("flap_threshold", "crc_delta_degraded",
-                            "auto_clear_window", "scan_window",
-                            "expected_links"):
-                    if key not in ici_cfg:
-                        continue
-                    try:
-                        val = type(getattr(comp, key))(ici_cfg[key])
-                        # all ici keys are thresholds/windows/counts — a
-                        # negative would be reported 'applied' but do
-                        # nothing (or misbehave); `not >=` also rejects NaN
-                        # (json.loads accepts the NaN token)
-                        if not val >= 0:
-                            raise ValueError("must be >= 0")
-                        setattr(comp, key, val)
-                        updated.append(f"ici.{key}")
-                        applied.setdefault("ici", {})[key] = val
-                    except (TypeError, ValueError) as e:
-                        errors.append(f"ici.{key}: {e}")
+        self._apply_numeric_section(
+            "ici", "accelerator-tpu-ici", cfgs,
+            {
+                "flap_threshold": 0,
+                "crc_delta_degraded": 0,
+                "auto_clear_window": 0,   # 0 = sticky until set-healthy
+                "scan_window": 60,        # sub-minute windows see no polls
+                "expected_links": 0,      # 0 = derive from topology
+            },
+            updated, applied, errors,
+        )
         nfs_cfg = cfgs.get("nfs_groups")
         if nfs_cfg is not None and not isinstance(nfs_cfg, list):
             errors.append("nfs_groups: must be a list of group objects")
@@ -433,23 +458,22 @@ class Dispatcher:
                 comp.reboot_threshold_overrides[name] = thr
                 updated.append(f"error_thresholds.{name}")
                 applied.setdefault("error_thresholds", {})[name] = thr
-        t_cfg = cfgs.get("temperature")
-        if t_cfg is not None and not isinstance(t_cfg, dict):
-            errors.append("temperature: must be an object")
-            t_cfg = None
-        if isinstance(t_cfg, dict):
-            comp = self.server.registry.get("accelerator-tpu-temperature")
-            if comp is not None:
-                for key in ("degraded_c", "unhealthy_c"):
-                    if key not in t_cfg:
-                        continue
-                    try:
-                        val = float(t_cfg[key])
-                        setattr(comp, key, val)
-                        updated.append(f"temperature.{key}")
-                        applied.setdefault("temperature", {})[key] = val
-                    except (TypeError, ValueError) as e:
-                        errors.append(f"temperature.{key}: {e}")
+        self._apply_numeric_section(
+            "anomaly", "accelerator-tpu-anomaly", cfgs,
+            {
+                # zero would silently disable scoring (or flag everything)
+                # while reporting 'applied' — require sane floors
+                "score_degraded": 0.1,
+                "lookback_seconds": 60,
+                "min_samples": 2,
+            },
+            updated, applied, errors,
+        )
+        self._apply_numeric_section(
+            "temperature", "accelerator-tpu-temperature", cfgs,
+            {"degraded_c": 1, "unhealthy_c": 1},
+            updated, applied, errors,
+        )
         return updated, applied, errors
 
     def _m_updateToken(self, req: Dict) -> Dict:

@@ -84,3 +84,51 @@ def test_chip_counts_enumeration_error():
     cr = c.check()
     assert cr.health_state_type() == HealthStateType.UNHEALTHY
     assert "injected" in cr.summary()
+
+
+def test_power_duty_cycle_sampled_average():
+    """GPM analog: duty cycle averaged over a time-based sampling window
+    (reference: gpm/component.go:34 sampling). Triggered checks inside the
+    sampler TTL must not stuff duplicate samples, and samples age out."""
+    from gpud_tpu.components.base import TpudInstance
+    from gpud_tpu.components.tpu.power import TPUPowerComponent
+    from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
+    from gpud_tpu.tpu.instance import MockBackend
+
+    c = TPUPowerComponent(TpudInstance(tpu_instance=MockBackend()))
+    c.sampler.ttl = 10.0
+    c.sampling_window_seconds = 150.0
+    now = [1000.0]
+    c.time_now_fn = lambda: now[0]
+    c.sampler.time_now_fn = lambda: now[0]
+    duties = iter([10.0, 20.0, 30.0, 40.0, 99.0])
+    real_tel = c.tpu.telemetry
+
+    def fake_tel():
+        d = next(duties)
+        tel = real_tel()
+        for t in tel.values():
+            t.duty_cycle_pct = d
+        return tel
+
+    c.tpu.telemetry = fake_tel
+    for _ in range(3):
+        c.check()
+        now[0] += 60.0
+    now[0] -= 60.0  # back to the third poll's timestamp
+    # a triggered check within the sampler TTL re-reads the cached sample
+    # and must NOT append a duplicate
+    now[0] += 5.0
+    c.check()
+    hist = c._duty_hist[0]
+    assert [v for _ts, v in hist] == [10.0, 20.0, 30.0]
+    # next real poll: fresh sample appended, the oldest ages out of the
+    # 150s window
+    now[0] += 55.0
+    c.check()
+    hist = c._duty_hist[0]
+    assert [v for _ts, v in hist] == [20.0, 30.0, 40.0]
+    rows = DEFAULT_REGISTRY.gather(0)
+    avg = [v for _ts, n, l, v in rows
+           if n == "tpud_tpu_duty_cycle_avg_percent" and l.get("chip") == "0"]
+    assert avg and abs(avg[0] - 30.0) < 1e-6

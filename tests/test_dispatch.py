@@ -421,3 +421,36 @@ def test_session_delete_marks_packages(dispatch, srv, tmp_path):
     import shutil
 
     shutil.rmtree(pkgs, ignore_errors=True)
+
+
+def test_update_config_anomaly_thresholds(dispatch, srv):
+    an = srv.registry.get("accelerator-tpu-anomaly")
+    try:
+        out = dispatch({"method": "updateConfig", "configs": {"anomaly": {
+            "score_degraded": 9.5, "min_samples": 12, "lookback_seconds": -5,
+        }}})
+        assert "anomaly.score_degraded" in out["updated"]
+        assert "anomaly.min_samples" in out["updated"]
+        assert any("lookback_seconds" in e for e in out["errors"])
+        assert an.score_degraded == 9.5 and an.min_samples == 12
+    finally:
+        from gpud_tpu.components.tpu.anomaly import (
+            DEFAULT_SCORE_DEGRADED,
+            MIN_SAMPLES,
+        )
+        from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
+
+        an.score_degraded = DEFAULT_SCORE_DEGRADED
+        an.min_samples = MIN_SAMPLES
+        srv.metadata.delete(KEY_CONFIG_OVERRIDES)
+
+
+def test_update_config_anomaly_rejects_disabling_zeroes(dispatch, srv):
+    an = srv.registry.get("accelerator-tpu-anomaly")
+    orig = an.score_degraded
+    out = dispatch({"method": "updateConfig", "configs": {"anomaly": {
+        "score_degraded": 0, "lookback_seconds": 0,
+    }}})
+    assert len(out["errors"]) == 2
+    assert out["updated"] == []
+    assert an.score_degraded == orig
