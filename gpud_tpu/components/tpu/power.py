@@ -9,6 +9,8 @@ telemetry sample.
 from __future__ import annotations
 
 import collections
+import threading
+import time
 
 from gpud_tpu.api.v1.types import HealthStateType
 from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
@@ -43,9 +45,6 @@ class TPUPowerComponent(PollingComponent):
         super().__init__(instance)
         self.tpu = instance.tpu_instance
         self.sampler = sampler_for(self.tpu)
-        import threading
-        import time
-
         self.sampling_window_seconds = SAMPLING_WINDOW_SECONDS
         self.time_now_fn = time.time
         self._hist_mu = threading.Lock()  # triggered checks race the poller

@@ -373,7 +373,10 @@ class Dispatcher:
                 "flap_threshold": 0,
                 "crc_delta_degraded": 0,
                 "auto_clear_window": 0,   # 0 = sticky until set-healthy
-                "scan_window": 60,        # sub-minute windows see no polls
+                # any positive window is accepted: a stricter floor here
+                # would silently drop previously-persisted overrides at
+                # boot replay
+                "scan_window": 1,
                 "expected_links": 0,      # 0 = derive from topology
             },
             updated, applied, errors,
