@@ -75,6 +75,36 @@ def cmd_scan(args) -> int:
     return 1 if unhealthy and args.strict else 0
 
 
+def cmd_fleet_scan(args) -> int:
+    """Fleet-wide ICI history sweep on the accelerator — the pod-scale
+    companion to the per-host ici component (gpud_tpu/fleet_scan.py)."""
+    import json as _json
+
+    from gpud_tpu.fleet_scan import fleet_scan
+
+    res = fleet_scan(
+        args.dbs,
+        window_seconds=args.window,
+        step_seconds=args.step,
+        flap_threshold=args.flap_threshold,
+        crc_threshold=args.crc_threshold,
+    )
+    if args.as_json:
+        print(_json.dumps(res, indent=2, sort_keys=True))
+    else:
+        s = res["summary"]
+        print(
+            f"{len(res['links'])} links across {len(args.dbs)} host DB(s) "
+            f"on {res['devices']} device(s): "
+            f"{s['healthy']} healthy, {s['degraded']} degraded, "
+            f"{s['unhealthy']} unhealthy"
+        )
+        for name, label in sorted(res["links"].items()):
+            if label != "healthy":
+                print(f"  {label:9s}  {name}")
+    return 1 if res["summary"]["unhealthy"] else 0
+
+
 def cmd_run(args) -> int:
     """Reference: cmd/gpud run → pkg/server.New (SURVEY §3.1)."""
     cfg = _build_config(args)
@@ -427,6 +457,21 @@ def build_parser() -> argparse.ArgumentParser:
     ps.add_argument("--accelerator-type", default="")
     ps.add_argument("--strict", action="store_true", help="exit 1 on any unhealthy check")
     ps.set_defaults(fn=cmd_scan)
+
+    pfs = sub.add_parser(
+        "fleet-scan",
+        help="accelerated sweep over many hosts' ICI history DBs",
+    )
+    pfs.add_argument("dbs", nargs="+", help="per-host tpud state DB files")
+    pfs.add_argument("--window", type=float, default=3600.0,
+                     help="scan window in seconds")
+    pfs.add_argument("--step", type=float, default=60.0,
+                     help="time-bucket size in seconds")
+    pfs.add_argument("--flap-threshold", type=int, default=3)
+    pfs.add_argument("--crc-threshold", type=int, default=100)
+    pfs.add_argument("--json", action="store_true", dest="as_json",
+                     help="print the full result as JSON")
+    pfs.set_defaults(fn=cmd_fleet_scan)
 
     pr = sub.add_parser("run", help="run the daemon")
     _add_common_flags(pr)

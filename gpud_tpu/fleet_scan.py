@@ -1,0 +1,243 @@
+"""Fleet-wide ICI history scan — the control-plane-side analytics tool.
+
+Per-host daemons keep 14 days of per-link snapshots in their state DBs
+(components/tpu/ici_store.py, the reference's IB-store analog). At pod
+scale an operator wants one sweep over every host's history — v5p-256 ⇒
+128 chips × 6 links × 1440 samples/day — which is exactly the shape the
+accelerated scan kernels were built for (ops/window_scan.py): the whole
+fleet's history packs into [L, T] arrays, the scan shards along L over a
+device mesh (parallel/fleet.py), and XLA fuses the pass into a few
+kernels.
+
+Entry point: ``tpud fleet-scan host1.db host2.db ... [--window S]``.
+Each DB is opened read-only; link names are prefixed with the DB's stem
+(disambiguated when two DBs share a filename) and set-healthy tombstones
+are honored exactly like the per-host scan.
+
+Granularity: history is bucketed into ``--step`` time slots (default 60s,
+matching the daemon's poll cadence, so normally one sample per bucket).
+Multiple samples inside one bucket collapse to the last — flaps faster
+than the step are a per-host concern (ICIStore.scan walks every snapshot);
+this tool trades that sub-step detail for fleet scale.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import time
+from typing import Dict, List, Optional, Tuple
+
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+TABLE = "tpud_ici_snapshots_v0_1"  # components/tpu/ici_store.py schema
+TOMBSTONE_TABLE = "tpud_ici_tombstones_v0_1"
+
+DEFAULT_WINDOW_SECONDS = 3600.0
+DEFAULT_STEP_SECONDS = 60.0
+# dense-array bound: 14 days of minutes. A window/step pair exceeding this
+# is coarsened (larger effective step) instead of materializing a huge
+# [L, T] array that can OOM the compiler.
+MAX_STEPS = 20160
+
+
+def load_fleet_history(
+    db_paths: List[str],
+    window_seconds: float = DEFAULT_WINDOW_SECONDS,
+    step_seconds: float = DEFAULT_STEP_SECONDS,
+    now: Optional[float] = None,
+):
+    """Read every host DB's snapshots in the window into dense arrays.
+
+    Returns (names, states, counters, valid) where names[i] labels row i
+    as ``<host>/<link>``; arrays are [L, T] per scan_links' layout.
+    """
+    import numpy as np
+
+    t_now = now if now is not None else time.time()
+    start = t_now - window_seconds
+    n_steps = max(1, int(window_seconds / step_seconds))
+    if n_steps > MAX_STEPS:
+        step_seconds = window_seconds / MAX_STEPS
+        n_steps = MAX_STEPS
+        logger.info(
+            "fleet-scan window coarsened to %.0fs buckets (%d steps)",
+            step_seconds, n_steps,
+        )
+
+    from urllib.parse import quote
+
+    rows: List[Tuple[str, int, int, int]] = []
+    names: List[str] = []
+    index: Dict[str, int] = {}
+    used_hosts: Dict[str, int] = {}
+    for path in db_paths:
+        host = os.path.splitext(os.path.basename(path))[0]
+        # two DBs named host1.db in different dirs must not merge
+        n_seen = used_hosts.get(host, 0)
+        used_hosts[host] = n_seen + 1
+        if n_seen:
+            host = f"{host}-{n_seen + 1}"
+        # immutable=1 would reject WAL files; ro mode is enough. Escape the
+        # path: '?', '#' or '%' would otherwise be URI-parsed.
+        uri = f"file:{quote(os.path.abspath(path))}?mode=ro"
+        conn = sqlite3.connect(uri, uri=True)
+        try:
+            tombstones = {}
+            try:
+                tombstones = dict(
+                    conn.execute(f"SELECT link, ts FROM {TOMBSTONE_TABLE}")
+                )
+            except sqlite3.OperationalError:
+                pass  # older DB without the table
+            global_ts = tombstones.get("*", 0.0)
+            cur = conn.execute(
+                f"SELECT link, ts, state, crc_errors FROM {TABLE} "
+                "WHERE ts>=? ORDER BY link, ts ASC",
+                (start,),
+            )
+            for link, ts, state, crc in cur:
+                # honor set-healthy exactly like ICIStore.scan
+                if ts < max(global_ts, tombstones.get(link, 0.0)):
+                    continue
+                name = f"{host}/{link}"
+                if name not in index:
+                    index[name] = len(names)
+                    names.append(name)
+                step = int((ts - start) / step_seconds)
+                rows.append((name, min(step, n_steps - 1), int(state), int(crc)))
+        finally:
+            conn.close()
+
+    if not names:
+        z = np.zeros((0, n_steps), dtype=np.int8)
+        return [], z, z.astype(np.int32), z.astype(bool)
+
+    from gpud_tpu.ops.window_scan import scan_numpy_bridge
+
+    states, counters, valid = scan_numpy_bridge(rows, index, len(names), n_steps)
+    return names, states, counters, valid
+
+
+def _scan_links_numpy(
+    states, counters, valid, flap_threshold: int = 3, crc_threshold: int = 100
+):
+    """Pure-numpy twin of ops/window_scan.scan_links + classify_links
+    (forward-fill across gaps, positive counter steps, same class rules);
+    parity-tested against the JAX kernels."""
+    import numpy as np
+
+    states = states.astype(np.int8)
+    valid = valid.astype(bool)
+    L, T = states.shape
+
+    # forward-fill last valid state/counter along time
+    idx = np.where(valid, np.arange(T)[None, :], -1)
+    ff_idx = np.maximum.accumulate(idx, axis=1)
+    has_ff = ff_idx >= 0
+    safe_idx = np.maximum(ff_idx, 0)
+    state_ff = np.take_along_axis(states, safe_idx, axis=1)
+    counter_ff = np.take_along_axis(counters, safe_idx, axis=1)
+
+    prev, prev_has = state_ff[:, :-1], has_ff[:, :-1]
+    nxt = states[:, 1:]
+    v_pair = valid[:, 1:] & prev_has
+    drops = np.sum((prev == 1) & (nxt == 0) & v_pair, axis=1)
+    flaps = np.sum((prev == 0) & (nxt == 1) & v_pair, axis=1)
+
+    last_idx = T - 1 - np.argmax(valid[:, ::-1], axis=1)
+    has_any = valid.any(axis=1)
+    last_state = np.take_along_axis(states, last_idx[:, None], axis=1)[:, 0]
+    currently_down = has_any & (last_state == 0)
+
+    diffs = counters[:, 1:] - counter_ff[:, :-1]
+    counter_delta = np.sum(np.where(v_pair, np.maximum(diffs, 0), 0), axis=1)
+
+    heavy = (drops >= flap_threshold) | (flaps >= flap_threshold)
+    unhealthy = currently_down | heavy
+    degraded = (drops > 0) | (flaps > 0) | (counter_delta >= crc_threshold)
+    return np.where(unhealthy, 2, np.where(degraded, 1, 0)).astype(np.int32)
+
+
+def fleet_scan(
+    db_paths: List[str],
+    window_seconds: float = DEFAULT_WINDOW_SECONDS,
+    step_seconds: float = DEFAULT_STEP_SECONDS,
+    flap_threshold: int = 3,
+    crc_threshold: int = 100,
+    now: Optional[float] = None,
+) -> dict:
+    """Scan the fleet's link history on the accelerator (sharded over the
+    device mesh when more than one device is visible).
+
+    Returns {"links": {name: "healthy|degraded|unhealthy"},
+             "summary": {...}, "devices": n, "window_seconds": S}.
+    """
+    import numpy as np
+
+    names, states, counters, valid = load_fleet_history(
+        db_paths, window_seconds, step_seconds, now=now
+    )
+    out = {
+        "window_seconds": window_seconds,
+        "links": {},
+        "summary": {"healthy": 0, "degraded": 0, "unhealthy": 0},
+        "devices": 0,
+    }
+    if not names:
+        return out
+
+    import jax
+
+    from gpud_tpu.ops.window_scan import classify_links, scan_links
+    from gpud_tpu.parallel.fleet import make_mesh, sharded_link_scan
+
+    def run_scan():
+        n_devices = len(jax.devices())
+        out["devices"] = n_devices
+        if n_devices > 1:
+            # pad L to a multiple of the mesh so the shard is even; padded
+            # rows are all-invalid → class 0, dropped after
+            pad = (-len(names)) % n_devices
+            st, ct, vl = states, counters, valid
+            if pad:
+                st = np.pad(st, ((0, pad), (0, 0)))
+                ct = np.pad(ct, ((0, pad), (0, 0)))
+                vl = np.pad(vl, ((0, pad), (0, 0)))
+            mesh = make_mesh(n_devices)
+            _scan, cls = sharded_link_scan(
+                mesh, st, ct, vl,
+                flap_threshold=flap_threshold, crc_threshold=crc_threshold,
+            )
+            return np.asarray(cls)[: len(names)]
+        scan = scan_links(states, counters, valid)
+        return np.asarray(
+            classify_links(
+                scan, flap_threshold=flap_threshold, crc_threshold=crc_threshold
+            )
+        )
+
+    try:
+        classes = run_scan()
+    except Exception as e:  # noqa: BLE001 — a broken accelerator runtime
+        # must not take the diagnostic tool down with it: a pure-numpy
+        # twin of the scan runs anywhere (switching jax backends after
+        # initialization is not reliable)
+        logger.warning("fleet scan on the accelerator failed (%s); "
+                       "falling back to the numpy scan", e)
+        out["devices"] = 0
+        classes = _scan_links_numpy(
+            states, counters, valid,
+            flap_threshold=flap_threshold, crc_threshold=crc_threshold,
+        )
+
+    class_names = {0: "healthy", 1: "degraded", 2: "unhealthy"}
+    summary = {"healthy": 0, "degraded": 0, "unhealthy": 0}
+    for name, c in zip(names, classes):
+        label = class_names[int(c)]
+        out["links"][name] = label
+        summary[label] += 1
+    out["summary"] = summary
+    return out

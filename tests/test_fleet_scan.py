@@ -1,0 +1,159 @@
+"""Fleet-wide ICI history scan: many host DBs → one accelerated sweep
+(sharded over the virtual 8-device CPU mesh from conftest)."""
+
+import time
+
+from gpud_tpu.components.tpu.ici_store import ICIStore
+from gpud_tpu.fleet_scan import fleet_scan, load_fleet_history
+from gpud_tpu.sqlite import DB
+from gpud_tpu.tpu.instance import ICILinkSnapshot, LinkState
+
+NOW = 1_700_000_000.0
+
+
+def _mk_host_db(path, down=(), flappy=(), crc_hot=(), n_chips=2, n_links=2):
+    db = DB(str(path))
+    store = ICIStore(db)
+    store.time_now_fn = lambda: NOW
+    for minute in range(30):
+        ts = NOW - (30 - minute) * 60
+        links = []
+        for c in range(n_chips):
+            for l in range(n_links):
+                name = f"chip{c}/ici{l}"
+                state = LinkState.UP
+                if name in down and minute >= 20:
+                    state = LinkState.DOWN
+                if name in flappy and minute % 4 < 2:
+                    state = LinkState.DOWN
+                links.append(
+                    ICILinkSnapshot(
+                        chip_id=c, link_id=l, state=state,
+                        crc_errors=minute * 50 if name in crc_hot else 0,
+                    )
+                )
+        store.insert_snapshot(links, ts=ts)
+    db.close()
+
+
+def test_load_fleet_history_shapes(tmp_path):
+    _mk_host_db(tmp_path / "hostA.db")
+    _mk_host_db(tmp_path / "hostB.db")
+    names, states, counters, valid = load_fleet_history(
+        [str(tmp_path / "hostA.db"), str(tmp_path / "hostB.db")],
+        window_seconds=3600, now=NOW,
+    )
+    assert len(names) == 8  # 2 hosts × 2 chips × 2 links
+    assert all(n.startswith(("hostA/", "hostB/")) for n in names)
+    assert states.shape == (8, 60)
+    assert valid.any(axis=1).all()
+
+
+def test_fleet_scan_classifies_across_hosts(tmp_path):
+    _mk_host_db(tmp_path / "hostA.db", down=("chip0/ici0",))
+    _mk_host_db(tmp_path / "hostB.db", flappy=("chip1/ici1",))
+    _mk_host_db(tmp_path / "hostC.db", crc_hot=("chip0/ici1",))
+    res = fleet_scan(
+        [str(tmp_path / f"host{h}.db") for h in "ABC"],
+        window_seconds=3600, now=NOW,
+    )
+    assert res["devices"] >= 1
+    assert res["links"]["hostA/chip0/ici0"] == "unhealthy"   # currently down
+    assert res["links"]["hostB/chip1/ici1"] == "unhealthy"   # heavy flapper
+    assert res["links"]["hostC/chip0/ici1"] == "degraded"    # CRC burst
+    assert res["links"]["hostA/chip1/ici0"] == "healthy"
+    s = res["summary"]
+    assert s["unhealthy"] == 2 and s["degraded"] == 1
+    assert s["healthy"] == 12 - 3
+
+
+def test_fleet_scan_empty_and_missing_window(tmp_path):
+    _mk_host_db(tmp_path / "old.db")
+    # window entirely after the data: nothing to scan
+    res = fleet_scan([str(tmp_path / "old.db")], window_seconds=60,
+                     now=NOW + 10 * 86400)
+    assert res["links"] == {}
+    assert res["summary"] == {"healthy": 0, "degraded": 0, "unhealthy": 0}
+
+
+def test_fleet_scan_agrees_with_per_host_store_scan(tmp_path):
+    """The fleet classes must agree with each host's own ICIStore.scan —
+    the kernels mirror the component's rules."""
+    _mk_host_db(tmp_path / "h.db", down=("chip0/ici0",), crc_hot=("chip1/ici0",))
+    res = fleet_scan([str(tmp_path / "h.db")], window_seconds=3600, now=NOW)
+
+    db = DB(str(tmp_path / "h.db"))
+    store = ICIStore(db)
+    store.time_now_fn = lambda: NOW
+    per_host = store.scan(3600)
+    db.close()
+    assert per_host.links["chip0/ici0"].currently_down
+    assert res["links"]["h/chip0/ici0"] == "unhealthy"
+    assert per_host.links["chip1/ici0"].crc_delta >= 100
+    assert res["links"]["h/chip1/ici0"] == "degraded"
+
+
+def test_numpy_scan_parity_with_jax_kernels():
+    """The numpy fallback must agree with the JAX kernels bit-for-bit on
+    random ragged histories."""
+    import numpy as np
+
+    from gpud_tpu.fleet_scan import _scan_links_numpy
+    from gpud_tpu.ops.window_scan import classify_links, scan_links
+
+    rng = np.random.default_rng(7)
+    L, T = 37, 123
+    states = (rng.random((L, T)) > 0.1).astype(np.int8)
+    counters = np.cumsum(rng.integers(0, 30, (L, T)), axis=1).astype(np.int32)
+    valid = rng.random((L, T)) > 0.2
+    jax_classes = np.asarray(classify_links(scan_links(states, counters, valid)))
+    np_classes = _scan_links_numpy(states, counters, valid)
+    np.testing.assert_array_equal(jax_classes, np_classes)
+
+
+def test_fleet_scan_numpy_fallback_on_jax_failure(tmp_path, monkeypatch):
+    _mk_host_db(tmp_path / "h.db", down=("chip0/ici0",))
+    import gpud_tpu.parallel.fleet as fleet_mod
+    import gpud_tpu.ops.window_scan as ws
+
+    def boom(*a, **k):
+        raise RuntimeError("compiler exploded")
+
+    monkeypatch.setattr(ws, "scan_links", boom)
+    monkeypatch.setattr(fleet_mod, "sharded_link_scan", boom)
+    res = fleet_scan([str(tmp_path / "h.db")], window_seconds=3600, now=NOW)
+    assert res["devices"] == 0  # fell back off the accelerator
+    assert res["links"]["h/chip0/ici0"] == "unhealthy"
+
+
+def test_fleet_scan_honors_tombstones(tmp_path):
+    _mk_host_db(tmp_path / "h.db", flappy=("chip0/ici0",))
+    db = DB(str(tmp_path / "h.db"))
+    store = ICIStore(db)
+    store.set_tombstone("*", ts=NOW + 1)
+    # fresh clean history after the set-healthy
+    store.insert_snapshot(
+        [
+            ICILinkSnapshot(chip_id=c, link_id=l, state=LinkState.UP)
+            for c in range(2) for l in range(2)
+        ],
+        ts=NOW + 10,
+    )
+    db.close()
+    res = fleet_scan([str(tmp_path / "h.db")], window_seconds=3600, now=NOW + 20)
+    assert res["links"]["h/chip0/ici0"] == "healthy"
+    assert res["summary"]["unhealthy"] == 0
+
+
+def test_fleet_scan_same_filename_different_dirs(tmp_path):
+    (tmp_path / "rack1").mkdir()
+    (tmp_path / "rack2").mkdir()
+    _mk_host_db(tmp_path / "rack1" / "host.db")
+    _mk_host_db(tmp_path / "rack2" / "host.db", down=("chip0/ici0",))
+    res = fleet_scan(
+        [str(tmp_path / "rack1" / "host.db"), str(tmp_path / "rack2" / "host.db")],
+        window_seconds=3600, now=NOW,
+    )
+    assert len(res["links"]) == 8  # no silent merge
+    assert res["links"]["host/chip0/ici0"] == "healthy"
+    assert res["links"]["host-2/chip0/ici0"] == "unhealthy"
