@@ -211,3 +211,27 @@ def test_chipless_events_share_one_track():
     ]
     out = evolve_health(evs)
     assert out.active_errors == {"tpu_runtime_fatal": 2}
+
+
+def test_prefilter_complete_over_corpus():
+    """The hot-loop prefilter must never reject a line any catalog pattern
+    would match — checked over every organic and injection line, plus
+    perturbed casings."""
+    for name, lines in ORGANIC.items():
+        for line in lines + [catalog.injection_line(name, chip_id=1)]:
+            for variant in (line, line.upper(), line.lower()):
+                assert catalog._PREFILTER.search(variant) is not None, variant
+                # and full match agrees with the unfiltered walk
+                m = catalog.match(line)
+                assert m is not None and m.entry.name == name
+
+
+def test_prefilter_rejects_typical_benign_lines():
+    for line in [
+        "audit: type=1400 apparmor=ALLOWED operation=open",
+        "systemd[1]: Started Daily apt download activities.",
+        "eth0: link becomes ready",
+        "EXT4-fs (sda1): mounted filesystem with ordered data mode",
+    ]:
+        assert catalog._PREFILTER.search(line) is None, line
+        assert catalog.match(line) is None
