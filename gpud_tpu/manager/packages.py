@@ -19,6 +19,13 @@ Contract per package dir ``<packages>/<name>/``:
                      script answers needDelete; our contract is file-
                      marker-driven like ``version``).
 - ``uninstall.sh`` — optional cleanup hook run before dir removal.
+- ``requires``     — optional dependency list (one package name per
+                     line); install waits until every dependency is
+                     installed (reference: Dependency gating,
+                     package_controller.go installRunner).
+- ``should_skip.sh`` — optional probe; exit 0 marks the package skipped
+                     (already provided by the image/host) without
+                     installing (reference: shouldSkip contract).
 """
 
 from __future__ import annotations
@@ -59,6 +66,11 @@ class PackageManager:
         self._mu = threading.Lock()
         self._progress: Dict[str, int] = {}
         self._installing: Dict[str, bool] = {}
+        self._skipped: set = set()  # should_skip.sh said the host provides it
+        # probe results cached on (target version, probe mtime): a skipped
+        # package would otherwise fork its probe every reconcile forever
+        self._skip_cache: Dict[str, tuple] = {}
+        self._dep_warned: set = set()  # (pkg, dep) pairs already logged
 
     # -- discovery ---------------------------------------------------------
     def package_names(self) -> List[str]:
@@ -83,8 +95,11 @@ class PackageManager:
             with self._mu:
                 installing = self._installing.get(name, False)
                 progress = self._progress.get(name, 0)
+                host_provided = name in self._skipped
             if installing:
                 phase = PackagePhase.INSTALLING
+            elif host_provided:
+                phase = PackagePhase.SKIPPED
             elif current and (not target or current == target):
                 phase = PackagePhase.INSTALLED
             elif not target:
@@ -120,13 +135,87 @@ class PackageManager:
                 d = os.path.join(self.packages_dir, name)
                 if os.path.isdir(d) and os.path.exists(os.path.join(d, "delete")):
                     self._delete(name, d)
-        for name in self.package_names():
+        names = self.package_names()
+        for name in names:
             d = os.path.join(self.packages_dir, name)
             target = _read(os.path.join(d, "version"))
             current = _read(os.path.join(d, "installed_version"))
             if not target or target == current:
                 continue
+            if self._should_skip(name, d):
+                continue
+            if not self._deps_ready(name, d, names):
+                continue
             self._install(name, d, target)
+
+    def _should_skip(self, name: str, pkg_dir: str) -> bool:
+        """Optional should_skip.sh probe: exit 0 ⇒ the host already
+        provides this package; mark skipped, never install (reference:
+        shouldSkip, package_controller.go installRunner). The result is
+        cached on (target version, probe mtime) so a skipped package does
+        not fork its probe on every reconcile pass."""
+        probe = os.path.join(pkg_dir, "should_skip.sh")
+        if not os.path.isfile(probe):
+            with self._mu:
+                self._skipped.discard(name)
+                self._skip_cache.pop(name, None)
+            return False
+        target = _read(os.path.join(pkg_dir, "version"))
+        try:
+            mtime = os.stat(probe).st_mtime_ns
+        except OSError:
+            mtime = 0
+        key = (target, mtime)
+        with self._mu:
+            cached = self._skip_cache.get(name)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        skip = run_command(["bash", probe], timeout=60.0).exit_code == 0
+        with self._mu:
+            self._skip_cache[name] = (key, skip)
+            if skip:
+                self._skipped.add(name)
+            else:
+                self._skipped.discard(name)
+        return skip
+
+    def _dep_satisfied(self, dep: str) -> bool:
+        """Installed, or host-provided per its should_skip probe."""
+        with self._mu:
+            if dep in self._skipped:
+                return True
+        return bool(
+            _read(os.path.join(self.packages_dir, dep, "installed_version"))
+        )
+
+    def _deps_ready(self, name: str, pkg_dir: str, known: List[str]) -> bool:
+        """Optional requires file: every listed package must be installed
+        (or host-provided/skipped) first (reference: Dependency gating).
+        Gating is logged once per (package, dependency) pair; the warning
+        re-arms when the dependency later satisfies, so a regression logs
+        again rather than silently re-gating."""
+        req = _read(os.path.join(pkg_dir, "requires"))
+        if not req:
+            return True
+        for dep in (ln.strip() for ln in req.splitlines()):
+            if not dep or dep.startswith("#"):
+                continue
+            if dep == name:
+                continue  # self-dependency would deadlock
+            if dep in known and self._dep_satisfied(dep):
+                with self._mu:
+                    self._dep_warned.discard((name, dep))
+                continue
+            why = "unknown package" if dep not in known else "not installed yet"
+            with self._mu:
+                first = (name, dep) not in self._dep_warned
+                self._dep_warned.add((name, dep))
+            if first:
+                logger.warning(
+                    "package %s waiting on dependency %s (%s)", name, dep, why
+                )
+            return False
+        return True
 
     def _delete(self, name: str, pkg_dir: str) -> None:
         """Reference: deleteRunner (package_controller.go:274-294) — run
@@ -172,6 +261,13 @@ class PackageManager:
             with self._mu:
                 self._installing.pop(name, None)
                 self._progress.pop(name, None)
+                # a delete-then-repush of the same name must not inherit
+                # stale skip/dep state
+                self._skipped.discard(name)
+                self._skip_cache.pop(name, None)
+                self._dep_warned = {
+                    pair for pair in self._dep_warned if pair[0] != name
+                }
 
     def _install(self, name: str, pkg_dir: str, target: str) -> None:
         with self._mu:

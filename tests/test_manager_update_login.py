@@ -289,3 +289,71 @@ def test_detect_metadata_mount(tmp_path):
     r = detect_metadata_mount(root=str(tmp_path))
     assert r.provider == "nscale"
     assert r.raw["instance_id"] == "proj-1/clu-2/inst-9"
+
+
+def test_package_dependency_gating(tmp_path):
+    """requires-file dependency gating (reference: Dependency in
+    installRunner): a package waits until its dependency is installed."""
+    base = _mk_pkg(tmp_path, "base")
+    app = _mk_pkg(tmp_path, "app")
+    (app / "requires").write_text("base\n")
+    pm = PackageManager(str(tmp_path / "packages"))
+    # sabotage base's first install so app must wait
+    (base / "init.sh").write_text("#!/bin/bash\nexit 1\n")
+    pm.reconcile_once()
+    assert not (base / "installed_version").exists()
+    assert not (app / "installed_version").exists()
+    # base recovers: it installs this pass; app (which sorts earlier and
+    # was visited before base finished) follows on the next pass — the
+    # reference's periodic runner converges the same way
+    (base / "init.sh").write_text("#!/bin/bash\ntrue\n")
+    pm.reconcile_once()
+    assert (base / "installed_version").read_text() == "1.0"
+    pm.reconcile_once()
+    assert (app / "installed_version").read_text() == "1.0"
+
+
+def test_package_unknown_dependency_waits(tmp_path):
+    app = _mk_pkg(tmp_path, "app")
+    (app / "requires").write_text("ghost\n")
+    pm = PackageManager(str(tmp_path / "packages"))
+    pm.reconcile_once()
+    assert not (app / "installed_version").exists()
+
+
+def test_package_should_skip_probe(tmp_path):
+    d = _mk_pkg(tmp_path, "preinstalled")
+    (d / "should_skip.sh").write_text("#!/bin/bash\nexit 0\n")
+    pm = PackageManager(str(tmp_path / "packages"))
+    pm.reconcile_once()
+    assert not (d / "installed_version").exists()
+    st = pm.status(probe=False)[0]
+    assert st.phase == PackagePhase.SKIPPED
+    # probe flips (package removed from the image) → installs normally
+    (d / "should_skip.sh").write_text("#!/bin/bash\nexit 1\n")
+    pm.reconcile_once()
+    assert (d / "installed_version").read_text() == "1.0"
+    assert pm.status(probe=False)[0].phase == PackagePhase.INSTALLED
+
+
+def test_package_dep_satisfied_by_host_provided_skip(tmp_path):
+    """A dependency the host already provides (should_skip.sh exit 0)
+    satisfies dependents without ever installing."""
+    base = _mk_pkg(tmp_path, "base")
+    (base / "should_skip.sh").write_text("#!/bin/bash\nexit 0\n")
+    app = _mk_pkg(tmp_path, "zapp")  # sorts after base
+    (app / "requires").write_text("base\n")
+    pm = PackageManager(str(tmp_path / "packages"))
+    pm.reconcile_once()
+    assert not (base / "installed_version").exists()
+    assert (app / "installed_version").read_text() == "1.0"
+
+
+def test_package_skip_probe_cached_until_inputs_change(tmp_path):
+    d = _mk_pkg(tmp_path, "cachedpkg")
+    runs = tmp_path / "probe_runs"
+    (d / "should_skip.sh").write_text(f"#!/bin/bash\necho x >> {runs}\nexit 0\n")
+    pm = PackageManager(str(tmp_path / "packages"))
+    for _ in range(5):
+        pm.reconcile_once()
+    assert runs.read_text().count("x") == 1  # cached, not per-pass
