@@ -55,7 +55,12 @@ class VersionFileWatcher:
         self.path = path
         self.current_version = current_version
         self.on_update = on_update or self._default_on_update
-        self.interval = interval
+        # env override so lifecycle e2e tests don't wait the 30s cadence
+        env_interval = os.environ.get("TPUD_UPDATE_POLL_SECONDS", "")
+        try:
+            self.interval = float(env_interval) if env_interval else interval
+        except ValueError:
+            self.interval = interval
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

@@ -189,3 +189,55 @@ def test_graceful_shutdown(daemon):
     # SIGTERM → clean exit 0 (signal handler in cmd_run)
     proc.terminate()
     assert proc.wait(timeout=15) == 0
+
+
+def test_self_update_exit_code_lifecycle(tmp_path):
+    """Full self-update lifecycle (reference: version-file watcher →
+    install → exit 244 for the supervisor, server.go:814-832): push a
+    target version, the daemon runs the update hook and exits 244."""
+    kmsg = tmp_path / "kmsg"
+    kmsg.write_text("")
+    hook = tmp_path / "install_hook.sh"
+    trace = tmp_path / "hook_ran"
+    hook.write_text(f"#!/bin/bash\necho $TARGET_VERSION > {trace}\n")
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = {
+        **os.environ,
+        "TPUD_TPU_MOCK_ALL_SUCCESS": "1",
+        "TPUD_KMSG_FILE_PATH": str(kmsg),
+        "TPUD_UPDATE_POLL_SECONDS": "0.3",
+        "TPUD_UPDATE_HOOK": str(hook),
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    data = tmp_path / "data"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gpud_tpu", "run",
+         "--data-dir", str(data), "--port", str(port), "--no-tls",
+         "--disable-components", "network-latency"],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+    )
+    try:
+        from gpud_tpu.client.v1 import Client
+
+        client = Client(base_url=f"http://localhost:{port}", timeout=10)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"daemon died early: {proc.stdout.read().decode()[-800:]}"
+                )
+            try:
+                client.healthz()
+                break
+            except Exception:  # noqa: BLE001
+                time.sleep(0.3)
+        # control plane pushes a new target version
+        (data / "target_version").write_text("99.0.0")
+        rc = proc.wait(timeout=30)
+        assert rc == 244, proc.stdout.read().decode()[-800:]
+        assert trace.read_text().strip() == "99.0.0"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
