@@ -85,7 +85,6 @@ def cmd_fleet_scan(args) -> int:
     res = fleet_scan(
         args.dbs,
         window_seconds=args.window,
-        step_seconds=args.step,
         flap_threshold=args.flap_threshold,
         crc_threshold=args.crc_threshold,
     )
@@ -465,8 +464,6 @@ def build_parser() -> argparse.ArgumentParser:
     pfs.add_argument("dbs", nargs="+", help="per-host tpud state DB files")
     pfs.add_argument("--window", type=float, default=3600.0,
                      help="scan window in seconds")
-    pfs.add_argument("--step", type=float, default=60.0,
-                     help="time-bucket size in seconds")
     pfs.add_argument("--flap-threshold", type=int, default=3)
     pfs.add_argument("--crc-threshold", type=int, default=100)
     pfs.add_argument("--json", action="store_true", dest="as_json",

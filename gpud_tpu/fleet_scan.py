@@ -14,11 +14,14 @@ Each DB is opened read-only; link names are prefixed with the DB's stem
 (disambiguated when two DBs share a filename) and set-healthy tombstones
 are honored exactly like the per-host scan.
 
-Granularity: history is bucketed into ``--step`` time slots (default 60s,
-matching the daemon's poll cadence, so normally one sample per bucket).
-Multiple samples inside one bucket collapse to the last — flaps faster
-than the step are a per-host concern (ICIStore.scan walks every snapshot);
-this tool trades that sub-step detail for fleet scale.
+Histories are *packed*: each link's snapshots sit left-aligned in ts
+order with suffix padding (a prefix validity mask) — every consecutive
+snapshot pair is compared exactly like ICIStore.scan walks them, so the
+fleet classes match the per-host scan snapshot-for-snapshot. Packing is
+also the layout the Pallas kernel wants (ops/pallas_scan.py), which runs
+the whole scan in one VPU pass per tile when a TPU is visible. Per-link
+sample counts are bounded by window/step (and a hard 14-days-of-minutes
+cap), keeping the dense array from OOMing the compiler.
 """
 
 from __future__ import annotations
@@ -46,32 +49,27 @@ MAX_STEPS = 20160
 def load_fleet_history(
     db_paths: List[str],
     window_seconds: float = DEFAULT_WINDOW_SECONDS,
-    step_seconds: float = DEFAULT_STEP_SECONDS,
     now: Optional[float] = None,
+    max_samples: int = MAX_STEPS,
 ):
-    """Read every host DB's snapshots in the window into dense arrays.
+    """Read every host DB's snapshots in the window into packed arrays.
 
-    Returns (names, states, counters, valid) where names[i] labels row i
-    as ``<host>/<link>``; arrays are [L, T] per scan_links' layout.
+    Returns (names, states, counters, valid, truncated) where names[i]
+    labels row i as ``<host>/<link>``; arrays are [L, T] with each link's
+    samples left-aligned in ts order (``valid`` is a prefix mask). A link
+    exceeding ``max_samples`` (the dense-array memory bound, 14 days of
+    minutes by default) keeps its LATEST samples and is reported in
+    ``truncated`` — never silently.
     """
     import numpy as np
 
     t_now = now if now is not None else time.time()
     start = t_now - window_seconds
-    n_steps = max(1, int(window_seconds / step_seconds))
-    if n_steps > MAX_STEPS:
-        step_seconds = window_seconds / MAX_STEPS
-        n_steps = MAX_STEPS
-        logger.info(
-            "fleet-scan window coarsened to %.0fs buckets (%d steps)",
-            step_seconds, n_steps,
-        )
 
     from urllib.parse import quote
 
-    rows: List[Tuple[str, int, int, int]] = []
+    seqs: Dict[str, List[Tuple[int, int]]] = {}  # name → [(state, crc), ...]
     names: List[str] = []
-    index: Dict[str, int] = {}
     used_hosts: Dict[str, int] = {}
     for path in db_paths:
         host = os.path.splitext(os.path.basename(path))[0]
@@ -103,22 +101,45 @@ def load_fleet_history(
                 if ts < max(global_ts, tombstones.get(link, 0.0)):
                     continue
                 name = f"{host}/{link}"
-                if name not in index:
-                    index[name] = len(names)
+                if name not in seqs:
+                    seqs[name] = []
                     names.append(name)
-                step = int((ts - start) / step_seconds)
-                rows.append((name, min(step, n_steps - 1), int(state), int(crc)))
+                seqs[name].append((int(state), int(crc)))
         finally:
             conn.close()
 
     if not names:
-        z = np.zeros((0, n_steps), dtype=np.int8)
-        return [], z, z.astype(np.int32), z.astype(bool)
+        z = np.zeros((0, 1), dtype=np.int8)
+        return [], z, z.astype(np.int32), z.astype(bool), []
 
-    from gpud_tpu.ops.window_scan import scan_numpy_bridge
-
-    states, counters, valid = scan_numpy_bridge(rows, index, len(names), n_steps)
-    return names, states, counters, valid
+    truncated: List[str] = []
+    for name, seq in seqs.items():
+        if len(seq) > max_samples:
+            seqs[name] = seq[-max_samples:]  # keep the latest
+            truncated.append(name)
+    if truncated:
+        logger.warning(
+            "fleet-scan truncated %d link(s) to the latest %d samples "
+            "(history denser than the array bound): %s",
+            len(truncated), max_samples, ", ".join(sorted(truncated)[:5]),
+        )
+    t_max = max(len(seq) for seq in seqs.values())
+    L = len(names)
+    states = np.zeros((L, t_max), dtype=np.int8)
+    counters = np.zeros((L, t_max), dtype=np.int32)
+    valid = np.zeros((L, t_max), dtype=bool)
+    for i, name in enumerate(names):
+        seq = seqs[name]
+        n = len(seq)
+        states[i, :n] = [s for s, _c in seq]
+        # rebase counters on the first sample: deltas are invariant and
+        # small magnitudes keep the float32 Pallas path exact
+        base = seq[0][1] if n else 0
+        counters[i, :n] = np.clip(
+            [c - base for _s, c in seq], -(2**31), 2**31 - 1
+        )
+        valid[i, :n] = True
+    return names, states, counters, valid, truncated
 
 
 def _scan_links_numpy(
@@ -164,7 +185,6 @@ def _scan_links_numpy(
 def fleet_scan(
     db_paths: List[str],
     window_seconds: float = DEFAULT_WINDOW_SECONDS,
-    step_seconds: float = DEFAULT_STEP_SECONDS,
     flap_threshold: int = 3,
     crc_threshold: int = 100,
     now: Optional[float] = None,
@@ -173,26 +193,45 @@ def fleet_scan(
     device mesh when more than one device is visible).
 
     Returns {"links": {name: "healthy|degraded|unhealthy"},
-             "summary": {...}, "devices": n, "window_seconds": S}.
+             "summary": {...}, "devices": n, "window_seconds": S,
+             "truncated_links": [...]}.
     """
     import numpy as np
 
-    names, states, counters, valid = load_fleet_history(
-        db_paths, window_seconds, step_seconds, now=now
+    names, states, counters, valid, truncated = load_fleet_history(
+        db_paths, window_seconds, now=now
     )
     out = {
         "window_seconds": window_seconds,
         "links": {},
         "summary": {"healthy": 0, "degraded": 0, "unhealthy": 0},
         "devices": 0,
+        "truncated_links": truncated,
     }
     if not names:
         return out
 
     import jax
 
-    from gpud_tpu.ops.window_scan import classify_links, scan_links
+    from gpud_tpu.ops.window_scan import WindowScan, classify_links, scan_links
     from gpud_tpu.parallel.fleet import make_mesh, sharded_link_scan
+
+    def classify_packed(scan) -> "np.ndarray":
+        # one rule set: adapt the packed (float32) result to
+        # classify_links' integer/bool shapes
+        drops = np.asarray(scan.drops).astype(np.int32)
+        ws = WindowScan(
+            drops=drops,
+            flaps=np.asarray(scan.flaps).astype(np.int32),
+            currently_down=np.asarray(scan.currently_down) > 0.5,
+            down_time_frac=np.zeros_like(drops, dtype=np.float32),
+            counter_delta=np.asarray(scan.counter_delta).astype(np.int64),
+        )
+        return np.asarray(
+            classify_links(
+                ws, flap_threshold=flap_threshold, crc_threshold=crc_threshold
+            )
+        )
 
     def run_scan():
         n_devices = len(jax.devices())
@@ -212,6 +251,15 @@ def fleet_scan(
                 flap_threshold=flap_threshold, crc_threshold=crc_threshold,
             )
             return np.asarray(cls)[: len(names)]
+        if any("tpu" in d.device_kind.lower() for d in jax.devices()):
+            # packed histories are exactly the Pallas kernel's contract:
+            # one VPU pass per tile instead of the multi-scan jnp graph
+            from gpud_tpu.ops.pallas_scan import scan_links_packed
+
+            try:
+                return classify_packed(scan_links_packed(states, counters, valid))
+            except Exception as e:  # noqa: BLE001 — lowering varies by runtime
+                logger.info("pallas scan unavailable (%s); using jnp", e)
         scan = scan_links(states, counters, valid)
         return np.asarray(
             classify_links(

@@ -4,8 +4,9 @@ The jnp implementation in ``window_scan.py`` handles ragged validity with
 gap-spanning forward fills (several associative scans → multiple fused HBM
 passes). When histories are *packed* — each link's samples left-aligned and
 contiguous, validity only as suffix padding, which is exactly what
-``scan_numpy_bridge``/the SQLite store produce — the transitions are plain
-adjacent compares and the whole scan collapses into one VPU pass per tile.
+``fleet_scan.load_fleet_history`` produces from the SQLite stores — the
+transitions are plain adjacent compares and the whole scan collapses into
+one VPU pass per tile.
 This kernel does that single pass: one [8, T] tile of links per grid step
 resident in VMEM, all reductions lane-wise on the VPU, one [8, 128] result
 tile out (columns 0..4 carry the per-link scalars).

@@ -109,19 +109,3 @@ def classify_links(
     return jnp.where(unhealthy, 2, jnp.where(degraded, 1, 0)).astype(jnp.int32)
 
 
-def scan_numpy_bridge(rows, link_index, n_links: int, n_steps: int):
-    """Pack (link_id, step, state, counter) rows into dense arrays for
-    ``scan_links``; host-side helper for feeding SQLite history to the
-    device. Returns (states, counters, valid) as numpy arrays."""
-    import numpy as np
-
-    states = np.zeros((n_links, n_steps), dtype=np.int8)
-    counters = np.zeros((n_links, n_steps), dtype=np.int32)
-    valid = np.zeros((n_links, n_steps), dtype=bool)
-    for link, step, state, counter in rows:
-        li = link_index[link]
-        if 0 <= step < n_steps:
-            states[li, step] = state
-            counters[li, step] = counter
-            valid[li, step] = True
-    return states, counters, valid

@@ -55,12 +55,20 @@ class VersionFileWatcher:
         self.path = path
         self.current_version = current_version
         self.on_update = on_update or self._default_on_update
-        # env override so lifecycle e2e tests don't wait the 30s cadence
+        # env override so lifecycle e2e tests don't wait the 30s cadence;
+        # clamped (a zero would busy-spin the loop) and logged so it can't
+        # silently shadow an explicit interval in production
+        self.interval = interval
         env_interval = os.environ.get("TPUD_UPDATE_POLL_SECONDS", "")
-        try:
-            self.interval = float(env_interval) if env_interval else interval
-        except ValueError:
-            self.interval = interval
+        if env_interval:
+            try:
+                self.interval = max(0.25, float(env_interval))
+                logger.info(
+                    "update watcher poll interval overridden to %.2fs "
+                    "(TPUD_UPDATE_POLL_SECONDS)", self.interval,
+                )
+            except ValueError:
+                pass
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 

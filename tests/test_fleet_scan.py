@@ -39,14 +39,16 @@ def _mk_host_db(path, down=(), flappy=(), crc_hot=(), n_chips=2, n_links=2):
 def test_load_fleet_history_shapes(tmp_path):
     _mk_host_db(tmp_path / "hostA.db")
     _mk_host_db(tmp_path / "hostB.db")
-    names, states, counters, valid = load_fleet_history(
+    names, states, counters, valid, truncated = load_fleet_history(
         [str(tmp_path / "hostA.db"), str(tmp_path / "hostB.db")],
         window_seconds=3600, now=NOW,
     )
+    assert truncated == []
     assert len(names) == 8  # 2 hosts × 2 chips × 2 links
     assert all(n.startswith(("hostA/", "hostB/")) for n in names)
-    assert states.shape == (8, 60)
-    assert valid.any(axis=1).all()
+    # packed layout: one column per snapshot (30 per link), prefix-valid
+    assert states.shape == (8, 30)
+    assert valid.all(), "fully-sampled links must have a full prefix mask"
 
 
 def test_fleet_scan_classifies_across_hosts(tmp_path):
@@ -157,3 +159,59 @@ def test_fleet_scan_same_filename_different_dirs(tmp_path):
     assert len(res["links"]) == 8  # no silent merge
     assert res["links"]["host/chip0/ici0"] == "healthy"
     assert res["links"]["host-2/chip0/ici0"] == "unhealthy"
+
+
+def test_fleet_scan_keeps_sub_minute_flaps(tmp_path):
+    """Packed histories keep every snapshot: flaps faster than any time
+    bucket still count (exact parity with ICIStore.scan's walk)."""
+    db = DB(str(tmp_path / "h.db"))
+    store = ICIStore(db)
+    # 4 snapshots within one minute: up → down → up → up
+    for i, st in enumerate(
+        (LinkState.UP, LinkState.DOWN, LinkState.UP, LinkState.UP)
+    ):
+        store.insert_snapshot(
+            [ICILinkSnapshot(chip_id=0, link_id=0, state=st)],
+            ts=NOW - 30 + i * 5,
+        )
+    db.close()
+    res = fleet_scan([str(tmp_path / "h.db")], window_seconds=3600, now=NOW)
+    assert res["links"]["h/chip0/ici0"] == "degraded"  # one drop+recover
+
+
+def test_fleet_scan_counter_rebase_preserves_deltas(tmp_path):
+    """Huge absolute counters are rebased per link before the scan so the
+    float32 Pallas path stays exact; deltas are unchanged."""
+    db = DB(str(tmp_path / "h.db"))
+    store = ICIStore(db)
+    big = 2_000_000_000
+    for i, crc in enumerate((big, big + 90, big + 250)):
+        store.insert_snapshot(
+            [ICILinkSnapshot(chip_id=0, link_id=0, state=LinkState.UP,
+                             crc_errors=crc)],
+            ts=NOW - 300 + i * 60,
+        )
+    db.close()
+    res = fleet_scan([str(tmp_path / "h.db")], window_seconds=3600, now=NOW,
+                     crc_threshold=100)
+    assert res["links"]["h/chip0/ici0"] == "degraded"  # delta 250 ≥ 100
+
+
+def test_fleet_scan_truncation_reported_not_silent(tmp_path):
+    """A chatty link over the array bound keeps its latest samples and is
+    reported in truncated_links — never silently classified from a tail."""
+    db = DB(str(tmp_path / "h.db"))
+    store = ICIStore(db)
+    for i in range(50):
+        store.insert_snapshot(
+            [ICILinkSnapshot(chip_id=0, link_id=0, state=LinkState.UP)],
+            ts=NOW - 3000 + i * 10,
+        )
+    db.close()
+    names, states, counters, valid, truncated = load_fleet_history(
+        [str(tmp_path / "h.db")], window_seconds=3600, now=NOW, max_samples=20,
+    )
+    assert truncated == ["h/chip0/ici0"]
+    assert states.shape == (1, 20)
+    res = fleet_scan([str(tmp_path / "h.db")], window_seconds=3600, now=NOW)
+    assert res["truncated_links"] == []  # default bound not hit

@@ -14,7 +14,7 @@ from gpud_tpu.models.anomaly import (  # noqa: E402
     robust_scores,
     windows_to_batch,
 )
-from gpud_tpu.ops.window_scan import classify_links, scan_links, scan_numpy_bridge  # noqa: E402
+from gpud_tpu.ops.window_scan import classify_links, scan_links  # noqa: E402
 
 
 def test_scan_links_matches_reference_semantics():
@@ -70,13 +70,6 @@ def test_scan_links_ragged_validity():
     s = scan_links(jnp.asarray(states), jnp.zeros((1, 4), jnp.int32), jnp.asarray(valid))
     assert s.drops.tolist() == [1]
     assert s.currently_down.tolist() == [True]  # last VALID sample is down
-
-
-def test_scan_numpy_bridge():
-    rows = [("a", 0, 1, 0), ("a", 1, 0, 5), ("b", 0, 1, 2)]
-    states, counters, valid = scan_numpy_bridge(rows, {"a": 0, "b": 1}, 2, 3)
-    assert states[0, 1] == 0 and counters[0, 1] == 5
-    assert valid[1, 0] and not valid[1, 2]
 
 
 def test_robust_scores_flags_drifting_chip():

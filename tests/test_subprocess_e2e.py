@@ -212,12 +212,15 @@ def test_self_update_exit_code_lifecycle(tmp_path):
         "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
     }
     data = tmp_path / "data"
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "gpud_tpu", "run",
-         "--data-dir", str(data), "--port", str(port), "--no-tls",
-         "--disable-components", "network-latency"],
-        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-    )
+    log = tmp_path / "daemon.log"
+    # log to a file, never a PIPE: an undrained pipe can block the child
+    with open(log, "wb") as log_f:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "gpud_tpu", "run",
+             "--data-dir", str(data), "--port", str(port), "--no-tls",
+             "--disable-components", "network-latency"],
+            env=env, stdout=log_f, stderr=subprocess.STDOUT,
+        )
     try:
         from gpud_tpu.client.v1 import Client
 
@@ -225,9 +228,7 @@ def test_self_update_exit_code_lifecycle(tmp_path):
         deadline = time.time() + 30
         while time.time() < deadline:
             if proc.poll() is not None:
-                raise RuntimeError(
-                    f"daemon died early: {proc.stdout.read().decode()[-800:]}"
-                )
+                raise RuntimeError(f"daemon died early: {log.read_text()[-800:]}")
             try:
                 client.healthz()
                 break
@@ -236,7 +237,7 @@ def test_self_update_exit_code_lifecycle(tmp_path):
         # control plane pushes a new target version
         (data / "target_version").write_text("99.0.0")
         rc = proc.wait(timeout=30)
-        assert rc == 244, proc.stdout.read().decode()[-800:]
+        assert rc == 244, log.read_text()[-800:]
         assert trace.read_text().strip() == "99.0.0"
     finally:
         if proc.poll() is None:
