@@ -24,7 +24,8 @@ Wants=network-online.target
 After=network-online.target
 
 [Service]
-Type=simple
+Type=notify
+NotifyAccess=main
 EnvironmentFile=-{env_file}
 ExecStart={python} -m gpud_tpu run $TPUD_FLAGS
 Restart=always

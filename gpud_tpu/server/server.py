@@ -222,6 +222,11 @@ class Server:
             raise RuntimeError("API listener failed to start in time")
         if self._start_error is not None:
             raise RuntimeError(f"API listener failed: {self._start_error}")
+        # Type=notify readiness: systemd holds dependents until the API is
+        # actually listening (reference: pkgsystemd.NotifyReady)
+        from gpud_tpu import sdnotify
+
+        sdnotify.ready()
 
     def _serve(self) -> None:
         loop = asyncio.new_event_loop()
@@ -260,6 +265,9 @@ class Server:
 
     def stop(self) -> None:
         logger.info("stopping tpud server")
+        from gpud_tpu import sdnotify
+
+        sdnotify.stopping()
         with self._session_mu:
             self._closed = True  # bars the fifo watcher from new sessions
         if getattr(self, "_fifo_stop", None) is not None:

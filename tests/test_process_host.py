@@ -60,3 +60,41 @@ def test_reboot_event_store_dedupes(tmp_db):
 
 def test_reboot_dry_run():
     assert host.reboot(dry_run=True) is None
+
+
+# -- sd_notify / systemd unit -------------------------------------------------
+
+def test_sdnotify_sends_ready_datagram(tmp_path, monkeypatch):
+    """sd_notify protocol: READY=1 datagram to $NOTIFY_SOCKET
+    (reference: Type=notify + pkgsystemd.NotifyReady)."""
+    import socket as _socket
+
+    from gpud_tpu import sdnotify
+
+    sock_path = str(tmp_path / "notify.sock")
+    srv = _socket.socket(_socket.AF_UNIX, _socket.SOCK_DGRAM)
+    srv.bind(sock_path)
+    srv.settimeout(2.0)
+    monkeypatch.setenv("NOTIFY_SOCKET", sock_path)
+    assert sdnotify.ready() is True
+    assert srv.recv(64) == b"READY=1"
+    assert sdnotify.stopping() is True
+    assert srv.recv(64) == b"STOPPING=1"
+    srv.close()
+
+
+def test_sdnotify_noop_without_systemd(monkeypatch):
+    from gpud_tpu import sdnotify
+
+    monkeypatch.delenv("NOTIFY_SOCKET", raising=False)
+    assert sdnotify.ready() is False
+
+
+def test_systemd_unit_is_type_notify():
+    from gpud_tpu.manager.systemd import render_unit
+
+    unit = render_unit(python="/usr/bin/python3")
+    assert "Type=notify" in unit
+    assert "NotifyAccess=main" in unit
+    assert "SuccessExitStatus=244 245" in unit
+    assert "Restart=always" in unit
