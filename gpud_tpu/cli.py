@@ -115,10 +115,8 @@ def cmd_run(args) -> int:
 
     from gpud_tpu.server.server import Server
 
-    srv = Server(config=cfg)
-    srv.start()
-    print(f"tpud {__version__} listening on {srv.base_url()}", flush=True)
-
+    # handlers installed BEFORE boot: a SIGTERM during the (multi-second)
+    # start sequence must still run the clean shutdown path
     stop = {"flag": False}
 
     def _sig(_s, _f):
@@ -126,6 +124,10 @@ def cmd_run(args) -> int:
 
     signal.signal(signal.SIGINT, _sig)
     signal.signal(signal.SIGTERM, _sig)
+
+    srv = Server(config=cfg)
+    srv.start()
+    print(f"tpud {__version__} listening on {srv.base_url()}", flush=True)
     try:
         while not stop["flag"]:
             time.sleep(0.5)
