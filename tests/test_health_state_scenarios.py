@@ -117,3 +117,76 @@ def test_simultaneous_timestamps_stable():
     ]
     ev = evolve_health(events)
     assert ev.active_errors.get("tpu_ici_link_down", 0) >= 1
+
+
+def _err_chip(t, name, chip):
+    return Event(time=t, name=name, type=EventType.FATAL,
+                 message=f"accel{chip}: {name}", extra_info={"chip": str(chip)})
+
+
+def test_threshold_override_lowers_escalation():
+    """Control-plane-pushed per-error thresholds win over catalog defaults
+    (reference: XID thresholds via updateConfig)."""
+    evs = [
+        _err(100, "tpu_chip_reset_required"),  # catalog threshold 3
+        _reboot(200),
+        _err(300, "tpu_chip_reset_required"),
+    ]
+    base = evolve_health(evs)
+    assert RepairActionType.REBOOT_SYSTEM in base.suggested_actions.repair_actions
+    tightened = evolve_health(evs, {"tpu_chip_reset_required": 1})
+    assert "recurred after 1 reboot(s)" in tightened.reason
+    assert (
+        RepairActionType.REBOOT_SYSTEM
+        not in tightened.suggested_actions.repair_actions
+    )
+
+
+def test_threshold_override_zero_disables_escalation():
+    evs = [
+        _err(100, "tpu_hbm_ecc_uncorrectable"),  # catalog threshold 1
+        _reboot(200),
+        _err(300, "tpu_hbm_ecc_uncorrectable"),
+        _reboot(400),
+        _err(500, "tpu_hbm_ecc_uncorrectable"),
+    ]
+    assert "recurred" in evolve_health(evs).reason
+    relaxed = evolve_health(evs, {"tpu_hbm_ecc_uncorrectable": 0})
+    assert "recurred" not in relaxed.reason
+
+
+def test_chip_attribution_from_extra_info_beats_message():
+    ev = Event(time=100, name="tpu_chip_lost", type=EventType.FATAL,
+               message="accel7: device lost", extra_info={"chip": "2"})
+    out = evolve_health([ev])
+    assert "tpu_chip_lost(chip 2)" in out.reason  # extra_info wins
+
+
+def test_mixed_chipless_and_chipped_same_error():
+    """A chip-attributed occurrence and an unattributable one are separate
+    tracks; both survive a reboot only if they recur."""
+    evs = [
+        _err_chip(100, "tpu_driver_timeout", 0),
+        _err(110, "tpu_driver_timeout"),      # no chip info
+        _reboot(200),
+        _err_chip(300, "tpu_driver_timeout", 0),  # only chip 0 recurs
+    ]
+    out = evolve_health(evs)
+    assert "tpu_driver_timeout(chip 0)" in out.reason
+    assert out.active_errors == {"tpu_driver_timeout(chip 0)": 2}
+
+
+def test_set_healthy_resets_per_chip_escalation():
+    evs = [
+        _err_chip(100, "tpu_chip_lost", 3),
+        _reboot(200),
+        _err_chip(300, "tpu_chip_lost", 3),
+        _reboot(400),
+        _err_chip(500, "tpu_chip_lost", 3),   # escalated (threshold 2)
+        _sh(600),
+        _err_chip(700, "tpu_chip_lost", 3),   # fresh incident post-clear
+    ]
+    out = evolve_health(evs)
+    assert "recurred" not in out.reason
+    assert out.active_errors == {"tpu_chip_lost(chip 3)": 1}
+    assert RepairActionType.REBOOT_SYSTEM in out.suggested_actions.repair_actions

@@ -199,3 +199,46 @@ def test_auto_falls_back_to_v1_and_remembers():
         s.stop()
     finally:
         cp.stop()
+
+
+def test_v2_unauthenticated_parks_session():
+    """A revoked token over v2 (grpc UNAUTHENTICATED) parks the reconnect
+    loop instead of retrying forever (reference: session_v2.go:359)."""
+    from gpud_tpu.session.session import Session
+
+    class AuthRejectManager(FakeManagerV2):
+        def __init__(self):
+            super().__init__()
+            self.attempts = 0
+
+        def _connect(self, request_iterator, context):
+            self.attempts += 1
+            next(request_iterator)
+            context.abort(grpc.StatusCode.UNAUTHENTICATED, "token revoked")
+            yield  # unreachable; makes this a generator
+
+    m = AuthRejectManager()
+    m.start()
+    s = None
+    try:
+        s = Session(
+            endpoint=f"http://127.0.0.1:{m.port}",
+            machine_id="m-auth",
+            token="revoked",
+            dispatch_fn=lambda r: {},
+            jitter_fn=lambda b: 0.01,
+            protocol="v2",
+        )
+        s.time_sleep_fn = lambda secs: s._stop.wait(min(secs, 0.02))
+        s.start()
+        assert _wait(lambda: s.auth_failed, timeout=8)
+        attempts_at_park = m.attempts
+        time.sleep(0.5)
+        assert m.attempts == attempts_at_park, "retry storm on UNAUTHENTICATED"
+        # token rotation resumes connecting (still rejected → parks again)
+        s.token = "fresh"
+        assert _wait(lambda: m.attempts > attempts_at_park, timeout=8)
+    finally:
+        if s is not None:
+            s.stop()
+        m.stop()
