@@ -114,3 +114,31 @@ def test_numpy_scorer_flags_drifting_chip():
     scores = robust_scores_np(windows)
     assert scores[2] == max(scores)
     assert scores[2] > 3 * max(scores[0], scores[1], scores[3])
+
+
+def test_jax_backend_through_component(tmp_db):
+    """The component's jax path produces the same health decision as the
+    numpy default on identical windows (parity through the product code,
+    not just the scorer functions)."""
+    rows = _telemetry_rows(drift_chip=1)
+    c_np = _component(tmp_db, rows)
+    cr_np = c_np.check()
+
+    from gpud_tpu.sqlite import DB as _DB  # fresh DB: same rows, jax path
+    import tempfile, os
+
+    d = tempfile.mkdtemp()
+    db2 = _DB(os.path.join(d, "s.db"))
+    try:
+        c_jax = _component(db2, rows)
+        c_jax.backend = "jax"
+        cr_jax = c_jax.check()
+        assert cr_jax.health == cr_np.health == HealthStateType.DEGRADED
+        assert cr_jax.extra_info["backend"] == "jax"
+        assert cr_np.extra_info["backend"] == "numpy"
+        # scores agree to float tolerance
+        s_np = float(cr_np.extra_info["chip1_score"])
+        s_jax = float(cr_jax.extra_info["chip1_score"])
+        assert abs(s_np - s_jax) < 0.05
+    finally:
+        db2.close()

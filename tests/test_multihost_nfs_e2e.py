@@ -1,0 +1,61 @@
+"""Multi-node without a cluster (reference test strategy §4.6): two full
+daemons share an 'NFS' directory (a local tmp dir) and observe each other
+through their nfs components — each host sees the peer's freshness file,
+and a dead peer surfaces as missing members on the survivor."""
+
+import time
+
+import pytest
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.config import default_config
+from gpud_tpu.server.server import Server
+
+
+def _mk_server(tmp_path, name, group_dir):
+    kmsg = tmp_path / f"{name}.kmsg"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp_path / name),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        machine_id=name,
+        components_disabled=["network-latency"],
+        nfs_group_dirs=[str(group_dir)],
+    )
+    return Server(config=cfg)
+
+
+def test_two_daemons_see_each_other_via_nfs_group(tmp_path):
+    group = tmp_path / "shared-nfs"
+    a = _mk_server(tmp_path, "host-a", group)
+    b = _mk_server(tmp_path, "host-b", group)
+    a.start()
+    b.start()
+    try:
+        na = a.registry.get("nfs")
+        nb = b.registry.get("nfs")
+        assert na.is_supported() and nb.is_supported()
+        # both write + read the shared dir
+        cra = na.check()
+        crb = nb.check()
+        assert crb.health_state_type() == HealthStateType.HEALTHY
+        assert crb.extra_info[f"{group}:members_fresh"] == "2"
+        assert cra.health_state_type() == HealthStateType.HEALTHY
+
+        # the control plane pins the expected membership; a host checking
+        # alone (peer's file gone stale/removed) goes unhealthy
+        for c in (na, nb):
+            c.group_configs[0].expected_members = 2
+        assert nb.check().health_state_type() == HealthStateType.HEALTHY
+        # host-a "dies": its freshness file disappears
+        for f in group.glob("host-a*"):
+            f.unlink()
+        # host-b alone now misses a member (its own write still succeeds)
+        crb = nb.check()
+        assert crb.health_state_type() == HealthStateType.UNHEALTHY
+        assert "1/2 members fresh" in crb.reason
+    finally:
+        a.stop()
+        b.stop()
