@@ -1,0 +1,104 @@
+"""Checkpoint/resume (SURVEY §5.4): daemon state is the SQLite file —
+events, health evaluation, and the ICI baseline survive a full daemon
+restart; --db-in-memory trades that persistence away deliberately."""
+
+import time
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.config import default_config
+from gpud_tpu.fault_injector import Request as InjectRequest
+from gpud_tpu.server.server import Server
+
+
+def _cfg(tmp_path, **kw):
+    kmsg = tmp_path / "kmsg"
+    kmsg.touch()
+    return default_config(
+        data_dir=str(tmp_path / "data"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        components_disabled=["network-latency"],
+        **kw,
+    )
+
+
+def _wait_unhealthy(srv, name, timeout=10):
+    comp = srv.registry.get(name)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        states = comp.last_health_states()
+        if states and states[0].health == HealthStateType.UNHEALTHY:
+            return states[0]
+        time.sleep(0.1)
+    raise AssertionError(f"{name} never went unhealthy: {states}")
+
+
+def test_events_and_health_survive_daemon_restart(tmp_path):
+    cfg = _cfg(tmp_path)
+    s1 = Server(config=cfg)
+    s1.start()
+    try:
+        err = s1.fault_injector.inject(
+            InjectRequest(tpu_error_name="tpu_hbm_ecc_uncorrectable", chip_id=2)
+        )
+        assert err is None
+        st = _wait_unhealthy(s1, "accelerator-tpu-error-kmsg")
+        assert "tpu_hbm_ecc_uncorrectable" in st.reason
+    finally:
+        s1.stop()
+
+    # fresh process equivalent: new Server over the same state file; the
+    # persisted events must re-evaluate to the same unhealthy state with
+    # per-chip attribution intact
+    s2 = Server(config=_cfg(tmp_path))
+    s2.start()
+    try:
+        st = _wait_unhealthy(s2, "accelerator-tpu-error-kmsg")
+        assert "tpu_hbm_ecc_uncorrectable(chip 2)" in st.reason
+        comp = s2.registry.get("accelerator-tpu-error-kmsg")
+        evs = comp.events(0)
+        assert any(e.name == "tpu_hbm_ecc_uncorrectable" for e in evs)
+        # operator clears; the clear also persists
+        comp.set_healthy()
+    finally:
+        s2.stop()
+
+    s3 = Server(config=_cfg(tmp_path))
+    s3.start()
+    try:
+        comp = s3.registry.get("accelerator-tpu-error-kmsg")
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            states = comp.last_health_states()
+            if states and states[0].health == HealthStateType.HEALTHY:
+                break
+            time.sleep(0.1)
+        assert states[0].health == HealthStateType.HEALTHY
+    finally:
+        s3.stop()
+
+
+def test_db_in_memory_mode_leaves_no_state_file(tmp_path):
+    cfg = _cfg(tmp_path, db_in_memory=True)
+    s = Server(config=cfg)
+    s.start()
+    try:
+        assert s.fault_injector.inject(
+            InjectRequest(tpu_error_name="tpu_power_fault", chip_id=0)
+        ) is None
+        _wait_unhealthy(s, "accelerator-tpu-error-kmsg")
+    finally:
+        s.stop()
+    state = tmp_path / "data" / "tpud.state"
+    assert not state.exists(), "in-memory mode must not write the state DB"
+
+    # a restart starts from a clean slate (the traded-away persistence)
+    s2 = Server(config=_cfg(tmp_path, db_in_memory=True))
+    s2.start()
+    try:
+        comp = s2.registry.get("accelerator-tpu-error-kmsg")
+        time.sleep(1.0)
+        assert comp.events(0) == []
+    finally:
+        s2.stop()
