@@ -55,6 +55,8 @@ class PluginStep:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PluginStep":
+        if not isinstance(d, dict):
+            raise ValueError(f"plugin step must be an object, got {type(d).__name__}")
         return cls(
             name=d.get("name", ""),
             script=d.get("script", ""),
@@ -166,13 +168,18 @@ class PluginSpec:
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "PluginSpec":
+        if not isinstance(d, dict):
+            raise ValueError(f"plugin spec must be an object, got {type(d).__name__}")
+        steps_raw = d.get("steps", []) or []
+        if not isinstance(steps_raw, list):
+            raise ValueError("plugin steps must be a list")
         return cls(
             name=d.get("name", ""),
             plugin_type=d.get("plugin_type", PluginType.COMPONENT),
             run_mode=d.get("run_mode", RunMode.AUTO),
             interval_seconds=float(d.get("interval_seconds", 60.0)),
             timeout_seconds=float(d.get("timeout_seconds", 60.0)),
-            steps=[PluginStep.from_dict(s) for s in d.get("steps", []) or []],
+            steps=[PluginStep.from_dict(s) for s in steps_raw],
             parser=OutputParser.from_dict(d.get("parser")),
             tags=list(d.get("tags", []) or []),
             component_list=list(d.get("component_list", []) or []),

@@ -54,7 +54,11 @@ class Dispatcher:
         self._diagnostic_inflight = threading.Event()
 
     def __call__(self, req: Dict) -> Dict:
+        if not isinstance(req, dict):
+            return {"error": "request must be an object"}
         method = req.get("method", "")
+        if not isinstance(method, str):
+            return {"error": f"invalid method {method!r}"}
         handler = getattr(self, f"_m_{method.replace('-', '_')}", None)
         if handler is None:
             return {"error": f"unknown method {method!r}"}
