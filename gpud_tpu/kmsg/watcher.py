@@ -26,6 +26,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, List, Optional
 
+from gpud_tpu.inotify import InotifyWatch as _InotifyWatch
 from gpud_tpu.log import get_logger
 
 logger = get_logger(__name__)
@@ -316,58 +317,5 @@ class Watcher:
             self.callback(m)
         except Exception:  # noqa: BLE001
             logger.exception("kmsg callback failed")
-
-
-class _InotifyWatch:
-    """Minimal inotify wrapper (ctypes; Linux-only) for event-driven file
-    tails — no busy polling, near-zero append-to-wakeup latency."""
-
-    IN_MODIFY = 0x00000002
-
-    def __init__(self, ifd: int) -> None:
-        self.ifd = ifd
-        self._poller = select.poll()
-        self._poller.register(ifd, select.POLLIN)
-
-    @classmethod
-    def create(cls, path: str) -> Optional["_InotifyWatch"]:
-        try:
-            import ctypes
-
-            libc = ctypes.CDLL(None, use_errno=True)
-            # CLOEXEC so spawned subprocesses don't inherit (and pin) the
-            # inotify instance; on Linux IN_NONBLOCK/IN_CLOEXEC share the
-            # O_* flag values
-            ifd = libc.inotify_init1(os.O_NONBLOCK | os.O_CLOEXEC)
-            if ifd < 0:
-                return None
-            wd = libc.inotify_add_watch(ifd, path.encode(), cls.IN_MODIFY)
-            if wd < 0:
-                os.close(ifd)
-                return None
-            return cls(ifd)
-        except Exception:  # noqa: BLE001 — non-Linux / restricted sandbox
-            return None
-
-    def wait(self, timeout_ms: int) -> bool:
-        """Block until the file is modified (or timeout); drains the event
-        queue. Returns True when an event arrived."""
-        events = self._poller.poll(timeout_ms)
-        if not events:
-            return False
-        try:
-            while True:
-                if not os.read(self.ifd, 4096):
-                    break
-        except OSError as e:
-            if e.errno not in (errno.EAGAIN, errno.EWOULDBLOCK):
-                raise
-        return True
-
-    def close(self) -> None:
-        try:
-            os.close(self.ifd)
-        except OSError:
-            pass
 
 

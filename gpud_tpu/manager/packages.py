@@ -308,11 +308,52 @@ class PackageManager:
         self._thread.start()
 
     def _loop(self) -> None:
-        while not self._stop.wait(RECONCILE_INTERVAL):
+        """File-informer loop (reference: informer/file_informer.go uses
+        fsnotify): inotify on the packages tree reconciles a push within
+        ~0.5s; the RECONCILE_INTERVAL poll remains as the fallback
+        heartbeat (and the only mechanism where inotify is unavailable)."""
+        import time as _time
+
+        from gpud_tpu.inotify import InotifyWatch
+
+        try:
+            os.makedirs(self.packages_dir, exist_ok=True)
+        except OSError:
+            pass
+        informer = InotifyWatch.create(
+            self.packages_dir, mask=InotifyWatch.TREE_MASK
+        )
+        if informer is None:
+            # no inotify (non-Linux/sandbox): plain interval polling, one
+            # blocking wait per cycle (footprint discipline)
+            while not self._stop.wait(RECONCILE_INTERVAL):
+                try:
+                    self.reconcile_once()
+                except Exception:  # noqa: BLE001
+                    logger.exception("package reconcile failed")
+            return
+        watched: set = set()
+        last = 0.0
+        while not self._stop.is_set():
             try:
-                self.reconcile_once()
-            except Exception:  # noqa: BLE001
-                logger.exception("package reconcile failed")
+                # watch each package subdir so version/delete pushes INSIDE
+                # them wake the loop too; prune vanished dirs so a
+                # delete-then-repush of the same name is re-watched
+                watched = {d for d in watched if os.path.isdir(d)}
+                for name in self.package_names():
+                    d = os.path.join(self.packages_dir, name)
+                    if d not in watched and informer.add_path(d):
+                        watched.add(d)
+                woke = informer.wait(500)
+                now = _time.monotonic()
+                if woke or now - last >= RECONCILE_INTERVAL:
+                    self.reconcile_once()
+                    last = now
+            except Exception:  # noqa: BLE001 — the loop must outlive any
+                logger.exception("package informer cycle failed")
+                if self._stop.wait(1.0):
+                    break
+        informer.close()
 
     def close(self) -> None:
         self._stop.set()

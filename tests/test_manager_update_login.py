@@ -357,3 +357,38 @@ def test_package_skip_probe_cached_until_inputs_change(tmp_path):
     for _ in range(5):
         pm.reconcile_once()
     assert runs.read_text().count("x") == 1  # cached, not per-pass
+
+
+def test_package_informer_reacts_within_poll_interval(tmp_path):
+    """File-informer parity (reference: informer/file_informer.go): a
+    pushed package installs well under the fallback poll interval."""
+    import time as _t
+
+    from gpud_tpu.inotify import InotifyWatch
+
+    pm = PackageManager(str(tmp_path / "packages"))
+    pm.start()
+    try:
+        probe = InotifyWatch.create(str(tmp_path))
+        if probe is None:
+            import pytest
+
+            pytest.skip("inotify unavailable")
+        probe.close()
+        _t.sleep(0.2)  # informer up
+        d = _mk_pkg(tmp_path, "pushed")
+        deadline = _t.time() + 5  # << RECONCILE_INTERVAL (15s)
+        while _t.time() < deadline:
+            if (d / "installed_version").exists():
+                break
+            _t.sleep(0.05)
+        assert (d / "installed_version").exists(), "informer never installed"
+        assert (d / "installed_version").read_text() == "1.0"
+        # delete marker also reacts fast
+        (d / "delete").write_text("")
+        deadline = _t.time() + 5
+        while _t.time() < deadline and d.exists():
+            _t.sleep(0.05)
+        assert not d.exists()
+    finally:
+        pm.close()
