@@ -227,9 +227,15 @@ def build_app(srv: "Server") -> web.Application:
     async def inject_fault(req: web.Request) -> web.Response:
         try:
             body = await req.json()
-        except json.JSONDecodeError:
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            # non-UTF8 bytes raise UnicodeDecodeError before JSON parsing
             return _json({"error": "invalid JSON body"}, 400)
-        ir = InjectRequest.from_dict(body)
+        if not isinstance(body, dict):
+            return _json({"error": "body must be a JSON object"}, 400)
+        try:
+            ir = InjectRequest.from_dict(body)
+        except (TypeError, ValueError) as e:
+            return _json({"error": f"invalid inject request: {e}"}, 400)
         err = await _run_blocking(srv, lambda: srv.fault_injector.inject(ir))
         if err:
             return _json({"error": err}, 400)

@@ -131,3 +131,60 @@ def test_plugin_spec_from_dict_garbage():
             specs_from_list([d])
         except (ValueError, KeyError, TypeError):
             pass  # a clean validation error is the contract
+
+
+def test_http_api_malformed_inputs(tmp_path):
+    """Every API route degrades to 4xx/handled responses on hostile query
+    strings and bodies — no 500s from input parsing."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.app import build_app
+    from gpud_tpu.server.server import Server
+
+    kmsg = tmp_path / "k"
+    kmsg.touch()
+    srv = Server(config=default_config(
+        data_dir=str(tmp_path / "d"), port=0, tls=False, kmsg_path=str(kmsg),
+        components_disabled=["network-latency"],
+    ))
+    srv.start()
+
+    async def drive():
+        client = TestClient(TestServer(build_app(srv)))
+        await client.start_server()
+        try:
+            hostile_gets = [
+                "/v1/events?startTime=banana",
+                "/v1/events?startTime=nan&endTime=%00",
+                "/v1/metrics?since=[]",
+                "/v1/info?startTime={}",
+                "/v1/states?components=%00%ff,,,",
+                "/v1/components/trigger-check",
+                "/v1/components/trigger-check?componentName=../../etc",
+                "/v1/events?" + "x" * 4096 + "=1",
+            ]
+            for path in hostile_gets:
+                resp = await client.get(path)
+                assert resp.status < 500, (path, resp.status)
+            hostile_posts = [
+                ("/inject-fault", b"\x00\xff garbage"),
+                ("/inject-fault", b'{"tpu_error_name": 42}'),
+                ("/inject-fault", b'{"unknown": true}'),
+                ("/v1/components/set-healthy?componentName=ghost", b""),
+                ("/v1/components/set-healthy", b""),
+            ]
+            for path, body in hostile_posts:
+                resp = await client.post(path, data=body)
+                assert resp.status < 500, (path, resp.status, await resp.text())
+            resp = await client.delete("/v1/components?componentName=nope")
+            assert resp.status < 500
+        finally:
+            await client.close()
+
+    try:
+        asyncio.run(drive())
+    finally:
+        srv.stop()
