@@ -340,11 +340,16 @@ class PackageManager:
                 # them wake the loop too; prune vanished dirs so a
                 # delete-then-repush of the same name is re-watched
                 watched = {d for d in watched if os.path.isdir(d)}
+                new_watch = False
                 for name in self.package_names():
                     d = os.path.join(self.packages_dir, name)
                     if d not in watched and informer.add_path(d):
                         watched.add(d)
-                woke = informer.wait(500)
+                        new_watch = True
+                # a just-watched dir may have received writes BEFORE its
+                # watch existed (push races dir creation) — reconcile now
+                # rather than waiting for an event that already happened
+                woke = True if new_watch else informer.wait(500)
                 now = _time.monotonic()
                 if woke or now - last >= RECONCILE_INTERVAL:
                     self.reconcile_once()
