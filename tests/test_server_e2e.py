@@ -146,3 +146,29 @@ def test_inject_fault_bad_name(client):
     with pytest.raises(ClientError) as ei:
         client.inject_fault(tpu_error_name="bogus")
     assert ei.value.status == 400
+
+
+def test_tls_server_e2e(tmp_path):
+    """The default deployment serves HTTPS with a boot-generated
+    self-signed ECDSA cert (reference: server.go:507-547); drive it over
+    real TLS with the client SDK."""
+    from gpud_tpu.client.v1 import Client
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    kmsg = tmp_path / "k"
+    kmsg.touch()
+    srv = Server(config=default_config(
+        data_dir=str(tmp_path / "d"), port=0, tls=True, kmsg_path=str(kmsg),
+        components_disabled=["network-latency"],
+    ))
+    srv.start()
+    try:
+        url = srv.base_url()
+        assert url.startswith("https://")
+        client = Client(base_url=url, timeout=10)
+        assert client.healthz()["status"] == "ok"
+        states = client.get_health_states(components=["cpu"])
+        assert states[0].states[0].component == "cpu"
+    finally:
+        srv.stop()
