@@ -216,3 +216,28 @@ def test_follow_file_detection_latency_under_poll_floor(tmp_path):
         assert got[0][0].message == "hello inotify 0"
     finally:
         w.close()
+
+
+def test_syncer_restart_dedupe_via_store(tmp_db):
+    """Restart safety (reference: Find-before-Insert,
+    xid/component.go:545-570): after a daemon restart the deduper cache is
+    empty, but re-reading the same ring-buffer line must not duplicate the
+    stored event."""
+    from gpud_tpu.kmsg.watcher import Message
+
+    es = EventStore(tmp_db)
+    bucket = es.bucket("tpu-errors")
+
+    def match(line):
+        return ("tpu-err", EventType.CRITICAL, line) if "TPU" in line else None
+
+    s1 = Syncer(match, bucket)
+    s1.process(Message(message="TPU fault on chip 1", time=42.0))
+    assert len(bucket.get(0)) == 1
+
+    s2 = Syncer(match, bucket)  # fresh process: empty dedupe cache
+    s2.process(Message(message="TPU fault on chip 1", time=42.0))
+    assert len(bucket.get(0)) == 1, "store-level find must dedupe re-reads"
+    # a genuinely new occurrence (different ring timestamp) still records
+    s2.process(Message(message="TPU fault on chip 1", time=99.0))
+    assert len(bucket.get(0)) == 2
