@@ -236,3 +236,18 @@ def test_kapmtls_repush_inactive_version_no_retarget(tmp_path, monkeypatch):
     assert mgr.install("v1", cert, key) is None  # re-push inactive v1
     assert calls == []
     assert mgr.status().current_version == "v2"
+
+
+def test_kapmtls_rollback_natural_version_order(tmp_path):
+    """v10 must sort above v9 (natural ordering, not lexicographic), and
+    rollback never 'rolls back' to a newer-but-inactive release."""
+    mgr = CertManager(root=str(tmp_path))
+    cert, key = _self_signed_pem()
+    for v in ("v9", "v10", "v11"):
+        assert mgr.install(v, cert, key) is None
+    assert mgr.activate("v11") is None
+    assert mgr.rollback() is None
+    assert mgr.status().current_version == "v10"  # not v9 (lexicographic bug)
+    assert mgr.rollback() is None
+    assert mgr.status().current_version == "v9"
+    assert "roll back" in (mgr.rollback() or "")  # nothing older

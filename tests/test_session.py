@@ -218,3 +218,26 @@ def test_is_auth_error_rejects_lookalikes():
     assert not is_auth_error(HTTPError())
     # anchored matches still hit
     assert is_auth_error("401 Client Error: Unauthorized for url")
+
+
+def test_reconnect_drains_stale_reader_queue():
+    """Reference: drainReaderChannel on reconnect — requests queued for a
+    dead stream must not replay into the new connection."""
+    tr = LoopbackTransport()
+    s = _mk_session(tr)
+    s.time_sleep_fn = lambda secs: s._stop.wait(min(secs, 0.02))
+    s.start()
+    assert _wait(lambda: s.connected)
+    # simulate requests stuck in the reader when the stream dies
+    s.reader.put(Frame(req_id="stale-1", data={"method": "x"}))
+    s.reader.put(Frame(req_id="stale-2", data={"method": "x"}))
+    # block the serve loop from consuming them first: kill via reconnect
+    s.signal_reconnect("stream died")
+    assert _wait(lambda: s.reconnect_count >= 1)
+    assert _wait(lambda: s.connected)
+    # fresh connection: push a real request and expect exactly its response
+    tr.push(Frame(req_id="fresh", data={"n": 1}))
+    assert _wait(lambda: any(f.req_id == "fresh" for f in tr.responses))
+    # the queue itself was drained at reconnect
+    assert s.reader.empty()
+    s.stop()
