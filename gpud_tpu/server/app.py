@@ -327,6 +327,37 @@ def build_app(srv: "Server") -> web.Application:
         r.add_get("/admin/pprof/heap", pprof_heap)
         r.add_get("/admin/pprof/threads", pprof_threads)
 
+    async def openapi(_req: web.Request) -> web.Response:
+        """Machine-readable API description (reference: the swagger route,
+        server.go:420) — generated from the live route table so it can
+        never drift from what is actually served."""
+        paths: dict = {}
+        for route in app.router.routes():
+            info = route.resource.get_info() if route.resource else {}
+            path = info.get("path") or info.get("formatter") or ""
+            if not path or path == "/openapi.json":
+                continue
+            method = route.method.lower()
+            if method == "head":
+                continue
+            doc = (route.handler.__doc__ or "").strip().split("\n")[0]
+            paths.setdefault(path, {})[method] = {
+                "summary": doc or route.handler.__name__,
+                "responses": {"200": {"description": "OK"}},
+            }
+        return _json(
+            {
+                "openapi": "3.0.3",
+                "info": {
+                    "title": "tpud local API",
+                    "version": srv.version,
+                    "description": "TPU fleet-health daemon node API",
+                },
+                "paths": dict(sorted(paths.items())),
+            }
+        )
+
+    r.add_get("/openapi.json", openapi)
     r.add_get("/healthz", healthz)
     r.add_get("/v1/components", list_components)
     r.add_delete("/v1/components", deregister_component)

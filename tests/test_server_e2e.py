@@ -172,3 +172,22 @@ def test_tls_server_e2e(tmp_path):
         assert states[0].states[0].component == "cpu"
     finally:
         srv.stop()
+
+
+def test_openapi_document(srv, client):
+    """The generated OpenAPI doc lists every served route (reference: the
+    swagger route) and cannot drift from the live router."""
+    import requests as _rq
+
+    s = _rq.Session()
+    s.trust_env = False
+    resp = s.get(f"{srv.base_url()}/openapi.json", timeout=10, verify=False)
+    assert resp.status_code == 200
+    doc = resp.json()
+    assert doc["openapi"].startswith("3.")
+    for path in ("/healthz", "/v1/states", "/v1/events", "/v1/metrics",
+                 "/metrics", "/machine-info", "/inject-fault", "/v1/plugins"):
+        assert path in doc["paths"], path
+    assert "post" in doc["paths"]["/inject-fault"]
+    assert "delete" in doc["paths"]["/v1/components"]
+    assert "/openapi.json" not in doc["paths"]
