@@ -251,3 +251,63 @@ def test_kapmtls_rollback_natural_version_order(tmp_path):
     assert mgr.rollback() is None
     assert mgr.status().current_version == "v9"
     assert "roll back" in (mgr.rollback() or "")  # nothing older
+
+
+# -- audit trail --------------------------------------------------------------
+
+def test_audit_trail_records_privileged_actions(tmp_path, tmp_db):
+    """Privileged actions append JSONL audit records (reference: pkg/log
+    audit logger): session methods, fault injection, kapmtls installs."""
+    import json
+
+    from gpud_tpu.config import default_config
+    from gpud_tpu.log import AuditLogger, set_audit_logger
+    from gpud_tpu.server.server import Server
+    from gpud_tpu.session.dispatch import Dispatcher
+
+    audit_file = tmp_path / "audit.jsonl"
+    set_audit_logger(AuditLogger(str(audit_file)))
+    try:
+        kmsg = tmp_path / "k"
+        kmsg.touch()
+        srv = Server(config=default_config(
+            data_dir=str(tmp_path / "d"), port=0, tls=False,
+            kmsg_path=str(kmsg), components_disabled=["network-latency"],
+        ))
+        srv.start()
+        try:
+            dispatch = Dispatcher(srv)
+            dispatch({"method": "injectFault",
+                      "tpu_error_name": "tpu_thermal_trip", "chip_id": 0})
+            dispatch({"method": "delete"})
+            import base64
+
+            dispatch({"method": "bootstrap",
+                      "script_base64": base64.b64encode(b"true").decode()})
+        finally:
+            srv.stop()
+        records = [json.loads(ln) for ln in audit_file.read_text().splitlines()]
+        actions = [r["action"] for r in records]
+        # every dispatched method is audited, plus the specific actions
+        assert actions.count("session_request") >= 3
+        assert "session_delete" in actions
+        assert "bootstrap_script" in actions
+        for r in records:
+            assert "ts" in r and r["ts"] > 0
+    finally:
+        set_audit_logger(AuditLogger(""))  # back to nop
+
+
+def test_audit_unwritable_path_never_crashes(tmp_path):
+    from gpud_tpu.log import AuditLogger
+
+    a = AuditLogger(str(tmp_path / "nope" / "deep" / "audit.jsonl"))
+    # make the parent unwritable-ish by pointing at a file-as-dir
+    (tmp_path / "blocker").write_text("")
+    b = AuditLogger.__new__(AuditLogger)
+    b.path = str(tmp_path / "blocker" / "audit.jsonl")
+    import threading
+
+    b._mu = threading.Lock()
+    b.log("x", k="v")  # must not raise
+    a.log("y")  # and a creatable path works
