@@ -61,6 +61,8 @@ def _build_config(args) -> "cfgmod.Config":
 
 def cmd_scan(args) -> int:
     """Reference: cmd/gpud scan → pkg/scan/scan.go:33."""
+    import io
+    import json as _json
     import os
 
     from gpud_tpu.scan import scan
@@ -68,7 +70,22 @@ def cmd_scan(args) -> int:
     if args.kmsg_path:
         # scan-mode components resolve the kmsg path via the env override
         os.environ["TPUD_KMSG_FILE_PATH"] = args.kmsg_path
-    results = scan(accelerator_type=args.accelerator_type)
+    as_json = getattr(args, "as_json", False)
+    sink = io.StringIO() if as_json else sys.stdout
+    results = scan(accelerator_type=args.accelerator_type, out=sink)
+    if as_json:
+        print(_json.dumps(
+            [
+                {
+                    "component": r.component_name(),
+                    "health": r.health_state_type(),
+                    "reason": r.summary(),
+                    "extra_info": dict(r.extra_info),
+                }
+                for r in results
+            ],
+            indent=2,
+        ))
     unhealthy = [
         r for r in results if r.health_state_type() != HealthStateType.HEALTHY
     ]
@@ -457,6 +474,8 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common_flags(ps)
     ps.add_argument("--accelerator-type", default="")
     ps.add_argument("--strict", action="store_true", help="exit 1 on any unhealthy check")
+    ps.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable results instead of the table")
     ps.set_defaults(fn=cmd_scan)
 
     pfs = sub.add_parser(

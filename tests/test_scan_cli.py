@@ -66,3 +66,15 @@ def test_parser_has_all_subcommands():
     names = set(subs.choices)
     assert {"scan", "run", "inject-fault", "status", "compact", "set-healthy",
             "metadata", "machine-info"} <= names
+
+
+def test_cli_scan_json(capsys):
+    import json
+
+    assert main(["scan", "--json"]) == 0
+    out = capsys.readouterr().out
+    results = json.loads(out)
+    comps = {r["component"]: r for r in results}
+    assert "cpu" in comps and "accelerator-tpu-temperature" in comps
+    assert comps["cpu"]["health"] in ("Healthy", "Degraded", "Unhealthy")
+    assert "reason" in comps["cpu"]
