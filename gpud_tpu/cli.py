@@ -188,13 +188,29 @@ def cmd_status(args) -> int:
     except Exception as e:  # noqa: BLE001
         print(f"tpud unreachable on port {args.port}: {e}", file=sys.stderr)
         return 1
+    bad = sum(
+        1
+        for comp in states
+        for st in comp.states
+        if st.health != HealthStateType.HEALTHY
+    )
+    if getattr(args, "as_json", False):
+        import json as _json
+
+        print(_json.dumps({
+            "version": hz.get("version", ""),
+            "unhealthy": bad,
+            "components": [
+                {"component": comp.component, "health": st.health,
+                 "reason": st.reason}
+                for comp in states for st in comp.states
+            ],
+        }, indent=2))
+        return 1 if bad else 0
     print(f"tpud {hz.get('version', '?')} healthy")
-    bad = 0
     for comp in states:
         for st in comp.states:
             glyph = "✔" if st.health == HealthStateType.HEALTHY else "✘"
-            if st.health != HealthStateType.HEALTHY:
-                bad += 1
             print(f"  {glyph} {comp.component}: {st.health} {st.reason}")
     return 1 if bad else 0
 
@@ -518,6 +534,8 @@ def build_parser() -> argparse.ArgumentParser:
     pst = sub.add_parser("status", help="query the running daemon")
     pst.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
     pst.add_argument("--no-tls", action="store_true", help="daemon runs with --no-tls")
+    pst.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable status")
     pst.set_defaults(fn=cmd_status)
 
     pc = sub.add_parser("compact", help="VACUUM the state DB (daemon stopped)")

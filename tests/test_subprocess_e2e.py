@@ -122,6 +122,13 @@ def test_cli_status_against_running_daemon(daemon):
     assert r.returncode in (0, 1), r.stderr.decode()
     out = r.stdout.decode()
     assert "cpu" in out and "accelerator-tpu" in out
+    # machine-readable variant agrees on the unhealthy count
+    import json
+
+    r2 = _cli(["status", "--port", port, "--no-tls", "--json"])
+    doc = json.loads(r2.stdout.decode())
+    assert (r2.returncode == 1) == (doc["unhealthy"] > 0)
+    assert any(c["component"] == "cpu" for c in doc["components"])
 
 
 def test_cli_set_healthy_against_running_daemon(daemon):
