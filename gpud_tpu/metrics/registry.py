@@ -124,7 +124,9 @@ class Registry:
         lines: List[str] = []
         for m in self.all_metrics():
             if m.help_text:
-                lines.append(f"# HELP {m.name} {m.help_text}")
+                # exposition format: HELP text escapes backslash + newline
+                escaped = m.help_text.replace("\\", "\\\\").replace("\n", "\\n")
+                lines.append(f"# HELP {m.name} {escaped}")
             lines.append(f"# TYPE {m.name} {m.TYPE}")
             for key, value in sorted(m.labels_values()):
                 lines.append(f"{m.name}{_render_labels(key)} {_format_value(value)}")

@@ -76,3 +76,20 @@ def test_config_unknown_override_rejected():
     except AttributeError:
         raised = True
     assert raised
+
+
+def test_prometheus_exposition_escaping():
+    """Label values and HELP text with quotes/backslashes/newlines must
+    escape per the exposition format — one bad value must not corrupt the
+    whole /metrics page."""
+    from gpud_tpu.metrics.registry import Registry
+
+    r = Registry()
+    g = r.gauge("esc_metric", "help with\nnewline and \\slash")
+    g.set(1.0, {"link": 'weird"name\\with\n stuff'})
+    out = r.render_prometheus()
+    assert '# HELP esc_metric help with\\nnewline and \\\\slash' in out
+    assert 'link="weird\\"name\\\\with\\n stuff"' in out
+    # every physical line is a comment or a sample — no stray fragments
+    for ln in out.strip().splitlines():
+        assert ln.startswith("#") or " " in ln, ln
