@@ -1,0 +1,64 @@
+"""Generate docs/CATALOG.md from the live error catalog.
+
+The reference ships its XID catalog as generated code
+(catalog_generated.go); here the catalog is source and the operator doc
+is generated — a test asserts the committed doc matches a fresh render so
+the two can never drift.
+
+Run: ``python -m gpud_tpu.tools.gen_catalog_doc [--check]``
+"""
+
+from __future__ import annotations
+
+import sys
+
+from gpud_tpu.components.tpu.catalog import CATALOG
+
+HEADER = """# TPU error catalog
+
+Generated from `gpud_tpu/components/tpu/catalog.py` — do not edit by
+hand (`python -m gpud_tpu.tools.gen_catalog_doc` regenerates; a test
+keeps this file in sync). Matching is first-hit-wins over kmsg lines;
+`tpud inject-fault --name <name>` writes each entry's canonical
+injection line.
+
+| Code | Name | Severity | Critical | Reboot threshold | Suggested actions | Description |
+|---|---|---|---|---|---|---|
+"""
+
+
+def render() -> str:
+    rows = []
+    for e in sorted(CATALOG, key=lambda e: e.code):
+        actions = ", ".join(e.repair_actions) or "—"
+        thr = str(e.reboot_threshold) if e.reboot_threshold else "never escalates"
+        rows.append(
+            f"| {e.code} | `{e.name}` | {e.event_type} | "
+            f"{'yes' if e.critical else 'no'} | {thr} | {actions} | "
+            f"{e.description} |"
+        )
+    return HEADER + "\n".join(rows) + "\n"
+
+
+def main() -> int:
+    out = render()
+    path = "docs/CATALOG.md"
+    if "--check" in sys.argv:
+        try:
+            current = open(path, "r", encoding="utf-8").read()
+        except OSError:
+            current = ""
+        if current != out:
+            print(f"{path} is out of date; regenerate with "
+                  f"python -m gpud_tpu.tools.gen_catalog_doc", file=sys.stderr)
+            return 1
+        print(f"{path} in sync ({len(CATALOG)} entries)")
+        return 0
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(out)
+    print(f"wrote {path} ({len(CATALOG)} entries)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

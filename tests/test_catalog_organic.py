@@ -235,3 +235,17 @@ def test_prefilter_rejects_typical_benign_lines():
     ]:
         assert catalog._PREFILTER.search(line) is None, line
         assert catalog.match(line) is None
+
+
+def test_catalog_doc_in_sync():
+    """docs/CATALOG.md is generated from the catalog; regen must match
+    the committed file (reference ships its catalog as generated code)."""
+    import os
+
+    from gpud_tpu.tools.gen_catalog_doc import render
+
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "CATALOG.md")
+    committed = open(path, "r", encoding="utf-8").read()
+    assert committed == render(), (
+        "docs/CATALOG.md stale — run python -m gpud_tpu.tools.gen_catalog_doc"
+    )
