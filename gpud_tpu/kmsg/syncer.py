@@ -16,7 +16,7 @@ from typing import Callable, List, Optional
 
 from gpud_tpu.api.v1.types import Event
 from gpud_tpu.eventstore import Bucket
-from gpud_tpu.kmsg.deduper import Deduper
+from gpud_tpu.kmsg.deduper import default_deduper
 from gpud_tpu.kmsg.watcher import Message, Watcher
 from gpud_tpu.log import get_logger
 
@@ -34,12 +34,12 @@ class Syncer:
         self,
         match_fn: MatchFunc,
         bucket: Bucket,
-        deduper: Optional[Deduper] = None,
+        deduper=None,  # any object with the seen_before contract
         on_event: Optional[Callable[[Event], None]] = None,
     ) -> None:
         self.match_fn = match_fn
         self.bucket = bucket
-        self.deduper = deduper or Deduper()
+        self.deduper = deduper or default_deduper()
         self.on_event = on_event
 
     def process(self, msg: Message) -> Optional[Event]:

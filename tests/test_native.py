@@ -179,3 +179,23 @@ def test_store_scan_native_vs_python_paths(tmp_db):
         assert (a.drops, a.flaps, a.currently_down) == (b.drops, b.flaps, b.currently_down), name
         assert (a.crc_delta, a.error_delta, a.samples) == (b.crc_delta, b.error_delta, b.samples), name
         assert (a.first_seen, a.last_seen, a.last_state) == (b.first_seen, b.last_seen, b.last_state), name
+
+
+def test_default_deduper_prefers_native(tmp_db):
+    """The product path (Syncer) uses the native TTL cache when loaded."""
+    from gpud_tpu.kmsg.deduper import Deduper, NativeBackedDeduper, default_deduper
+    from gpud_tpu.kmsg.syncer import Syncer
+    from gpud_tpu.eventstore import EventStore
+
+    d = default_deduper()
+    if native.available():
+        assert isinstance(d, NativeBackedDeduper)
+    else:
+        assert isinstance(d, Deduper)
+    # contract smoke: mark-and-test with second bucketing
+    assert d.seen_before("m", 5.0) is False
+    assert d.seen_before("m", 5.0) is True
+    assert d.seen_before("m", 6.0) is False
+    # and the Syncer default picks it up
+    s = Syncer(lambda ln: None, EventStore(tmp_db).bucket("x"))
+    assert type(s.deduper) is type(d)
