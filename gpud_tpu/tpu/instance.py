@@ -50,6 +50,11 @@ ENV_USE_JAX = "TPUD_TPU_USE_JAX"
 ENV_INJECT_HBM_ECC_PENDING = "TPUD_TPU_INJECT_HBM_ECC_PENDING"
 ENV_INJECT_THERMAL_SLOWDOWN = "TPUD_TPU_INJECT_THERMAL_SLOWDOWN"
 ENV_INJECT_ICI_LINK_DOWN = "TPUD_TPU_INJECT_ICI_LINK_DOWN"
+# root overrides for the real-surface readers (tpu/sysfs.py) so fixture
+# trees of stock TPU VMs drive the whole daemon (reference pattern:
+# --infiniband-class-root-dir flag + KMSG_FILE_PATH env)
+ENV_SYSFS_ROOT = "TPUD_SYSFS_ROOT"
+ENV_DEV_ROOT = "TPUD_DEV_ROOT"
 # root of the ICI link sysfs layout (see SysfsBackend.ici_links): per-link
 # dirs <root>/chip<N>/ici<L>/{state,tx_bytes,rx_bytes,tx_errors,rx_errors,
 # crc_errors,replays}. Driver exposure varies by runtime version (SURVEY §7
@@ -117,6 +122,10 @@ class TPUChip:
     hbm_total_bytes: int = 0
     lost: bool = False
     requires_reset: bool = False
+    # real-surface attributes (populated by the PCI scan; see tpu/sysfs.py)
+    numa_node: int = -1
+    driver: str = ""
+    iommu_group: str = ""
 
 
 class TPUInstance:
@@ -316,22 +325,57 @@ def _int_set(spec: str) -> set:
 # ---------------------------------------------------------------------------
 
 class SysfsICILinksMixin:
-    """ICI link reads from the deployment-mapped sysfs layout
-    (``TPUD_ICI_SYSFS_ROOT``). Shared by every side-band backend: ICI
-    exposure is a driver/sysfs property, independent of how chips were
-    enumerated (device nodes or the tpu-info CLI)."""
+    """ICI link reads for side-band backends, two sources in order:
+
+    1. ``TPUD_ICI_SYSFS_ROOT`` — a deployment-mapped per-link layout
+       (override for runtimes/node agents that do expose per-port nodes).
+    2. Derived topology inventory (the stock-TPU-VM default): no current
+       runtime exposes per-port ICI state in sysfs (SURVEY §7), so the
+       link inventory comes from the slice topology and coarse liveness
+       from chip presence/driver binding — a chip that vanished from PCI
+       or lost its binding reports its links down. Fine-grained link
+       faults arrive via the driver kmsg catalog; counters stay zero.
+
+    Shared by every side-band backend: ICI exposure is a driver/sysfs
+    property, independent of how chips were enumerated."""
 
     def _ici_root(self) -> str:
         return os.environ.get(ENV_ICI_SYSFS_ROOT, "")
 
-    def ici_supported(self) -> bool:
+    def _derived_ici_links(self) -> List[ICILinkSnapshot]:
+        """Topology-derived inventory; backends with real-surface
+        knowledge override ``_unbound_chip_ids`` for liveness."""
+        topo = self.topology()
+        if topo is None:
+            return []
+        unbound = self._unbound_chip_ids()
+        out: List[ICILinkSnapshot] = []
+        for cid in sorted(self.devices()):
+            state = LinkState.DOWN if cid in unbound else LinkState.UP
+            for lid in range(topo.ici_links_per_chip):
+                out.append(ICILinkSnapshot(chip_id=cid, link_id=lid, state=state))
+        return out
+
+    def _unbound_chip_ids(self) -> set:
+        return set()
+
+    def ici_source(self) -> str:
         root = self._ici_root()
-        return bool(root) and os.path.isdir(root)
+        if root and os.path.isdir(root):
+            return "mapped-sysfs"
+        # cheap availability probe — runs on the polling hot path, so it
+        # must not materialize the whole derived snapshot list
+        if self.topology() is not None and self.devices():
+            return "derived-topology"
+        return ""
+
+    def ici_supported(self) -> bool:
+        return bool(self.ici_source())
 
     def ici_links(self) -> List[ICILinkSnapshot]:
         root = self._ici_root()
         if not root or not os.path.isdir(root):
-            return []
+            return self._derived_ici_links()
         out: List[ICILinkSnapshot] = []
         for chip_dir in sorted(glob.glob(os.path.join(root, "chip[0-9]*"))):
             cm = re.search(r"chip(\d+)$", chip_dir)
@@ -403,41 +447,127 @@ class SysfsICILinksMixin:
 
 class SysfsBackend(SysfsICILinksMixin, TPUInstance):
     """Enumerates the Google TPU driver's device nodes without opening
-    libtpu (side-band monitoring only). Roots are parameterized so sysfs
-    fixture trees drive tests (SURVEY §4.4 fixture-directory pattern)."""
+    libtpu (side-band monitoring only).
+
+    Primary path: the real TPU-VM PCI surface (tpu/sysfs.py — vendor
+    0x1ae0 functions with per-generation device ids, accel-class indices,
+    vfio/iommu bindings), the same way the public tpu-info tool detects
+    chips. Fallback: bare /dev/accel* / /dev/vfio/* globs for minimal
+    environments. Roots are parameterized so checked-in fixture trees of
+    real TPU VMs drive tests (SURVEY §4.4; reference pattern:
+    infiniband/class/testdata/sys-class-infiniband-h100.0)."""
 
     def __init__(
         self,
         dev_root: str = "/dev",
-        sys_accel_root: str = "/sys/class/accel",
-        pci_root: str = "/sys/bus/pci/devices",
+        sys_accel_root: str = "",
         accelerator_type: str = "",
         worker_id: int = 0,
+        sysfs_root: Optional[str] = None,
     ) -> None:
+        from gpud_tpu.tpu.sysfs import TpuVmSurface
+
         self.dev_root = dev_root
-        self.sys_accel_root = sys_accel_root
-        self.pci_root = pci_root
-        self._accel_type = accelerator_type or _gce_metadata_accel_type()
+        if sysfs_root is None:
+            # a caller that redirected dev_root to a fixture but left
+            # sysfs_root alone must NOT scan the real /sys — on an actual
+            # TPU VM the real PCI chips would win over the fixture nodes
+            sysfs_root = "/sys" if dev_root == "/dev" else ""
+        self.sysfs_root = sysfs_root
+        # legacy explicit accel-class root (older fixtures); derived from
+        # sysfs_root when not given
+        self.sys_accel_root = sys_accel_root or (
+            os.path.join(sysfs_root, "class", "accel") if sysfs_root else ""
+        )
         self._worker_id = worker_id
         self._init_error = ""
+        self.surface = TpuVmSurface(sysfs_root=sysfs_root, dev_root=dev_root)
+        self._unbound: set = set()
         self._chips = self._enumerate()
+        self._accel_type = (
+            accelerator_type
+            or _gce_metadata_accel_type()
+            or self._infer_accel_type()
+        )
+        self._backfill_topology_facts()
+
+    def _backfill_topology_facts(self) -> None:
+        """Reconcile per-chip facts with the resolved accelerator type.
+
+        The topology (operator flag or GCE metadata) outranks the PCI
+        device id: the legacy id 0x0027 is shared by v2 and v3, so a v3
+        host would otherwise be stamped v2 with half its real HBM. Chips
+        enumerated from bare device nodes carry no generation at all and
+        get everything from the topology."""
+        topo = parse_accelerator_type(self._accel_type) if self._accel_type else None
+        if topo is None:
+            return
+        spec = GENERATIONS.get(topo.generation)
+        for chip in self._chips.values():
+            if chip.generation != topo.generation:
+                chip.generation = topo.generation
+                chip.hbm_total_bytes = topo.hbm_bytes_per_chip
+                if spec is not None:
+                    chip.cores = spec.cores_per_chip
+            if chip.hbm_total_bytes == 0:
+                chip.hbm_total_bytes = topo.hbm_bytes_per_chip
+            if spec is not None and chip.cores == 2 and spec.cores_per_chip != 2:
+                chip.cores = spec.cores_per_chip
 
     def _enumerate(self) -> Dict[int, TPUChip]:
+        chips = self._enumerate_pci()
+        if chips:
+            return chips
+        return self._enumerate_dev_nodes()
+
+    def _enumerate_pci(self) -> Dict[int, TPUChip]:
+        """The stock-TPU-VM path: chips are the vendor-0x1ae0 PCI
+        functions; generation comes from the device id table, so this
+        works with no metadata server at all."""
+        if not self.sysfs_root:
+            return {}
+        fns = self.surface.scan()
         chips: Dict[int, TPUChip] = {}
-        topo = parse_accelerator_type(self._accel_type) if self._accel_type else None
-        gen = topo.generation if topo else ""
-        hbm = topo.hbm_bytes_per_chip if topo else 0
+        ordered = self.surface.chip_order()
+        # accel-class indices are only authoritative when every function
+        # has one — a partial set (dangling udev symlink) mixed with
+        # positional ids could collide and silently drop a chip
+        use_accel_ids = bool(ordered) and all(
+            f.accel_index is not None for f in ordered
+        )
+        for i, fn in enumerate(ordered):
+            cid = fn.accel_index if use_accel_ids else i
+            gen = fn.generation
+            spec = GENERATIONS.get(gen)
+            chip = TPUChip(
+                chip_id=cid,
+                device_path=fn.accel_dev or fn.vfio_dev or f"pci:{fn.bdf}",
+                pci_address=fn.bdf,
+                generation=gen,
+                cores=spec.cores_per_chip if spec else 2,
+                hbm_total_bytes=spec.hbm_bytes_per_chip if spec else 0,
+                numa_node=fn.numa_node,
+                driver=fn.driver,
+                iommu_group=fn.iommu_group,
+            )
+            if not fn.bound:
+                # present on PCI but no driver → unusable by libtpu; keep
+                # it enumerated (chip-count stays right) but mark it so
+                # derived ICI liveness reports its links down
+                chip.requires_reset = True
+                self._unbound.add(cid)
+            chips[cid] = chip
+        return chips
+
+    def _enumerate_dev_nodes(self) -> Dict[int, TPUChip]:
+        """Fallback for environments exposing only bare device nodes."""
+        chips: Dict[int, TPUChip] = {}
         for path in sorted(glob.glob(os.path.join(self.dev_root, "accel[0-9]*"))):
             m = re.search(r"accel(\d+)$", path)
             if not m:
                 continue
             cid = int(m.group(1))
-            chip = TPUChip(
-                chip_id=cid,
-                device_path=path,
-                generation=gen,
-                hbm_total_bytes=hbm,
-            )
+            chip = TPUChip(chip_id=cid, device_path=path)
             # PCI address via /sys/class/accel/accelN/device symlink
             sys_dev = os.path.join(self.sys_accel_root, f"accel{cid}", "device")
             try:
@@ -449,9 +579,25 @@ class SysfsBackend(SysfsICILinksMixin, TPUInstance):
             # vfio-based runtimes expose chips as /dev/vfio/* instead
             vfio = sorted(glob.glob(os.path.join(self.dev_root, "vfio", "[0-9]*")))
             for i, path in enumerate(vfio):
-                chips[i] = TPUChip(chip_id=i, device_path=path, generation=gen,
-                                   hbm_total_bytes=hbm)
+                chips[i] = TPUChip(chip_id=i, device_path=path)
         return chips
+
+    def _infer_accel_type(self) -> str:
+        """Single-host accelerator type synthesized from the PCI-derived
+        generation when the metadata server is absent (bare-metal-ish or
+        fixture runs). Multi-host slices need the metadata value — a
+        local-only guess would understate the topology, so this only
+        claims what this host can see."""
+        gens = {c.generation for c in self._chips.values() if c.generation}
+        if len(gens) != 1:
+            return ""
+        gen = gens.pop()
+        spec = GENERATIONS.get(gen)
+        if spec is None:
+            return ""
+        n = len(self._chips)
+        count = n if spec.suffix_counts_chips else n * spec.cores_per_chip
+        return f"{gen}-{count}"
 
     def tpu_lib_exists(self) -> bool:
         return bool(self._chips)
@@ -467,17 +613,16 @@ class SysfsBackend(SysfsICILinksMixin, TPUInstance):
         return self._accel_type
 
     def driver_version(self) -> str:
-        for name in ("google_tpu", "accel", "gasket"):
-            v = _read_file(f"/sys/module/{name}/version")
-            if v:
-                return v
-        return ""
+        return self.surface.driver_version()
 
     def worker_id(self) -> int:
         return self._worker_id
 
     def devices(self) -> Dict[int, TPUChip]:
         return dict(self._chips)
+
+    def _unbound_chip_ids(self) -> set:
+        return set(self._unbound)
 
     def telemetry_supported(self) -> bool:
         return False  # sysfs telemetry not exposed by current drivers
@@ -686,26 +831,34 @@ def new_instance(
     elif os.environ.get(ENV_USE_JAX, "").lower() in ("1", "true", "yes"):
         inst = JaxBackend(accelerator_type=accelerator_type)
     else:
-        inst = SysfsBackend(accelerator_type=accelerator_type, worker_id=worker_id)
+        inst = SysfsBackend(
+            accelerator_type=accelerator_type,
+            worker_id=worker_id,
+            sysfs_root=os.environ.get(ENV_SYSFS_ROOT, "/sys"),
+            dev_root=os.environ.get(ENV_DEV_ROOT, "/dev"),
+        )
         # prefer tpu-info when on PATH: same side-band chips plus telemetry.
         # Pass the sysfs-resolved accelerator type (GCE metadata) so slice
         # topology isn't re-inferred from local chips only; availability is
-        # a PATH check, so the probe costs one CLI run at most.
-        try:
-            from gpud_tpu.tpu.tpu_info_backend import (
-                TpuInfoBackend,
-                tpu_info_available,
-            )
-
-            if tpu_info_available():
-                ti = TpuInfoBackend(
-                    accelerator_type=inst.accelerator_type() or accelerator_type,
-                    worker_id=worker_id,
+        # a PATH check, so the probe costs one CLI run at most. Fixture
+        # runs (root overrides set) must stay on the fixture-driven
+        # backend — the CLI would enumerate the real hardware instead.
+        if not (os.environ.get(ENV_SYSFS_ROOT) or os.environ.get(ENV_DEV_ROOT)):
+            try:
+                from gpud_tpu.tpu.tpu_info_backend import (
+                    TpuInfoBackend,
+                    tpu_info_available,
                 )
-                if ti.tpu_lib_exists():
-                    inst = ti
-        except Exception:  # noqa: BLE001 — sysfs result stands
-            pass
+
+                if tpu_info_available():
+                    ti = TpuInfoBackend(
+                        accelerator_type=inst.accelerator_type() or accelerator_type,
+                        worker_id=worker_id,
+                    )
+                    if ti.tpu_lib_exists():
+                        inst = ti
+            except Exception:  # noqa: BLE001 — sysfs result stands
+                pass
     if failure_injector is not None and not failure_injector.empty():
         inst = InjectedInstance(inst, failure_injector)
     return inst

@@ -1,0 +1,206 @@
+"""Readers for the sysfs surface a stock TPU VM actually exposes.
+
+This is the ground-truth enumeration path (reference:
+components/accelerator/nvidia/infiniband/class/class.go:14-34 reads the
+real /sys/class/infiniband tree with checked-in fixture snapshots; we do
+the same for the TPU-VM PCI/accel/vfio surface, with fixture trees per
+generation under tests/fixtures/tpuvm/).
+
+What a stock TPU VM exposes (no node agent, no mapping layer):
+
+- ``/sys/bus/pci/devices/<bdf>/`` — every TPU chip is a PCI function with
+  vendor ``0x1ae0`` (Google). The device id identifies the generation; the
+  id table below matches the public ``tpu-info`` tool
+  (google/cloud-accelerator-diagnostics, tpu_info/device.py), which
+  detects chips exactly this way. Standard attributes: ``vendor``,
+  ``device``, ``class``, ``revision``, ``subsystem_vendor``,
+  ``subsystem_device``, ``numa_node``, plus ``driver`` and ``iommu_group``
+  symlinks.
+- ``/sys/class/accel/accelN/device`` — on gasket/accel-driver runtimes
+  (v2–v4 era) each chip also has an accel class entry whose ``device``
+  symlink resolves to the PCI function; the accelN index is the stable
+  chip index and ``/dev/accelN`` is the char device.
+- ``/dev/vfio/<group>`` + ``/sys/kernel/iommu_groups/<group>/devices/`` —
+  on vfio-pci runtimes (v5e/v5p/v6e) chips are bound to ``vfio-pci`` and
+  libtpu opens them through their IOMMU-group char device.
+
+Per-port ICI link state is NOT in this tree on any current runtime
+(SURVEY §7 hard parts: "per-link counters are less exposed than
+/sys/class/infiniband"). The honest default ICI source is therefore
+*derived*: the link inventory comes from the slice topology (axis count
+per generation), and coarse liveness comes from this surface — a chip
+that vanished from PCI or lost its driver binding has its links reported
+down. Fine-grained link faults arrive through the driver kmsg catalog,
+and deployments that do map per-link nodes keep the ``TPUD_ICI_SYSFS_ROOT``
+override (see instance.SysfsICILinksMixin).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+TPU_PCI_VENDOR = "0x1ae0"
+
+# PCI device id → TPU generation. Source: the public tpu-info tool's chip
+# table (google/cloud-accelerator-diagnostics, tpu_info/device.py) — it
+# identifies chips by scanning /sys/bus/pci/devices for vendor 0x1ae0 and
+# these device ids.
+PCI_DEVICE_IDS: Dict[str, str] = {
+    "0x0027": "v2",   # legacy gasket-era id (v2/v3 share the TPU-VM id)
+    "0x005e": "v4",
+    "0x0062": "v5p",
+    "0x0063": "v5e",
+    "0x006f": "v6e",
+}
+
+# kernel modules that carry the TPU driver version, by runtime era
+_DRIVER_MODULES = ("google_tpu", "accel", "gasket", "tpu_common", "vfio_pci")
+
+
+@dataclass
+class PciTpuFunction:
+    """One TPU chip's PCI function as sysfs exposes it."""
+
+    bdf: str                       # e.g. "0000:00:04.0"
+    device_id: str = ""            # e.g. "0x0063"
+    generation: str = ""           # derived from device_id
+    class_code: str = ""
+    revision: str = ""
+    subsystem_vendor: str = ""
+    subsystem_device: str = ""
+    numa_node: int = -1
+    driver: str = ""               # basename of the driver symlink ("vfio-pci", "accel", ...)
+    iommu_group: str = ""          # basename of the iommu_group symlink
+    vfio_dev: str = ""             # /dev/vfio/<group> when it exists
+    accel_index: Optional[int] = None  # accelN class index when present
+    accel_dev: str = ""            # /dev/accelN when it exists
+
+    @property
+    def bound(self) -> bool:
+        """A chip whose PCI function lost its driver binding is not usable
+        by libtpu — coarse ICI-liveness treats it as down."""
+        return bool(self.driver)
+
+
+@dataclass
+class TpuVmSurface:
+    """Aggregated view of the TPU-VM sysfs/dev surface.
+
+    ``sysfs_root``/``dev_root`` are parameterized so checked-in fixture
+    trees drive tests (SURVEY §4.4 fixture-directory pattern — the same
+    mechanism as the reference's --infiniband-class-root-dir).
+    """
+
+    sysfs_root: str = "/sys"
+    dev_root: str = "/dev"
+    functions: List[PciTpuFunction] = field(default_factory=list)
+
+    def scan(self) -> List[PciTpuFunction]:
+        self.functions = self._scan_pci()
+        self._overlay_accel_class(self.functions)
+        self._overlay_vfio(self.functions)
+        return self.functions
+
+    # -- PCI ---------------------------------------------------------------
+    def _scan_pci(self) -> List[PciTpuFunction]:
+        out: List[PciTpuFunction] = []
+        pci_root = os.path.join(self.sysfs_root, "bus", "pci", "devices")
+        for dev_dir in sorted(glob.glob(os.path.join(pci_root, "*"))):
+            if _read(dev_dir, "vendor").lower() != TPU_PCI_VENDOR:
+                continue
+            fn = PciTpuFunction(bdf=os.path.basename(dev_dir))
+            fn.device_id = _read(dev_dir, "device").lower()
+            fn.generation = PCI_DEVICE_IDS.get(fn.device_id, "")
+            fn.class_code = _read(dev_dir, "class")
+            fn.revision = _read(dev_dir, "revision")
+            fn.subsystem_vendor = _read(dev_dir, "subsystem_vendor")
+            fn.subsystem_device = _read(dev_dir, "subsystem_device")
+            numa = _read(dev_dir, "numa_node")
+            try:
+                fn.numa_node = int(numa)
+            except ValueError:
+                fn.numa_node = -1
+            fn.driver = _link_basename(os.path.join(dev_dir, "driver"))
+            fn.iommu_group = _link_basename(os.path.join(dev_dir, "iommu_group"))
+            out.append(fn)
+        return out
+
+    # -- accel class (gasket/accel driver era) -----------------------------
+    def _overlay_accel_class(self, fns: List[PciTpuFunction]) -> None:
+        by_bdf = {f.bdf: f for f in fns}
+        accel_root = os.path.join(self.sysfs_root, "class", "accel")
+        for entry in sorted(glob.glob(os.path.join(accel_root, "accel[0-9]*"))):
+            m = re.search(r"accel(\d+)$", entry)
+            if not m:
+                continue
+            idx = int(m.group(1))
+            dev_link = os.path.join(entry, "device")
+            try:
+                bdf = os.path.basename(os.path.realpath(dev_link))
+            except OSError:
+                continue
+            fn = by_bdf.get(bdf)
+            if fn is None:
+                continue
+            fn.accel_index = idx
+            dev_node = os.path.join(self.dev_root, f"accel{idx}")
+            if os.path.exists(dev_node):
+                fn.accel_dev = dev_node
+
+    # -- vfio (v5e/v5p/v6e era) -------------------------------------------
+    def _overlay_vfio(self, fns: List[PciTpuFunction]) -> None:
+        for fn in fns:
+            if not fn.iommu_group:
+                continue
+            vfio_node = os.path.join(self.dev_root, "vfio", fn.iommu_group)
+            if os.path.exists(vfio_node):
+                fn.vfio_dev = vfio_node
+
+    # -- aggregate facts ---------------------------------------------------
+    def generation(self) -> str:
+        """Consensus generation across enumerated functions ('' if mixed
+        or none — a mixed host is a hardware fault worth surfacing, not
+        silently picking one)."""
+        gens = {f.generation for f in self.functions if f.generation}
+        if len(gens) == 1:
+            return gens.pop()
+        if len(gens) > 1:
+            logger.warning("mixed TPU generations on one host: %s", sorted(gens))
+        return ""
+
+    def driver_version(self) -> str:
+        for name in _DRIVER_MODULES:
+            v = _read(os.path.join(self.sysfs_root, "module", name), "version")
+            if v:
+                return v
+        return ""
+
+    def chip_order(self) -> List[PciTpuFunction]:
+        """Stable chip ordering: accel-class index when the driver assigns
+        one (it is the /dev/accelN index), else BDF order."""
+        if self.functions and all(f.accel_index is not None for f in self.functions):
+            return sorted(self.functions, key=lambda f: f.accel_index)
+        return sorted(self.functions, key=lambda f: f.bdf)
+
+
+def _read(dirname: str, name: str) -> str:
+    try:
+        with open(os.path.join(dirname, name), "r", encoding="ascii") as f:
+            return f.read().strip()
+    except OSError:
+        return ""
+
+
+def _link_basename(path: str) -> str:
+    try:
+        return os.path.basename(os.readlink(path))
+    except OSError:
+        return ""

@@ -1,0 +1,115 @@
+#!/usr/bin/env python3
+"""Generates the checked-in TPU-VM sysfs fixture trees under
+tests/fixtures/tpuvm/ (run once; the trees are committed, the script
+documents their provenance and regenerates them if the surface model
+changes).
+
+Each tree mirrors what an *unmodified* TPU VM of that generation exposes
+(reference pattern: the checked-in H100 /sys/class/infiniband snapshot,
+components/accelerator/nvidia/infiniband/class/testdata/):
+
+- v4-8:  gasket/accel-driver era — 4 chips, /dev/accelN char devices,
+         /sys/class/accel/accelN class entries, driver "accel".
+- v5e-8: vfio era — 8 chips bound to vfio-pci, /dev/vfio/<group> nodes,
+         /sys/kernel/iommu_groups/<group>/devices/ back-links.
+- v5p-8: vfio era — 4 chips (v5p-8 = 8 TensorCores), NUMA split 0/0/1/1.
+
+PCI device ids follow the public tpu-info chip table
+(google/cloud-accelerator-diagnostics, tpu_info/device.py):
+v4=0x005e, v5e=0x0063, v5p=0x0062.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.join(HERE, "tpuvm")
+
+
+def _write(path: str, content: str) -> None:
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w", encoding="ascii") as f:
+        f.write(content + "\n")
+
+
+def _symlink(target: str, link: str) -> None:
+    os.makedirs(os.path.dirname(link), exist_ok=True)
+    if os.path.islink(link):
+        os.unlink(link)
+    os.symlink(target, link)
+
+
+def make_tree(
+    name: str,
+    n_chips: int,
+    device_id: str,
+    driver: str,
+    numa_nodes: list,
+    accel_class: bool,
+    vfio: bool,
+    first_group: int = 8,
+) -> None:
+    base = os.path.join(ROOT, name)
+    if os.path.isdir(base):
+        shutil.rmtree(base)
+    sysd = os.path.join(base, "sys")
+    devd = os.path.join(base, "dev")
+    os.makedirs(devd, exist_ok=True)
+
+    drivers_dir = os.path.join(sysd, "bus", "pci", "drivers", driver)
+    os.makedirs(drivers_dir, exist_ok=True)
+
+    for i in range(n_chips):
+        bdf = f"0000:00:{0x04 + i:02x}.0"
+        dev_dir = os.path.join(sysd, "devices", "pci0000:00", bdf)
+        _write(os.path.join(dev_dir, "vendor"), "0x1ae0")
+        _write(os.path.join(dev_dir, "device"), device_id)
+        _write(os.path.join(dev_dir, "class"), "0x120000")
+        _write(os.path.join(dev_dir, "revision"), "0x00")
+        _write(os.path.join(dev_dir, "subsystem_vendor"), "0x1ae0")
+        _write(os.path.join(dev_dir, "subsystem_device"), "0x0056")
+        _write(os.path.join(dev_dir, "numa_node"), str(numa_nodes[i]))
+        # driver symlink: sys/devices/pci0000:00/<bdf>/driver -> sys/bus/pci/drivers/<drv>
+        _symlink(f"../../../bus/pci/drivers/{driver}",
+                 os.path.join(dev_dir, "driver"))
+        # bus view: sys/bus/pci/devices/<bdf> -> device dir
+        _symlink(f"../../../devices/pci0000:00/{bdf}",
+                 os.path.join(sysd, "bus", "pci", "devices", bdf))
+        # driver's bound-device back-link
+        _symlink(f"../../../../devices/pci0000:00/{bdf}",
+                 os.path.join(drivers_dir, bdf))
+
+        if accel_class:
+            _symlink(f"../../../devices/pci0000:00/{bdf}",
+                     os.path.join(sysd, "class", "accel", f"accel{i}", "device"))
+            _write(os.path.join(devd, f"accel{i}"), "")
+
+        if vfio:
+            group = first_group + i
+            _symlink(f"../../../kernel/iommu_groups/{group}",
+                     os.path.join(dev_dir, "iommu_group"))
+            _symlink(f"../../../../devices/pci0000:00/{bdf}",
+                     os.path.join(sysd, "kernel", "iommu_groups", str(group),
+                                  "devices", bdf))
+            _write(os.path.join(devd, "vfio", str(group)), "")
+
+    if vfio:
+        _write(os.path.join(devd, "vfio", "vfio"), "")
+
+
+def main() -> int:
+    make_tree("v4-8", 4, "0x005e", "accel", [0, 0, 0, 0],
+              accel_class=True, vfio=False)
+    make_tree("v5e-8", 8, "0x0063", "vfio-pci", [0] * 8,
+              accel_class=False, vfio=True)
+    make_tree("v5p-8", 4, "0x0062", "vfio-pci", [0, 0, 1, 1],
+              accel_class=False, vfio=True, first_group=12)
+    print(f"wrote fixture trees under {ROOT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

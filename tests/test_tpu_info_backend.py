@@ -2,6 +2,7 @@
 mocked the way the reference mocks lspci, e2e/mock/common.go:16-31)."""
 
 from gpud_tpu.process import RunResult
+from gpud_tpu.tpu.instance import LinkState
 from gpud_tpu.tpu.tpu_info_backend import TpuInfoBackend
 
 # a representative v4-8 single-host output (tolerant parser: the exact
@@ -128,8 +129,25 @@ def test_tpu_info_backend_ici_via_sysfs(tmp_path, monkeypatch):
     assert links["chip0/ici0"].crc_errors == 7
 
 
-def test_tpu_info_backend_ici_unsupported_without_root(monkeypatch):
+def test_tpu_info_backend_derived_ici_without_root(monkeypatch):
+    # without a mapped per-link layout the stock default applies: the
+    # link inventory is derived from the slice topology, all up (chips
+    # the CLI lists are live by construction)
     monkeypatch.delenv("TPUD_ICI_SYSFS_ROOT", raising=False)
     b = TpuInfoBackend(run_fn=_runner(FIXTURE_V4))
+    assert b.ici_supported()
+    assert b.ici_source() == "derived-topology"
+    links = b.ici_links()
+    topo = b.topology()
+    assert topo is not None
+    assert len(links) == len(b.devices()) * topo.ici_links_per_chip
+    assert all(ln.state == LinkState.UP for ln in links)
+
+
+def test_tpu_info_backend_no_topology_no_derived_ici(monkeypatch):
+    # unknown generation → no inventory can be derived → unsupported
+    monkeypatch.delenv("TPUD_ICI_SYSFS_ROOT", raising=False)
+    b = TpuInfoBackend(run_fn=_runner(FIXTURE_V4))
+    b._accel_type = ""
     assert not b.ici_supported()
     assert b.ici_links() == []
