@@ -106,6 +106,13 @@ class TPUICIComponent(PollingComponent):
         if topo is None:
             return 0
         topo_expected = len(self.tpu.devices()) * topo.ici_links_per_chip
+        source = getattr(self.tpu, "ici_source", lambda: "")()
+        if source == "derived-topology":
+            # the derived inventory IS the topology count — recording it
+            # as an observed high-water mark would poison the baseline
+            # for a later partially-mapped per-link layout (which may
+            # legitimately expose fewer nodes than the topology)
+            return topo_expected
         if reported > self._max_links_seen:
             self._max_links_seen = reported
             if self._metadata is not None:

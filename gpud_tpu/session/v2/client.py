@@ -19,6 +19,7 @@ import grpc
 
 from gpud_tpu.log import get_logger
 from gpud_tpu.session.v2 import session_pb2 as pb
+from gpud_tpu.session.v2 import typed
 from gpud_tpu.version import __version__
 
 if TYPE_CHECKING:
@@ -27,7 +28,11 @@ if TYPE_CHECKING:
 logger = get_logger(__name__)
 
 METHOD = "/tpud.session.v2.Session/Connect"
-REVISION = 1
+# rev 1: JSON Frames over gRPC; rev 2: typed per-method ManagerPacket
+# requests answered with Result packets (see session.proto header)
+MIN_REVISION = 1
+MAX_REVISION = 2
+CAPABILITIES = ["typed-requests", "drain-notice"]
 HANDSHAKE_TIMEOUT = 10.0
 
 
@@ -75,8 +80,15 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
     hello.hello.token = session.token
     hello.hello.machine_proof = session.machine_proof
     hello.hello.tpud_version = __version__
-    hello.hello.revision = REVISION
+    # rev-1 compat field: an old manager reads `revision` and never sees
+    # the range; a rev-2 manager negotiates from [min, max]
+    hello.hello.revision = MIN_REVISION
+    hello.hello.min_revision = MIN_REVISION
+    hello.hello.max_revision = MAX_REVISION
+    hello.hello.capabilities.extend(CAPABILITIES)
     out_q.put(hello)
+    # negotiated revision, fixed at handshake before send_pump starts
+    negotiated = [MIN_REVISION]
 
     def request_iter():
         while not stopped.is_set():
@@ -111,6 +123,9 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
                         handshake_err.append(mpkt.hello_ack.reason or "rejected")
                         handshake_ok.set()
                         return
+                    negotiated[0] = typed.negotiate_revision(
+                        mpkt.hello_ack.revision, MAX_REVISION
+                    )
                     handshake_ok.set()
                 elif kind == "frame":
                     from gpud_tpu.session.session import Frame
@@ -132,6 +147,33 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
                     )
                     _signal_if_established("manager draining")
                     return
+                else:
+                    # rev-2 typed request (or a payload newer than this
+                    # agent): adapt onto the same serve loop as rev-1
+                    # frames; unknowns answer an error Result so the
+                    # manager's request_id never dangles
+                    from gpud_tpu.session.session import Frame
+
+                    try:
+                        req = typed.request_to_dict(mpkt)
+                    except typed.UnsupportedRequest as e:
+                        if mpkt.request_id:
+                            out_q.put(typed.error_result(mpkt.request_id, str(e)))
+                        continue
+                    try:
+                        session.reader.put(
+                            Frame(req_id=mpkt.request_id, data=req), timeout=5.0
+                        )
+                    except queue.Full:
+                        logger.warning("v2 reader channel full; dropping")
+                        if mpkt.request_id:
+                            # same no-dangling-request_id invariant as the
+                            # UnsupportedRequest path
+                            out_q.put(
+                                typed.error_result(
+                                    mpkt.request_id, "agent busy: request dropped"
+                                )
+                            )
             if not stopped.is_set():
                 handshake_err.append("stream closed before ack")
                 handshake_ok.set()
@@ -150,9 +192,13 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
                 frame = session.writer.get(timeout=0.5)
             except queue.Empty:
                 continue
-            pkt = pb.AgentPacket()
-            pkt.frame.req_id = frame.req_id
-            pkt.frame.data = json.dumps(frame.data).encode("utf-8")
+            if negotiated[0] >= 2:
+                # rev 2: responses are Result packets keyed by request_id
+                pkt = typed.make_result(frame.req_id, frame.data)
+            else:
+                pkt = pb.AgentPacket()
+                pkt.frame.req_id = frame.req_id
+                pkt.frame.data = json.dumps(frame.data).encode("utf-8")
             out_q.put(pkt)
 
     recv_t = threading.Thread(target=recv_pump, name="tpud-v2-recv", daemon=True)

@@ -1,0 +1,200 @@
+"""Rev-2 typed-request adaptation: ManagerPacket → dispatcher request.
+
+The dispatcher's contract is a method-keyed dict (session/dispatch.py) —
+shared by v1 JSON and rev-1 Frames. Rev 2 replaces the *wire* encoding
+with per-method protobuf messages (reference:
+pkg/session/v2/session.proto:16-60 ManagerPacket oneof); this module maps
+each typed request onto the dispatcher contract, so the method surface
+stays identical across protocol revisions. Responses travel back as
+``Result{request_id, payload_json}`` (built in v2/client.py).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict
+
+from gpud_tpu.session.v2 import session_pb2 as pb
+
+# oneof field name → dispatcher method name
+FIELD_TO_METHOD = {
+    "get_states": "states",
+    "get_events": "events",
+    "get_metrics": "metrics",
+    "gossip": "gossip",
+    "diagnostic": "diagnostic",
+    "reboot": "reboot",
+    "set_healthy": "setHealthy",
+    "trigger_component": "triggerComponent",
+    "deregister_component": "deregisterComponent",
+    "inject_fault": "injectFault",
+    "bootstrap": "bootstrap",
+    "update_config": "updateConfig",
+    "update_token": "updateToken",
+    "get_token": "getToken",
+    "logout": "logout",
+    "delete_machine": "delete",
+    "get_package_status": "packageStatus",
+    "update": "update",
+    "kap_mtls_status": "kapMTLSStatus",
+    "kap_mtls_update_credentials": "kapMTLSUpdateCredentials",
+    "kap_mtls_activate": "kapMTLSActivate",
+    "get_plugin_specs": "getPluginSpecs",
+    "set_plugin_specs": "setPluginSpecs",
+}
+
+
+class UnsupportedRequest(Exception):
+    """The manager sent a payload this agent revision doesn't know —
+    either a newer oneof field (decodes as no payload) or one without a
+    dispatcher mapping. The agent answers an error Result rather than
+    dropping the request_id on the floor."""
+
+
+def request_to_dict(mpkt: pb.ManagerPacket) -> Dict:
+    """Typed ManagerPacket → dispatcher request dict.
+
+    Raises UnsupportedRequest for payloads outside the rev-2 method set.
+    Parameter names match the v1 JSON contract exactly — the dispatcher
+    is revision-agnostic.
+    """
+    kind = mpkt.WhichOneof("payload")
+    if kind is None:
+        raise UnsupportedRequest("no recognizable payload (manager newer than agent?)")
+    method = FIELD_TO_METHOD.get(kind)
+    if method is None:
+        raise UnsupportedRequest(f"non-request payload {kind!r}")
+    req: Dict = {"method": method}
+    msg = getattr(mpkt, kind)
+
+    if kind == "get_states":
+        if msg.components:
+            req["components"] = list(msg.components)
+    elif kind in ("get_events", "get_metrics"):
+        if msg.since_unix:
+            req["since"] = msg.since_unix
+    elif kind == "diagnostic":
+        if msg.script_base64:
+            req["script_base64"] = msg.script_base64
+        if msg.since_unix:
+            req["since"] = msg.since_unix
+        if msg.timeout_seconds:
+            req["timeout_seconds"] = msg.timeout_seconds
+    elif kind == "reboot":
+        if msg.delay_seconds:
+            req["delay_seconds"] = msg.delay_seconds
+    elif kind == "set_healthy":
+        req["component"] = msg.component
+    elif kind == "trigger_component":
+        req["component"] = msg.component
+        req["tag"] = msg.tag
+    elif kind == "deregister_component":
+        req["component"] = msg.component
+    elif kind == "inject_fault":
+        fault = msg.WhichOneof("fault")
+        if fault == "tpu_error_name":
+            req["tpu_error_name"] = msg.tpu_error_name
+        elif fault == "kernel_message":
+            req["kernel_message"] = msg.kernel_message.message
+            if msg.kernel_message.priority:
+                req["priority"] = msg.kernel_message.priority
+        if msg.chip_id:
+            req["chip_id"] = msg.chip_id
+        if msg.detail:
+            req["detail"] = msg.detail
+    elif kind == "bootstrap":
+        req["script_base64"] = msg.script_base64
+        if msg.timeout_seconds:
+            req["timeout_seconds"] = msg.timeout_seconds
+    elif kind == "update_config":
+        configs: Dict = {}
+        for section, raw in msg.configs_json.items():
+            try:
+                configs[section] = json.loads(raw)
+            except ValueError as e:
+                raise UnsupportedRequest(
+                    f"updateConfig section {section!r}: invalid JSON ({e})"
+                ) from e
+        req["configs"] = configs
+    elif kind == "update_token":
+        req["token"] = msg.token
+    elif kind == "update":
+        req["version"] = msg.version
+    elif kind == "kap_mtls_update_credentials":
+        req["version"] = msg.version
+        req["cert_pem"] = msg.cert_pem
+        req["key_pem"] = msg.key_pem
+        req["activate"] = msg.activate
+    elif kind == "kap_mtls_activate":
+        req["version"] = msg.version
+    elif kind == "set_plugin_specs":
+        req["specs"] = [_plugin_spec_to_dict(s) for s in msg.specs]
+    # gossip / get_token / logout / delete_machine / get_package_status /
+    # kap_mtls_status / get_plugin_specs carry no parameters
+
+    return req
+
+
+def _plugin_spec_to_dict(spec: pb.PluginSpec) -> Dict:
+    """Typed PluginSpec → the plugins.spec JSON contract
+    (plugins/spec.py PluginSpec.from_dict)."""
+    out: Dict = {
+        "name": spec.name,
+        "steps": [
+            {
+                "name": st.name,
+                **(
+                    {"script_base64": st.script_base64}
+                    if st.script_base64
+                    else {"script": st.script}
+                ),
+            }
+            for st in spec.steps
+        ],
+    }
+    if spec.plugin_type:
+        out["plugin_type"] = spec.plugin_type
+    if spec.run_mode:
+        out["run_mode"] = spec.run_mode
+    if spec.interval_seconds:
+        out["interval_seconds"] = spec.interval_seconds
+    if spec.timeout_seconds:
+        out["timeout_seconds"] = spec.timeout_seconds
+    if spec.tags:
+        out["tags"] = list(spec.tags)
+    if spec.component_list:
+        out["component_list"] = list(spec.component_list)
+    if spec.HasField("parser"):
+        out["parser"] = {
+            "json_paths": dict(spec.parser.json_paths),
+            "match_rules": [
+                {
+                    "regex": r.regex,
+                    "field": r.field,
+                    "health": r.health or "Unhealthy",
+                    "suggested_actions": list(r.suggested_actions),
+                    "description": r.description,
+                }
+                for r in spec.parser.match_rules
+            ],
+        }
+    return out
+
+
+def make_result(request_id: str, payload: Dict) -> pb.AgentPacket:
+    pkt = pb.AgentPacket()
+    pkt.result.request_id = request_id
+    pkt.result.payload_json = json.dumps(payload).encode("utf-8")
+    return pkt
+
+
+def error_result(request_id: str, message: str) -> pb.AgentPacket:
+    return make_result(request_id, {"error": message})
+
+
+def negotiate_revision(ack_revision: int, max_supported: int) -> int:
+    """Manager's acked revision clamped to what this agent speaks; 0 (an
+    old manager that never sets the field) means rev 1."""
+    if ack_revision <= 0:
+        return 1
+    return min(ack_revision, max_supported)

@@ -526,16 +526,10 @@ class SysfsBackend(SysfsICILinksMixin, TPUInstance):
         works with no metadata server at all."""
         if not self.sysfs_root:
             return {}
-        fns = self.surface.scan()
+        self.surface.scan()
         chips: Dict[int, TPUChip] = {}
-        ordered = self.surface.chip_order()
-        # accel-class indices are only authoritative when every function
-        # has one — a partial set (dangling udev symlink) mixed with
-        # positional ids could collide and silently drop a chip
-        use_accel_ids = bool(ordered) and all(
-            f.accel_index is not None for f in ordered
-        )
-        for i, fn in enumerate(ordered):
+        use_accel_ids = self.surface.accel_indices_authoritative()
+        for i, fn in enumerate(self.surface.chip_order()):
             cid = fn.accel_index if use_accel_ids else i
             gen = fn.generation
             spec = GENERATIONS.get(gen)
@@ -588,12 +582,9 @@ class SysfsBackend(SysfsICILinksMixin, TPUInstance):
         fixture runs). Multi-host slices need the metadata value — a
         local-only guess would understate the topology, so this only
         claims what this host can see."""
-        gens = {c.generation for c in self._chips.values() if c.generation}
-        if len(gens) != 1:
-            return ""
-        gen = gens.pop()
+        gen = self.surface.generation()  # consensus; warns on a mixed host
         spec = GENERATIONS.get(gen)
-        if spec is None:
+        if spec is None or not self._chips:
             return ""
         n = len(self._chips)
         count = n if spec.suffix_counts_chips else n * spec.cores_per_chip
@@ -834,7 +825,10 @@ def new_instance(
         inst = SysfsBackend(
             accelerator_type=accelerator_type,
             worker_id=worker_id,
-            sysfs_root=os.environ.get(ENV_SYSFS_ROOT, "/sys"),
+            # None (not "/sys") when unset: the constructor's guard must
+            # still suppress the real-PCI scan if only the dev root was
+            # redirected to a fixture
+            sysfs_root=os.environ.get(ENV_SYSFS_ROOT) or None,
             dev_root=os.environ.get(ENV_DEV_ROOT, "/dev"),
         )
         # prefer tpu-info when on PATH: same side-band chips plus telemetry.

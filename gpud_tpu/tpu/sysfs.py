@@ -183,10 +183,18 @@ class TpuVmSurface:
                 return v
         return ""
 
+    def accel_indices_authoritative(self) -> bool:
+        """True when every function has an accel-class index — only then
+        do accelN indices name the chips; a partial set (dangling udev
+        symlink) mixed with positional ids could collide."""
+        return bool(self.functions) and all(
+            f.accel_index is not None for f in self.functions
+        )
+
     def chip_order(self) -> List[PciTpuFunction]:
         """Stable chip ordering: accel-class index when the driver assigns
         one (it is the /dev/accelN index), else BDF order."""
-        if self.functions and all(f.accel_index is not None for f in self.functions):
+        if self.accel_indices_authoritative():
             return sorted(self.functions, key=lambda f: f.accel_index)
         return sorted(self.functions, key=lambda f: f.bdf)
 

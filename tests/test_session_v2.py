@@ -19,10 +19,12 @@ class FakeManagerV2:
     """Minimal v2 control plane: accepts Hello, streams requests, collects
     responses; can emit a DrainNotice."""
 
-    def __init__(self, reject=False):
+    def __init__(self, reject=False, revision=1):
         self.reject = reject
+        self.revision = revision  # revision this manager acks (0 = legacy)
         self.hellos = []
-        self.responses = []
+        self.responses = []   # rev-1 Frame responses: (req_id, dict)
+        self.results = []     # rev-2 Result responses: (request_id, dict)
         self.outbound = queue.Queue()
         self.drain = threading.Event()
         self._server = None
@@ -35,7 +37,7 @@ class FakeManagerV2:
         ack = pb.ManagerPacket()
         ack.hello_ack.accepted = not self.reject
         ack.hello_ack.reason = "bad token" if self.reject else ""
-        ack.hello_ack.revision = 1
+        ack.hello_ack.revision = self.revision
         yield ack
         if self.reject:
             return
@@ -45,9 +47,17 @@ class FakeManagerV2:
         def drain_requests():
             try:
                 for pkt in request_iterator:
-                    if pkt.WhichOneof("payload") == "frame":
+                    kind = pkt.WhichOneof("payload")
+                    if kind == "frame":
                         self.responses.append(
                             (pkt.frame.req_id, json.loads(pkt.frame.data.decode()))
+                        )
+                    elif kind == "result":
+                        self.results.append(
+                            (
+                                pkt.result.request_id,
+                                json.loads(pkt.result.payload_json.decode()),
+                            )
                         )
             except Exception:
                 pass
@@ -64,6 +74,9 @@ class FakeManagerV2:
             try:
                 item = self.outbound.get(timeout=0.1)
             except queue.Empty:
+                continue
+            if isinstance(item, pb.ManagerPacket):
+                yield item  # pre-built (typed rev-2) request
                 continue
             req_id, data = item
             m = pb.ManagerPacket()

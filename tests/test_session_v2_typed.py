@@ -1,0 +1,262 @@
+"""Session v2 revision-2 typed protocol (VERDICT r2 Missing #2).
+
+Reference shape: pkg/session/v2/session.proto:16-60 — per-method request
+messages in the ManagerPacket oneof with a top-level request_id, Result
+agent packets, Hello advertising a revision range. Covers negotiation in
+both directions: a rev-2 manager drives typed requests; a rev-1 (old)
+manager keeps the legacy Frame tunnel against the same agent.
+"""
+
+import json
+import time
+
+import pytest
+
+grpc = pytest.importorskip("grpc")
+
+from gpud_tpu.session.session import Session
+from gpud_tpu.session.v2 import session_pb2 as pb
+from gpud_tpu.session.v2 import typed
+from tests.test_session_v2 import FakeManagerV2, _wait
+
+
+def _session(manager, dispatch_fn):
+    return Session(
+        endpoint=f"http://127.0.0.1:{manager.port}",
+        machine_id="m-typed",
+        token="tok",
+        machine_proof="proof",
+        dispatch_fn=dispatch_fn,
+        protocol="v2",
+        jitter_fn=lambda b: 0.05,
+    )
+
+
+# -- negotiation + wire roundtrips ----------------------------------------
+
+def test_hello_advertises_revision_range_and_capabilities():
+    m = FakeManagerV2(revision=1)
+    m.start()
+    try:
+        s = _session(m, lambda req: {})
+        s.start()
+        assert _wait(lambda: s.connected)
+        h = m.hellos[0]
+        assert h.min_revision == 1
+        assert h.max_revision == 2
+        assert "typed-requests" in list(h.capabilities)
+        assert h.revision == 1  # legacy compat field for old managers
+        s.stop()
+    finally:
+        m.stop()
+
+
+def test_rev2_manager_gets_typed_dispatch_and_result():
+    m = FakeManagerV2(revision=2)
+    m.start()
+    try:
+        seen = []
+
+        def dispatch(req):
+            seen.append(req)
+            return {"status": "ok", "method_seen": req.get("method")}
+
+        s = _session(m, dispatch)
+        s.start()
+        assert _wait(lambda: s.connected)
+
+        pkt = pb.ManagerPacket()
+        pkt.request_id = "req-42"
+        pkt.get_states.components.append("cpu")
+        m.outbound.put(pkt)
+
+        assert _wait(lambda: m.results)
+        request_id, payload = m.results[0]
+        assert request_id == "req-42"
+        assert payload == {"status": "ok", "method_seen": "states"}
+        assert seen[0] == {"method": "states", "components": ["cpu"]}
+        assert m.responses == []  # nothing rode the legacy Frame tunnel
+        s.stop()
+    finally:
+        m.stop()
+
+
+def test_rev1_manager_keeps_frame_tunnel():
+    # old manager ↔ new agent: ack pins rev 1, requests/responses stay
+    # JSON Frames even though the agent could speak rev 2
+    m = FakeManagerV2(revision=1)
+    m.start()
+    try:
+        s = _session(m, lambda req: {"echo": req})
+        s.start()
+        assert _wait(lambda: s.connected)
+        m.outbound.put(("r1", {"method": "ping"}))
+        assert _wait(lambda: m.responses)
+        assert m.responses[0] == ("r1", {"echo": {"method": "ping"}})
+        assert m.results == []
+        s.stop()
+    finally:
+        m.stop()
+
+
+def test_legacy_manager_acking_zero_means_rev1():
+    m = FakeManagerV2(revision=0)
+    m.start()
+    try:
+        s = _session(m, lambda req: {"ok": True})
+        s.start()
+        assert _wait(lambda: s.connected)
+        m.outbound.put(("rz", {"method": "ping"}))
+        assert _wait(lambda: m.responses)
+        assert m.results == []
+        s.stop()
+    finally:
+        m.stop()
+
+
+def test_unknown_future_payload_answers_error_result():
+    # manager newer than agent: a payload field this agent's schema does
+    # not know decodes as "no payload"; the agent must answer an error
+    # Result instead of dangling the request_id
+    m = FakeManagerV2(revision=2)
+    m.start()
+    try:
+        s = _session(m, lambda req: {"ok": True})
+        s.start()
+        assert _wait(lambda: s.connected)
+
+        raw = pb.ManagerPacket()
+        raw.request_id = "req-future"
+        blob = raw.SerializeToString()
+        # append an unknown length-delimited field (#99, varint-encoded
+        # tag 0x9a 0x06) — simulates a future request type; python
+        # protobuf preserves unknown fields through reserialization
+        blob += b"\x9a\x06\x03" + b"xyz"
+        pkt = pb.ManagerPacket.FromString(blob)
+        m.outbound.put(pkt)
+
+        assert _wait(lambda: m.results)
+        request_id, payload = m.results[0]
+        assert request_id == "req-future"
+        assert "error" in payload
+        s.stop()
+    finally:
+        m.stop()
+
+
+def test_rev2_typed_inject_fault_roundtrip():
+    m = FakeManagerV2(revision=2)
+    m.start()
+    try:
+        seen = []
+        s = _session(m, lambda req: (seen.append(req), {"status": "ok"})[1])
+        s.start()
+        assert _wait(lambda: s.connected)
+
+        pkt = pb.ManagerPacket()
+        pkt.request_id = "req-if"
+        pkt.inject_fault.tpu_error_name = "hbm_ecc_uncorrectable"
+        pkt.inject_fault.chip_id = 2
+        m.outbound.put(pkt)
+
+        assert _wait(lambda: m.results)
+        assert seen[0] == {
+            "method": "injectFault",
+            "tpu_error_name": "hbm_ecc_uncorrectable",
+            "chip_id": 2,
+        }
+        s.stop()
+    finally:
+        m.stop()
+
+
+# -- conversion contract (no gRPC) ----------------------------------------
+
+def test_convert_get_events_defaults():
+    pkt = pb.ManagerPacket()
+    pkt.request_id = "r"
+    pkt.get_events.SetInParent()
+    assert typed.request_to_dict(pkt) == {"method": "events"}
+    pkt.get_events.since_unix = 123.5
+    assert typed.request_to_dict(pkt) == {"method": "events", "since": 123.5}
+
+
+def test_convert_inject_fault_kernel_message():
+    pkt = pb.ManagerPacket()
+    pkt.inject_fault.kernel_message.message = "accel0: oops"
+    pkt.inject_fault.kernel_message.priority = 3
+    assert typed.request_to_dict(pkt) == {
+        "method": "injectFault",
+        "kernel_message": "accel0: oops",
+        "priority": 3,
+    }
+
+
+def test_convert_update_config_parses_json_sections():
+    pkt = pb.ManagerPacket()
+    pkt.update_config.configs_json["ici"] = json.dumps({"expected_links": 24})
+    pkt.update_config.configs_json["chip_count"] = "4"
+    req = typed.request_to_dict(pkt)
+    assert req == {
+        "method": "updateConfig",
+        "configs": {"ici": {"expected_links": 24}, "chip_count": 4},
+    }
+
+
+def test_convert_update_config_rejects_bad_json():
+    pkt = pb.ManagerPacket()
+    pkt.update_config.configs_json["ici"] = "{not json"
+    with pytest.raises(typed.UnsupportedRequest):
+        typed.request_to_dict(pkt)
+
+
+def test_convert_plugin_specs_full_shape():
+    pkt = pb.ManagerPacket()
+    spec = pkt.set_plugin_specs.specs.add()
+    spec.name = "nv-check"
+    spec.plugin_type = "component"
+    spec.run_mode = "auto"
+    spec.interval_seconds = 120.0
+    st = spec.steps.add()
+    st.name = "probe"
+    st.script = "echo '{\"ok\": 1}'"
+    spec.parser.json_paths["ok"] = "$.ok"
+    r = spec.parser.match_rules.add()
+    r.field = "ok"
+    r.regex = "1"
+    r.health = "Healthy"
+    req = typed.request_to_dict(pkt)
+    assert req["method"] == "setPluginSpecs"
+    got = req["specs"][0]
+    assert got["name"] == "nv-check"
+    assert got["steps"] == [{"name": "probe", "script": "echo '{\"ok\": 1}'"}]
+    assert got["parser"]["json_paths"] == {"ok": "$.ok"}
+    assert got["parser"]["match_rules"][0]["health"] == "Healthy"
+
+    # the converted dict satisfies the plugin spec model end to end
+    from gpud_tpu.plugins.spec import specs_from_list
+
+    specs = specs_from_list(req["specs"])
+    assert specs[0].validate() is None
+
+
+def test_convert_parameterless_methods():
+    for field, method in (
+        ("gossip", "gossip"),
+        ("get_token", "getToken"),
+        ("logout", "logout"),
+        ("delete_machine", "delete"),
+        ("get_package_status", "packageStatus"),
+        ("kap_mtls_status", "kapMTLSStatus"),
+        ("get_plugin_specs", "getPluginSpecs"),
+    ):
+        pkt = pb.ManagerPacket()
+        getattr(pkt, field).SetInParent()
+        assert typed.request_to_dict(pkt) == {"method": method}
+
+
+def test_negotiate_revision_clamps():
+    assert typed.negotiate_revision(0, 2) == 1   # legacy manager
+    assert typed.negotiate_revision(1, 2) == 1
+    assert typed.negotiate_revision(2, 2) == 2
+    assert typed.negotiate_revision(3, 2) == 2   # future manager clamped

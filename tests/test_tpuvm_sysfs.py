@@ -148,6 +148,27 @@ def test_no_topology_means_no_derived_links(tmp_path):
     assert b.devices() and not b.ici_supported()
 
 
+def test_derived_source_does_not_poison_high_water(tmp_db):
+    # the derived inventory always equals the topology count; persisting
+    # it as an "observed" high-water mark would make a later partially-
+    # mapped per-link layout (fewer real nodes than topology) alarm
+    # forever (see ici.py _expected_links)
+    from gpud_tpu.components.base import TpudInstance
+    from gpud_tpu.components.tpu.ici import TPUICIComponent
+    from gpud_tpu.eventstore import EventStore
+    from gpud_tpu.metadata import KEY_ICI_MAX_LINKS_SEEN, Metadata
+
+    b = _backend("v5p-8")
+    assert b.ici_source() == "derived-topology"
+    inst = TpudInstance(tpu_instance=b, db_rw=tmp_db, event_store=EventStore(tmp_db))
+    comp = TPUICIComponent(inst)
+    comp.sampler.ttl = 0.0
+    r = comp.check_once()
+    assert r.extra_info["links_up"] == "24"
+    assert r.extra_info["links_expected"] == "24"
+    assert Metadata(tmp_db).get(KEY_ICI_MAX_LINKS_SEEN) in (None, "", "0")
+
+
 # -- surface reader unit facts --------------------------------------------
 
 def test_surface_scan_attributes():
