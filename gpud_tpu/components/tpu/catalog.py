@@ -144,6 +144,20 @@ CATALOG: List[CatalogEntry] = [
        EventType.CRITICAL,
        "TPU firmware load failed",
        _REBOOT_HW, reboot_threshold=1),
+    # driver resource setup (gasket/accel class patterns; the production
+    # TPU driver is out-of-tree, so these anchor on the class vocabulary
+    # rather than verbatim strings). Before the generic probe/init entry:
+    # "interrupt vector init failed" must hit the specific class.
+    _e(61, "tpu_msix_init_failed",
+       r"((gasket|accel|apex).*(MSI-?X|interrupt vector).*(alloc|init|enable)\w*.*fail|TPU-ERR: tpu_msix_init_failed)",
+       EventType.CRITICAL,
+       "TPU interrupt vector allocation/initialization failed",
+       _REBOOT, reboot_threshold=2),
+    _e(62, "tpu_bar_map_failed",
+       r"((gasket|accel|apex).*(BAR ?\d?|register space).*(map|request|reserve)\w*.*fail|TPU-ERR: tpu_bar_map_failed)",
+       EventType.CRITICAL,
+       "TPU BAR/register-space mapping failed",
+       _REBOOT, reboot_threshold=1),
     _e(8, "tpu_driver_init_failed",
        r"((gasket|apex|accel).*(probe|init\w*).*fail|TPU-ERR: tpu_driver_init_failed)",
        EventType.CRITICAL,
@@ -263,11 +277,44 @@ CATALOG: List[CatalogEntry] = [
        "TPU temperature above warning threshold",
        _NONE, reboot_threshold=0, critical=False),
     # --- PCIe -------------------------------------------------------------
+    # On TPU VMs the only vfio-pci-bound functions ARE the TPUs (see
+    # tpu/sysfs.py), so a vfio-pci-attributed AER line is chip-scoped by
+    # construction — stronger attribution than root-port lines.
+    # Ordering within this section: recovery-failed (most severe) before
+    # the generic vfio-AER entries; corrected before uncorrected so a
+    # benign corrected burst never escalates (\bcorrected\b does not match
+    # inside "Uncorrected" — no word boundary after "Un").
+    # Kernel format: drivers/pci/pcie/err.c pcie_do_recovery
+    # ("device recovery failed")
+    _e(46, "tpu_pcie_recovery_failed",
+       r"((pcieport|vfio-pci).*(AER: )?device recovery failed|TPU-ERR: tpu_pcie_recovery_failed)",
+       EventType.FATAL,
+       "PCIe error recovery failed — device needs reset/replacement",
+       _REBOOT_HW, reboot_threshold=1, exclude=_NON_TPU_DRIVERS),
+    # Kernel format: drivers/pci/pcie/aer.c aer_print_error
+    # ("PCIe Bus Error: severity=%s, type=%s, (%s)" / "%s error received")
+    _e(63, "tpu_vfio_aer_correctable",
+       r"(vfio-pci [0-9a-f:.]+.*(severity=Corrected|Corrected error received)|TPU-ERR: tpu_vfio_aer_correctable)",
+       EventType.WARNING,
+       "corrected PCIe AER error on a vfio-bound TPU function",
+       _NONE, reboot_threshold=0, critical=False),
+    _e(45, "tpu_vfio_aer",
+       r"(vfio-pci [0-9a-f:.]+.*(AER|PCIe Bus Error)|TPU-ERR: tpu_vfio_aer)",
+       EventType.CRITICAL,
+       "uncorrected PCIe AER error on a vfio-bound TPU function",
+       _REBOOT_HW, reboot_threshold=2,
+       exclude=r"\bcorrected\b"),
     _e(40, "tpu_pcie_uncorrectable",
        r"(pcieport.*AER.*(uncorrect|fatal)|TPU-ERR: tpu_pcie_uncorrectable)",
        EventType.CRITICAL,
        "PCIe uncorrectable error on TPU path",
        _REBOOT_HW, reboot_threshold=2),
+    # Kernel format: drivers/pci/hotplug/pciehp_ctrl.c ("Slot(%s): Link Down")
+    _e(47, "tpu_pcie_slot_link_down",
+       r"(pciehp .*Slot\([^)]*\): (Link Down|Card not present)|TPU-ERR: tpu_pcie_slot_link_down)",
+       EventType.FATAL,
+       "hotplug slot link down — device dropped off the bus",
+       _REBOOT_HW, reboot_threshold=1, exclude=_NON_TPU_DRIVERS),
     _e(43, "tpu_pcie_surprise_down",
        r"(pcie\w*.*[Ss]urprise ([Ll]ink )?[Dd]own|TPU-ERR: tpu_pcie_surprise_down)",
        EventType.FATAL,
@@ -288,14 +335,33 @@ CATALOG: List[CatalogEntry] = [
        EventType.WARNING,
        "PCIe correctable errors on TPU path",
        _NONE, reboot_threshold=0, critical=False),
+    # --- driver binding (vfio runtimes) ----------------------------------
+    # Kernel format: drivers/vfio/pci/vfio_pci_core.c vfio_pci_core_request
+    # ("Relaying device request to user (#%u)") — an unbind/hot-remove was
+    # requested while the runtime holds the TPU
+    _e(48, "tpu_dev_unbind_requested",
+       r"(vfio-pci [0-9a-f:.]+.*Relaying device request to user|(accel|apex|gasket).*(unbind|unregister)|TPU-ERR: tpu_dev_unbind_requested)",
+       EventType.WARNING,
+       "device unbind requested while TPU in use",
+       _APP, reboot_threshold=0, critical=False),
+    # Kernel format: drivers/vfio/pci/vfio_pci_core.c vfio_bar_restore
+    # ("%s: reset recovery - restoring BARs") — the device reset behind
+    # the runtime's back
+    _e(49, "tpu_vfio_reset_recovery",
+       r"(vfio-pci [0-9a-f:.]+.*reset recovery - restoring BARs|TPU-ERR: tpu_vfio_reset_recovery)",
+       EventType.CRITICAL,
+       "TPU function reset behind the runtime (BARs restored)",
+       _REBOOT, reboot_threshold=2),
     # --- IOMMU ------------------------------------------------------------
     # device-attributed formats only: the generic "DMAR: DRHD: handling
     # fault status" status line appears on healthy hosts (observed in this
     # sandbox) and must not alarm. Even the attributed formats name a BDF
     # the catalog cannot map to the TPU, so this stays informational —
     # an event trail to correlate, not a health flip.
+    # DMAR bracket allows the PASID token newer kernels append
+    # ("[DMA Read NO_PASID]" — drivers/iommu/intel/dmar.c dmar_fault_do_one)
     _e(56, "tpu_iommu_fault",
-       r"(DMAR: \[DMA (Read|Write)\].*Request device|AMD-Vi.*IO_PAGE_FAULT|iommu.*page fault.*(accel|apex|tpu)|TPU-ERR: tpu_iommu_fault)",
+       r"(DMAR: \[DMA (Read|Write)[^\]]*\].*Request device|AMD-Vi.*IO_PAGE_FAULT|iommu.*page fault.*(accel|apex|tpu)|TPU-ERR: tpu_iommu_fault)",
        EventType.WARNING,
        "IOMMU DMA fault (device attribution best-effort; correlate BDF with the TPU)",
        _NONE, reboot_threshold=0, critical=False,
@@ -331,6 +397,22 @@ CATALOG: List[CatalogEntry] = [
        EventType.CRITICAL,
        "slice health degraded — worker missing/unhealthy",
        _APP, reboot_threshold=2, critical=False),
+    # Kernel format: mm/oom_kill.c ("Out of memory: Killed process %d (%s)
+    # total-vm:%lukB, ...") — scoped to TPU-runtime-ish process names; the
+    # host-wide OOM signal itself belongs to the memory component
+    _e(57, "tpu_runtime_oom_killed",
+       r"(Out of memory: Killed process \d+ \((tpu|libtpu|megascale)[^)]*\)|TPU-ERR: tpu_runtime_oom_killed)",
+       EventType.WARNING,
+       "kernel OOM-killed a TPU runtime process",
+       _APP, reboot_threshold=0, critical=False),
+    # Kernel format: drivers/acpi/apei/ghes.c / CPER decode
+    # ("{%d}[Hardware Error]: section_type: memory error") — host DIMM
+    # path (not HBM); event trail for fleet correlation
+    _e(58, "tpu_host_mem_ghes",
+       r"(\{\d+\}\[Hardware Error\]:.*memory error|ghes.*memory error|TPU-ERR: tpu_host_mem_ghes)",
+       EventType.WARNING,
+       "APEI/GHES host memory error (DIMM path, not HBM)",
+       _NONE, reboot_threshold=0, critical=False),
 ]
 
 _BY_NAME = {c.name: c for c in CATALOG}
@@ -376,7 +458,7 @@ class MatchedError:
 _PREFILTER = re.compile(
     r"tpu|accel|gasket|apex|ici|interchip|hbm|ecc|edac|mce|machine"
     r"|pcie|aer|dmar|amd-vi|iommu|megascale|dcn|slice|vrm|voltage"
-    r"|power|sram|scalar|tensor|correctable|memory|row remap",
+    r"|power|sram|scalar|tensor|correctable|memory|row remap|vfio",
     re.IGNORECASE,
 )
 

@@ -112,6 +112,18 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
         if established.wait(HANDSHAKE_TIMEOUT) and not stopped.is_set():
             session.signal_reconnect(reason)
 
+    def _enqueue_request(req_id: str, data) -> bool:
+        """Hand one inbound request to the session serve loop; False when
+        the reader channel is saturated."""
+        from gpud_tpu.session.session import Frame
+
+        try:
+            session.reader.put(Frame(req_id=req_id, data=data), timeout=5.0)
+            return True
+        except queue.Full:
+            logger.warning("v2 reader channel full; dropping")
+            return False
+
     def recv_pump():
         try:
             for mpkt in call:
@@ -128,19 +140,13 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
                     )
                     handshake_ok.set()
                 elif kind == "frame":
-                    from gpud_tpu.session.session import Frame
                     import json
 
                     try:
                         data = json.loads(mpkt.frame.data.decode("utf-8"))
                     except ValueError:
                         continue
-                    try:
-                        session.reader.put(
-                            Frame(req_id=mpkt.frame.req_id, data=data), timeout=5.0
-                        )
-                    except queue.Full:
-                        logger.warning("v2 reader channel full; dropping")
+                    _enqueue_request(mpkt.frame.req_id, data)
                 elif kind == "drain_notice":
                     logger.info(
                         "manager drain notice: %s", mpkt.drain_notice.reason
@@ -150,30 +156,20 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
                 else:
                     # rev-2 typed request (or a payload newer than this
                     # agent): adapt onto the same serve loop as rev-1
-                    # frames; unknowns answer an error Result so the
-                    # manager's request_id never dangles
-                    from gpud_tpu.session.session import Frame
-
+                    # frames; unknowns and overload answer an error Result
+                    # so the manager's request_id never dangles
                     try:
                         req = typed.request_to_dict(mpkt)
                     except typed.UnsupportedRequest as e:
                         if mpkt.request_id:
                             out_q.put(typed.error_result(mpkt.request_id, str(e)))
                         continue
-                    try:
-                        session.reader.put(
-                            Frame(req_id=mpkt.request_id, data=req), timeout=5.0
-                        )
-                    except queue.Full:
-                        logger.warning("v2 reader channel full; dropping")
-                        if mpkt.request_id:
-                            # same no-dangling-request_id invariant as the
-                            # UnsupportedRequest path
-                            out_q.put(
-                                typed.error_result(
-                                    mpkt.request_id, "agent busy: request dropped"
-                                )
+                    if not _enqueue_request(mpkt.request_id, req) and mpkt.request_id:
+                        out_q.put(
+                            typed.error_result(
+                                mpkt.request_id, "agent busy: request dropped"
                             )
+                        )
             if not stopped.is_set():
                 handshake_err.append("stream closed before ack")
                 handshake_ok.set()

@@ -96,7 +96,7 @@ def request_to_dict(mpkt: pb.ManagerPacket) -> Dict:
             req["tpu_error_name"] = msg.tpu_error_name
         elif fault == "kernel_message":
             req["kernel_message"] = msg.kernel_message.message
-            if msg.kernel_message.priority:
+            if msg.kernel_message.HasField("priority"):
                 req["priority"] = msg.kernel_message.priority
         if msg.chip_id:
             req["chip_id"] = msg.chip_id
