@@ -108,6 +108,11 @@ class TpudInstance:
         self.kmsg_path = kmsg_path
         self.failure_injector = failure_injector
         self.config = config
+        # cross-component fast path: the kmsg pipeline (inotify, ~ms) calls
+        # these on fabric-class catalog matches so pollers can open an
+        # adaptive fast-poll window instead of waiting out their cadence
+        # (listeners take the catalog error name; see ici.py)
+        self.fabric_suspicion_listeners: List[Callable[[str], None]] = []
 
 
 class CheckResult:
@@ -264,6 +269,7 @@ class PollingComponent(Component):
     def __init__(self, instance: TpudInstance) -> None:
         super().__init__(instance)
         self._stop_event = threading.Event()
+        self._poke_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.time_now_fn: Callable[[], float] = time.time
 
@@ -276,16 +282,31 @@ class PollingComponent(Component):
         )
         self._thread.start()
 
+    def poll_interval(self) -> float:
+        """Next sleep; override for adaptive cadences (e.g. the ICI
+        component's fast-poll-on-suspicion window)."""
+        return self.POLL_INTERVAL
+
+    def poke(self) -> None:
+        """Wake the poller now (event-triggered check instead of waiting
+        out the cadence)."""
+        self._poke_event.set()
+
     def _loop(self) -> None:
         # first check runs inside the poller thread so a hung data source
         # can never wedge daemon startup (reference runs the initial Check in
         # the spawned goroutine, temperature/component.go:81-97)
         self.check()
-        while not self._stop_event.wait(self.POLL_INTERVAL):
+        while not self._stop_event.is_set():
+            self._poke_event.wait(self.poll_interval())
+            self._poke_event.clear()
+            if self._stop_event.is_set():
+                return
             self.check()
 
     def close(self) -> None:
         self._stop_event.set()
+        self._poke_event.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None

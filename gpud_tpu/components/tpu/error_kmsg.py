@@ -79,6 +79,14 @@ class TPUErrorKmsgComponent(Component):
     # -- event path --------------------------------------------------------
     def _on_event(self, ev: Event) -> None:
         _c_errors.inc(labels={"component": NAME, "error": ev.name})
+        # fabric-class matches open the ICI component's fast-poll window —
+        # the inotify kmsg path is ~ms, so sysfs confirmation starts now
+        # instead of at the next 60s tick (see ici.py raise_suspicion)
+        for listener in self.instance.fabric_suspicion_listeners:
+            try:
+                listener(ev.name)
+            except Exception:  # noqa: BLE001 — a listener bug must not
+                pass           # break error recording
         self._reevaluate()
 
     def start(self) -> None:

@@ -44,6 +44,18 @@ DEFAULT_CRC_DELTA_DEGRADED = 100    # CRC-errors delta in window before Degraded
 # opt-in: clear sticky flap state after this much clean uptime; 0 = sticky
 # until set-healthy (reference: flap_auto_clear_window.go)
 DEFAULT_AUTO_CLEAR_WINDOW = 0.0
+# Adaptive fast-poll: on suspicion (a fabric-class kmsg match arriving via
+# the ~ms inotify path, or a sample delta — state change / counter step /
+# link-set change) the poller drops to FAST_POLL_INTERVAL for
+# SUSPICION_WINDOW seconds, then decays back to the 60s cadence. Beats the
+# reference's fixed 60s IB poll (SURVEY §6) without raising steady-state
+# CPU: a healthy host never enters the window.
+DEFAULT_FAST_POLL_INTERVAL = 1.0
+DEFAULT_SUSPICION_WINDOW = 60.0
+# a counter-step trigger re-arms only after this cooldown — a continuously
+# rising CRC counter (Degraded-class, non-urgent) must not hold ~50% fast
+# duty by re-opening a window at every steady poll
+DEFAULT_COUNTER_RETRIGGER_COOLDOWN = 600.0
 
 
 class TPUICIComponent(PollingComponent):
@@ -66,6 +78,16 @@ class TPUICIComponent(PollingComponent):
         self.auto_clear_window = DEFAULT_AUTO_CLEAR_WINDOW
         self.time_now_fn = time.time
         self._last_purge = 0.0
+        # adaptive fast-poll state
+        self.fast_poll_interval = DEFAULT_FAST_POLL_INTERVAL
+        self.suspicion_window = DEFAULT_SUSPICION_WINDOW
+        self.counter_retrigger_cooldown = DEFAULT_COUNTER_RETRIGGER_COOLDOWN
+        self._suspicion_until = 0.0
+        self._counter_trigger_armed_at = 0.0
+        self._prev_sample: dict = {}
+        self._last_store_ts = 0.0
+        self._cached_scan: Optional[ScanResult] = None
+        instance.fabric_suspicion_listeners.append(self._on_fabric_kmsg)
         # explicit expected-link-count override (pushed via updateConfig);
         # 0 = derive from topology / observed high-water mark
         self.expected_links = 0
@@ -89,6 +111,47 @@ class TPUICIComponent(PollingComponent):
             and self.tpu.tpu_lib_exists()
             and self.tpu.ici_supported()
         )
+
+    # -- adaptive fast-poll ------------------------------------------------
+    def poll_interval(self) -> float:
+        if self.time_now_fn() < self._suspicion_until:
+            return self.fast_poll_interval
+        return self.POLL_INTERVAL
+
+    def raise_suspicion(self, reason: str = "") -> None:
+        """Open (or extend) the fast-poll window and wake the poller."""
+        self._suspicion_until = self.time_now_fn() + self.suspicion_window
+        self.poke()
+
+    def _on_fabric_kmsg(self, error_name: str) -> None:
+        # driver saw a fabric problem; confirm on sysfs immediately
+        # instead of waiting out the 60s cadence
+        if error_name.startswith("tpu_ici"):
+            self.raise_suspicion(error_name)
+
+    def _delta_kind(self, links) -> Optional[str]:
+        """Classify the change vs the previous sample: "state" (state or
+        link-set change) outranks "counter" (error-counter step)."""
+        cur = {
+            ln.name: (
+                ln.state,
+                ln.tx_errors + ln.rx_errors + ln.crc_errors + ln.replays,
+            )
+            for ln in links
+        }
+        prev, self._prev_sample = self._prev_sample, cur
+        if not prev:
+            return None
+        if set(prev) != set(cur):
+            return "state"
+        kind = None
+        for name, (state, errs) in cur.items():
+            p_state, p_errs = prev[name]
+            if state != p_state:
+                return "state"
+            if errs > p_errs:
+                kind = "counter"
+        return kind
 
     def _expected_links(self, reported: int) -> int:
         """Expected link count. Driver sysfs exposure can be partial
@@ -147,6 +210,21 @@ class TPUICIComponent(PollingComponent):
             )
         links = self.sampler.ici_links()
         now = self.time_now_fn()
+        delta = self._delta_kind(links)
+        if delta == "state":
+            # link state/set moved: hold the fast cadence until the window
+            # expires with no further state changes
+            self._suspicion_until = now + self.suspicion_window
+        elif (
+            delta == "counter"
+            and now >= self._suspicion_until
+            and now >= self._counter_trigger_armed_at
+        ):
+            # a counter step opens ONE window per cooldown — a steadily-
+            # rising CRC counter is a Degraded-class condition that must
+            # not pin the poller at (or near) 1 Hz forever
+            self._suspicion_until = now + self.suspicion_window
+            self._counter_trigger_armed_at = now + self.counter_retrigger_cooldown
 
         up = 0
         for ln in links:
@@ -161,17 +239,26 @@ class TPUICIComponent(PollingComponent):
 
         scan: Optional[ScanResult] = None
         if self.store is not None:
-            self.store.insert_snapshot(links, ts=now)
-            # purge at retention/5 cadence, not per poll (matches the
-            # eventstore purger; a per-poll DELETE would walk the table)
-            if now - self._last_purge >= self.store.retention_seconds / 5.0:
-                self.store.purge()
-                self._last_purge = now
-            scan = self.store.scan(self.scan_window)
+            # fast polls detect down-links directly from the sample; the
+            # history store keeps its steady 60s granularity (plus an
+            # immediate row on any delta so the transition is recorded) —
+            # a 1 Hz insert + 1h-window scan would be sustained disk/CPU
+            # load and ~60x row growth during every suspicion window
+            if delta is not None or now - self._last_store_ts >= self.POLL_INTERVAL:
+                self.store.insert_snapshot(links, ts=now)
+                self._last_store_ts = now
+                # purge at retention/5 cadence, not per poll (matches the
+                # eventstore purger; a per-poll DELETE would walk the table)
+                if now - self._last_purge >= self.store.retention_seconds / 5.0:
+                    self.store.purge()
+                    self._last_purge = now
+                self._cached_scan = self.store.scan(self.scan_window)
+            scan = self._cached_scan
 
         extra = {
             "links_up": str(up),
             "links_expected": str(expected),
+            "poll_mode": "fast" if now < self._suspicion_until else "steady",
         }
 
         # 1. links currently down → Unhealthy (sticky by construction: the
@@ -276,6 +363,15 @@ class TPUICIComponent(PollingComponent):
             for s in recent.links.values()
         )
 
+    def close(self) -> None:
+        # a discarded/deregistered component must not keep receiving
+        # fabric-suspicion callbacks through the long-lived TpudInstance
+        try:
+            self.instance.fabric_suspicion_listeners.remove(self._on_fabric_kmsg)
+        except ValueError:
+            pass
+        super().close()
+
     def events(self, since: float):
         if self._event_bucket is None:
             return []
@@ -290,6 +386,10 @@ class TPUICIComponent(PollingComponent):
         updateConfig override."""
         if self.store is not None:
             self.store.set_tombstone("*", ts=self.time_now_fn())
+            # the cached window scan predates the tombstone — drop it and
+            # force a fresh insert+scan so the re-check reflects the clear
+            self._cached_scan = None
+            self._last_store_ts = 0.0
         if self._event_bucket is not None:
             self._event_bucket.insert(
                 Event(

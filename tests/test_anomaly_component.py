@@ -75,6 +75,69 @@ def test_drifting_chip_goes_degraded_with_event(tmp_db):
     assert len([e for e in c.events(0) if e.name == "tpu_telemetry_anomaly"]) == 1
 
 
+def test_chip_with_intermittent_gauge_gaps_still_scores(tmp_db):
+    """Round-2 verdict Weak #5: one flaky gauge on one chip must not
+    shrink the fleet-wide window below min_samples. Chip 3's temperature
+    gauge reports only every other sweep; chip 2 drifts; forward-fill
+    alignment keeps all chips scored and the drift still detected."""
+    rows = _telemetry_rows(drift_chip=2)
+    rows = [
+        r
+        for r in rows
+        if not (
+            r[2]["chip"] == "3"
+            and r[1] == "tpud_tpu_temperature_celsius"
+            and (int(r[0]) // 60) % 2 == 0
+        )
+    ]
+    c = _component(tmp_db, rows)
+    chips, windows = c._build_windows(float(NOW))
+    assert "3" in chips  # gappy chip still present (forward-filled)
+    assert windows.shape[0] == 4
+    assert windows.shape[1] >= c.min_samples
+    cr = c.check()
+    assert cr.health == HealthStateType.DEGRADED
+    assert "chip 2" in cr.reason
+
+
+def test_chip_missing_entire_feature_skipped_alone(tmp_db):
+    """A chip that never reported one feature in-window is dropped by
+    itself; the rest of the fleet keeps scoring."""
+    rows = [
+        r
+        for r in _telemetry_rows(drift_chip=1)
+        if not (r[2]["chip"] == "0" and r[1] == "tpud_tpu_power_watts")
+    ]
+    c = _component(tmp_db, rows)
+    chips, windows = c._build_windows(float(NOW))
+    assert "0" not in chips
+    assert set(chips) == {"1", "2", "3"}
+    cr = c.check()
+    assert cr.health == HealthStateType.DEGRADED
+    assert "chip 1" in cr.reason
+
+
+def test_forward_fill_leading_gap_repeats_first_sample(tmp_db):
+    """A series starting late back-fills with its first sample instead of
+    fabricating zeros (a zero would read as a huge negative drift)."""
+    rows = [
+        r
+        for r in _telemetry_rows()
+        if not (
+            r[2]["chip"] == "1"
+            and r[1] == "tpud_tpu_duty_cycle_percent"
+            and r[0] < NOW - 20 * 60
+        )
+    ]
+    c = _component(tmp_db, rows)
+    chips, windows = c._build_windows(float(NOW))
+    i = chips.index("1")
+    f = list(FEATURE_METRICS).index("tpud_tpu_duty_cycle_percent")
+    first_real = windows[i, :, f][-1]  # series hovers ~50
+    assert abs(windows[i, 0, f] - 50.0) < 5.0, windows[i, 0, f]
+    assert abs(first_real - 50.0) < 5.0
+
+
 def test_warming_up_below_min_samples(tmp_db):
     c = _component(tmp_db, _telemetry_rows(n_sweeps=4))
     cr = c.check()

@@ -1,0 +1,221 @@
+"""Adaptive sysfs-ICI polling (round-2 verdict, item #7): suspicion —
+a fabric-class kmsg match via the ~ms inotify path, or a sample delta —
+opens a bounded fast-poll window; steady state stays on the 60s cadence."""
+
+import time
+
+from gpud_tpu.api.v1.types import Event, EventType, HealthStateType
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.components.tpu.ici import (
+    DEFAULT_FAST_POLL_INTERVAL,
+    DEFAULT_SUSPICION_WINDOW,
+    TPUICIComponent,
+)
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.tpu.instance import ICILinkSnapshot, LinkState, MockBackend
+
+
+def _component(tmp_db, clock):
+    inst = TpudInstance(
+        tpu_instance=MockBackend(accelerator_type="v5e-4"),
+        db_rw=tmp_db,
+        event_store=EventStore(tmp_db),
+    )
+    c = TPUICIComponent(inst)
+    c.time_now_fn = lambda: clock[0]
+    if c.store is not None:
+        c.store.time_now_fn = lambda: clock[0]
+    c.sampler.ttl = 0.0
+    return c, inst
+
+
+def test_steady_state_uses_production_cadence(tmp_db):
+    clock = [1000.0]
+    c, _ = _component(tmp_db, clock)
+    assert c.poll_interval() == c.POLL_INTERVAL == 60.0
+
+
+def test_suspicion_opens_fast_window_and_decays(tmp_db):
+    clock = [1000.0]
+    c, _ = _component(tmp_db, clock)
+    c.raise_suspicion("tpu_ici_link_down")
+    assert c.poll_interval() == DEFAULT_FAST_POLL_INTERVAL
+    clock[0] += DEFAULT_SUSPICION_WINDOW - 1
+    assert c.poll_interval() == DEFAULT_FAST_POLL_INTERVAL
+    clock[0] += 2  # window expired with no further deltas → decay
+    assert c.poll_interval() == c.POLL_INTERVAL
+
+
+def test_sample_delta_extends_window(tmp_db):
+    clock = [1000.0]
+    c, _ = _component(tmp_db, clock)
+    c.check_once()  # baseline sample
+    assert c.poll_interval() == c.POLL_INTERVAL  # first sample: no delta
+    # a link goes down between polls → the next check flags the delta
+    c.tpu._down_links.add("chip1/ici2")
+    clock[0] += 60
+    r = c.check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    assert r.extra_info["poll_mode"] == "fast"  # window opened on this poll
+    assert c.poll_interval() == DEFAULT_FAST_POLL_INTERVAL
+    # still down but no NEW delta: window expires, cadence decays while
+    # the sticky unhealthy state persists
+    clock[0] += DEFAULT_SUSPICION_WINDOW + 1
+    r2 = c.check_once()
+    assert r2.health == HealthStateType.UNHEALTHY
+    assert c.poll_interval() == c.POLL_INTERVAL
+
+
+def test_counter_step_is_suspicious(tmp_db):
+    clock = [1000.0]
+    c, _ = _component(tmp_db, clock)
+
+    links = [ICILinkSnapshot(chip_id=0, link_id=0, state=LinkState.UP)]
+    c.sampler.ici_links = lambda: [
+        ICILinkSnapshot(
+            chip_id=0, link_id=0, state=LinkState.UP, crc_errors=links[0].crc_errors
+        )
+    ]
+    c.check_once()
+    assert c.poll_interval() == c.POLL_INTERVAL
+    links[0].crc_errors += 5
+    clock[0] += 60
+    c.check_once()
+    assert c.poll_interval() == DEFAULT_FAST_POLL_INTERVAL
+
+
+def test_fabric_kmsg_listener_wiring(tmp_db):
+    clock = [1000.0]
+    c, inst = _component(tmp_db, clock)
+    assert c._on_fabric_kmsg in inst.fabric_suspicion_listeners
+    for listener in inst.fabric_suspicion_listeners:
+        listener("tpu_ici_link_down")
+    assert c.poll_interval() == DEFAULT_FAST_POLL_INTERVAL
+
+
+def test_non_fabric_kmsg_does_not_trigger(tmp_db):
+    clock = [1000.0]
+    c, inst = _component(tmp_db, clock)
+    for listener in inst.fabric_suspicion_listeners:
+        listener("tpu_hbm_ecc_uncorrectable")
+    assert c.poll_interval() == c.POLL_INTERVAL
+
+
+def test_error_kmsg_event_opens_ici_fast_window(tmp_db):
+    """End-to-end wiring: an ICI-class event recorded by the error-kmsg
+    component opens the ICI component's fast window through the shared
+    TpudInstance listener list."""
+    from gpud_tpu.components.tpu.error_kmsg import TPUErrorKmsgComponent
+
+    clock = [1000.0]
+    c, inst = _component(tmp_db, clock)
+    ek = TPUErrorKmsgComponent(inst)
+    ek._on_event(
+        Event(
+            component=ek.NAME,
+            name="tpu_ici_link_down",
+            type=EventType.CRITICAL,
+            message="ICI link 3 down on chip 1",
+        )
+    )
+    assert c.poll_interval() == DEFAULT_FAST_POLL_INTERVAL
+
+
+def test_counter_step_retrigger_respects_cooldown(tmp_db):
+    """A continuously rising counter opens ONE window per cooldown — it
+    must not hold the poller at (or near) 1 Hz indefinitely."""
+    clock = [1000.0]
+    c, _ = _component(tmp_db, clock)
+    crc = [0]
+    c.sampler.ici_links = lambda: [
+        ICILinkSnapshot(chip_id=0, link_id=0, state=LinkState.UP, crc_errors=crc[0])
+    ]
+    c.check_once()  # baseline
+    crc[0] += 1
+    clock[0] += 60
+    c.check_once()
+    assert c.poll_interval() == DEFAULT_FAST_POLL_INTERVAL  # window opened
+    # window expires; counter keeps rising at every steady poll — within
+    # the cooldown no new window opens
+    clock[0] += DEFAULT_SUSPICION_WINDOW + 1
+    crc[0] += 1
+    c.check_once()
+    assert c.poll_interval() == c.POLL_INTERVAL
+    # after the cooldown the trigger re-arms
+    clock[0] += c.counter_retrigger_cooldown + 1
+    crc[0] += 1
+    c.check_once()
+    assert c.poll_interval() == DEFAULT_FAST_POLL_INTERVAL
+
+
+def test_fast_polls_throttle_store_writes(tmp_db):
+    """1 Hz fast polls must not insert a history row per poll — steady
+    60s granularity plus one immediate row per delta."""
+    clock = [1000.0]
+    c, _ = _component(tmp_db, clock)
+    c.check_once()  # baseline insert (first poll always writes)
+    c.raise_suspicion("tpu_ici_link_down")
+    rows0 = tmp_db.query("SELECT COUNT(*) FROM tpud_ici_snapshots_v0_1")[0][0]
+    for _ in range(10):  # ten fast polls, nothing changing
+        clock[0] += 1
+        c.check_once()
+    rows1 = tmp_db.query("SELECT COUNT(*) FROM tpud_ici_snapshots_v0_1")[0][0]
+    assert rows1 == rows0  # no per-fast-poll inserts
+    clock[0] += 60  # steady cadence elapsed → one more row
+    c.check_once()
+    rows2 = tmp_db.query("SELECT COUNT(*) FROM tpud_ici_snapshots_v0_1")[0][0]
+    assert rows2 > rows1
+
+
+def test_set_healthy_invalidates_cached_scan(tmp_db):
+    """set_healthy tombstones history; the cached window scan must not
+    keep the sticky flap alive past the operator clear."""
+    clock = [1000.0]
+    c, _ = _component(tmp_db, clock)
+    c.check_once()
+    # drop + recover = flap (sticky)
+    c.tpu._down_links.add("chip0/ici0")
+    clock[0] += 60
+    c.check_once()
+    c.tpu._down_links.clear()
+    clock[0] += 60
+    r = c.check_once()
+    assert r.health != HealthStateType.HEALTHY  # sticky flap
+    c.set_healthy()
+    assert c.last_health_states()[0].health == HealthStateType.HEALTHY
+
+
+def test_close_removes_fabric_listener(tmp_db):
+    clock = [1000.0]
+    c, inst = _component(tmp_db, clock)
+    assert c._on_fabric_kmsg in inst.fabric_suspicion_listeners
+    c.close()
+    assert c._on_fabric_kmsg not in inst.fabric_suspicion_listeners
+
+
+def test_poke_wakes_poller_immediately(tmp_db):
+    """raise_suspicion must not wait out a sleeping 60s poller."""
+    clock = [1000.0]
+    c, _ = _component(tmp_db, clock)
+    c.time_now_fn = time.time  # real clock for the live poller
+    checks = []
+    orig = c.check_once
+
+    def counted():
+        checks.append(time.time())
+        return orig()
+
+    c.check_once = counted
+    c.start()
+    try:
+        deadline = time.time() + 5
+        while not checks and time.time() < deadline:
+            time.sleep(0.01)
+        n0 = len(checks)
+        c.raise_suspicion("tpu_ici_link_down")
+        deadline = time.time() + 3
+        while len(checks) <= n0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(checks) > n0, "poke did not wake the sleeping poller"
+    finally:
+        c.close()
