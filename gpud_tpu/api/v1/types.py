@@ -480,6 +480,8 @@ class NICInfo:
     addresses: List[str] = field(default_factory=list)
     mtu: int = 0
     speed_mbps: int = 0
+    driver: str = ""       # kernel driver bound to the device (gve, virtio_net, ...)
+    virtual: bool = False  # no backing device in /sys/class/net/<nic>/device
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -488,6 +490,8 @@ class NICInfo:
             "addresses": list(self.addresses),
             "mtu": self.mtu,
             "speed_mbps": self.speed_mbps,
+            "driver": self.driver,
+            "virtual": self.virtual,
         }
 
     @classmethod
@@ -498,6 +502,59 @@ class NICInfo:
             addresses=list(d.get("addresses", []) or []),
             mtu=int(d.get("mtu", 0)),
             speed_mbps=int(d.get("speed_mbps", 0)),
+            driver=d.get("driver", ""),
+            virtual=bool(d.get("virtual", False)),
+        )
+
+
+@dataclass
+class BlockDeviceInfo:
+    """One node of the block-device tree (reference:
+    pkg/machine-info/machine_info.go:45-434 builds the lsblk-style
+    disk tree; here it is read from /sys/block directly)."""
+
+    name: str = ""
+    type: str = "disk"          # disk | part
+    size_bytes: int = 0
+    model: str = ""
+    rotational: bool = False
+    removable: bool = False
+    mount_point: str = ""
+    fstype: str = ""
+    used_bytes: int = 0
+    children: List["BlockDeviceInfo"] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "type": self.type,
+            "size_bytes": self.size_bytes,
+            "model": self.model,
+            "rotational": self.rotational,
+            "removable": self.removable,
+            "mount_point": self.mount_point,
+            "fstype": self.fstype,
+            "used_bytes": self.used_bytes,
+        }
+        if self.children:
+            d["children"] = [c.to_dict() for c in self.children]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "BlockDeviceInfo":
+        return cls(
+            name=d.get("name", ""),
+            type=d.get("type", "disk"),
+            size_bytes=int(d.get("size_bytes", 0)),
+            model=d.get("model", ""),
+            rotational=bool(d.get("rotational", False)),
+            removable=bool(d.get("removable", False)),
+            mount_point=d.get("mount_point", ""),
+            fstype=d.get("fstype", ""),
+            used_bytes=int(d.get("used_bytes", 0)),
+            children=[
+                cls.from_dict(c) for c in d.get("children", []) or []
+            ],
         )
 
 
@@ -521,9 +578,11 @@ class MachineInfo:
     public_ip: str = ""
     private_ip: str = ""
     tpud_version: str = ""
+    containerized: bool = False
     tpu_info: Optional[TPUInfo] = None
     disks: List[DiskInfo] = field(default_factory=list)
     nics: List[NICInfo] = field(default_factory=list)
+    block_devices: List[BlockDeviceInfo] = field(default_factory=list)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -542,8 +601,10 @@ class MachineInfo:
             "public_ip": self.public_ip,
             "private_ip": self.private_ip,
             "tpud_version": self.tpud_version,
+            "containerized": self.containerized,
             "disks": [x.to_dict() for x in self.disks],
             "nics": [x.to_dict() for x in self.nics],
+            "block_devices": [x.to_dict() for x in self.block_devices],
         }
         if self.tpu_info is not None:
             d["tpu_info"] = self.tpu_info.to_dict()
@@ -567,9 +628,14 @@ class MachineInfo:
             public_ip=d.get("public_ip", ""),
             private_ip=d.get("private_ip", ""),
             tpud_version=d.get("tpud_version", ""),
+            containerized=bool(d.get("containerized", False)),
             tpu_info=TPUInfo.from_dict(d.get("tpu_info")),
             disks=[DiskInfo.from_dict(x) for x in d.get("disks", []) or []],
             nics=[NICInfo.from_dict(x) for x in d.get("nics", []) or []],
+            block_devices=[
+                BlockDeviceInfo.from_dict(x)
+                for x in d.get("block_devices", []) or []
+            ],
         )
 
 

@@ -244,7 +244,9 @@ class TPUICIComponent(PollingComponent):
             # immediate row on any delta so the transition is recorded) —
             # a 1 Hz insert + 1h-window scan would be sustained disk/CPU
             # load and ~60x row growth during every suspicion window
-            if delta is not None or now - self._last_store_ts >= self.POLL_INTERVAL:
+            # counter deltas recur on every fast poll of a noisy link —
+            # only STATE transitions warrant an off-cadence row
+            if delta == "state" or now - self._last_store_ts >= self.POLL_INTERVAL:
                 self.store.insert_snapshot(links, ts=now)
                 self._last_store_ts = now
                 # purge at retention/5 cadence, not per poll (matches the

@@ -8,6 +8,7 @@ topology (SURVEY §5.8).
 
 from __future__ import annotations
 
+import os
 import socket
 from typing import Optional
 
@@ -21,8 +22,21 @@ from gpud_tpu.api.v1.types import (
     TPUChipInfo,
     TPUInfo,
 )
+from gpud_tpu.blockdev import detect_containerized, read_block_tree
 from gpud_tpu.tpu.instance import TPUInstance
 from gpud_tpu.version import __version__
+
+
+def _nic_driver(name: str, sys_class_net: str = "/sys/class/net") -> tuple:
+    """(driver, virtual): driver symlink basename; virtual when the NIC
+    has no backing device (veth/bridge/tun)."""
+    dev = os.path.join(sys_class_net, name, "device")
+    if not os.path.exists(dev):
+        return "", True
+    try:
+        return os.path.basename(os.readlink(os.path.join(dev, "driver"))), False
+    except OSError:
+        return "", False
 
 
 def _cpu_model() -> str:
@@ -106,6 +120,7 @@ def get_machine_info(
                 elif a.family in (socket.AF_INET, socket.AF_INET6):
                     ips.append(a.address)
             st = stats.get(name)
+            driver, virtual = _nic_driver(name)
             nics.append(
                 NICInfo(
                     name=name,
@@ -113,6 +128,8 @@ def get_machine_info(
                     addresses=ips,
                     mtu=st.mtu if st else 0,
                     speed_mbps=st.speed if st else 0,
+                    driver=driver,
+                    virtual=virtual,
                 )
             )
     except OSError:
@@ -133,7 +150,9 @@ def get_machine_info(
         public_ip=public_ip,
         private_ip=private_ip,
         tpud_version=__version__,
+        containerized=detect_containerized(),
         tpu_info=get_tpu_info(tpu),
         disks=disks,
         nics=nics,
+        block_devices=read_block_tree(),
     )

@@ -167,6 +167,29 @@ def test_fast_polls_throttle_store_writes(tmp_db):
     assert rows2 > rows1
 
 
+def test_noisy_counter_fast_polls_do_not_write_per_poll(tmp_db):
+    """A counter rising on EVERY fast poll (noisy link) must not turn the
+    fast window into 1 Hz inserts + scans — only state transitions earn
+    an off-cadence row."""
+    clock = [1000.0]
+    c, _ = _component(tmp_db, clock)
+    crc = [0]
+    c.sampler.ici_links = lambda: [
+        ICILinkSnapshot(chip_id=0, link_id=0, state=LinkState.UP, crc_errors=crc[0])
+    ]
+    c.check_once()  # baseline insert
+    crc[0] += 1
+    clock[0] += 60
+    c.check_once()  # opens the window (also inserts: steady cadence hit)
+    rows0 = tmp_db.query("SELECT COUNT(*) FROM tpud_ici_snapshots_v0_1")[0][0]
+    for _ in range(10):  # counter keeps stepping during fast polls
+        crc[0] += 1
+        clock[0] += 1
+        c.check_once()
+    rows1 = tmp_db.query("SELECT COUNT(*) FROM tpud_ici_snapshots_v0_1")[0][0]
+    assert rows1 == rows0
+
+
 def test_set_healthy_invalidates_cached_scan(tmp_db):
     """set_healthy tombstones history; the cached window scan must not
     keep the sticky flap alive past the operator clear."""

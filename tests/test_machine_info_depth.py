@@ -1,0 +1,141 @@
+"""MachineInfo depth (round-2 verdict, item #8): block-device tree from
+/sys/block, NIC driver/virtual metadata, container awareness — reference:
+pkg/machine-info/machine_info.go:45-434."""
+
+import os
+
+from gpud_tpu.api.v1.types import BlockDeviceInfo, MachineInfo
+from gpud_tpu.blockdev import detect_containerized, read_block_tree, read_mounts
+from gpud_tpu.machine_info import _nic_driver, get_machine_info
+from gpud_tpu.tpu.instance import MockBackend
+
+
+def _write(path, content):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        f.write(content)
+
+
+def _block_fixture(tmp_path):
+    b = tmp_path / "sys" / "block"
+    # a 100 GiB boot disk with two partitions
+    _write(str(b / "sda" / "size"), str(100 * (1 << 30) // 512))
+    _write(str(b / "sda" / "removable"), "0")
+    _write(str(b / "sda" / "queue" / "rotational"), "0")
+    _write(str(b / "sda" / "device" / "model"), "PersistentDisk")
+    _write(str(b / "sda" / "sda1" / "size"), str(99 * (1 << 30) // 512))
+    _write(str(b / "sda" / "sda1" / "partition"), "1")
+    _write(str(b / "sda" / "sda15" / "size"), str((1 << 30) // 512))
+    _write(str(b / "sda" / "sda15" / "partition"), "15")
+    # loop devices are noise
+    _write(str(b / "loop0" / "size"), "1024")
+    # an unpartitioned scratch NVMe
+    _write(str(b / "nvme0n1" / "size"), str(375 * (1 << 30) // 512))
+    _write(str(b / "nvme0n1" / "queue" / "rotational"), "0")
+    _write(str(b / "nvme0n1" / "device" / "model"), "nvme_card")
+    mounts = tmp_path / "proc" / "mounts"
+    _write(
+        str(mounts),
+        "/dev/sda1 / ext4 rw,relatime 0 0\n"
+        "/dev/sda1 /snap squashfs ro 0 0\n"   # dup: first mount wins
+        "proc /proc proc rw 0 0\n",
+    )
+    return str(b), str(mounts)
+
+
+def test_block_tree_shape_and_mounts(tmp_path):
+    root, mounts = _block_fixture(tmp_path)
+    tree = read_block_tree(sys_block_root=root, proc_mounts=mounts)
+    names = [d.name for d in tree]
+    assert names == ["nvme0n1", "sda"]  # loop skipped, sorted
+    sda = tree[1]
+    assert sda.size_bytes == 100 * (1 << 30)
+    assert sda.model == "PersistentDisk"
+    assert not sda.rotational
+    assert [c.name for c in sda.children] == ["sda1", "sda15"]
+    p1 = sda.children[0]
+    assert p1.type == "part"
+    assert p1.mount_point == "/" and p1.fstype == "ext4"
+    assert p1.used_bytes > 0  # statvfs of the real root
+    assert tree[0].model == "nvme_card" and tree[0].children == []
+
+
+def test_block_tree_host_root_prefix(tmp_path):
+    _block_fixture(tmp_path)
+    tree = read_block_tree(host_root=str(tmp_path))
+    assert {d.name for d in tree} == {"sda", "nvme0n1"}
+
+
+def test_read_mounts_octal_escapes(tmp_path):
+    p = tmp_path / "mounts"
+    p.write_text("/dev/sdb1 /mnt/my\\040disk ext4 rw 0 0\n")
+    m = read_mounts(str(p))
+    assert m["sdb1"][0] == "/mnt/my disk"
+
+
+def test_read_mounts_non_ascii_preserved(tmp_path):
+    # only fstab octal escapes may be expanded — a blanket unicode_escape
+    # would mojibake UTF-8 mount points
+    p = tmp_path / "mounts"
+    p.write_text("/dev/sdb1 /mnt/café ext4 rw 0 0\n", encoding="utf-8")
+    m = read_mounts(str(p))
+    assert m["sdb1"][0] == "/mnt/café"
+
+
+def test_host_root_stats_host_path_not_container_path(tmp_path):
+    # containerized: the host's /proc/mounts says /dev/sda1 is at
+    # /hostdata — statvfs must hit <host_root>/hostdata (bind-mounted),
+    # not the container's own /hostdata (which does not exist)
+    b = tmp_path / "sys" / "block"
+    _write(str(b / "sda" / "size"), str((1 << 30) // 512))
+    _write(str(b / "sda" / "sda1" / "size"), str((1 << 30) // 512))
+    _write(str(b / "sda" / "sda1" / "partition"), "1")
+    (tmp_path / "hostdata").mkdir()
+    _write(str(tmp_path / "proc" / "mounts"), "/dev/sda1 /hostdata ext4 rw 0 0\n")
+    assert not os.path.exists("/hostdata")
+    tree = read_block_tree(host_root=str(tmp_path))
+    p1 = tree[0].children[0]
+    assert p1.mount_point == "/hostdata"
+    assert p1.used_bytes > 0  # statvfs of <host_root>/hostdata succeeded
+
+
+def test_block_device_info_roundtrip():
+    node = BlockDeviceInfo(
+        name="sda", size_bytes=10, model="m",
+        children=[BlockDeviceInfo(name="sda1", type="part", mount_point="/")],
+    )
+    again = BlockDeviceInfo.from_dict(node.to_dict())
+    assert again.children[0].mount_point == "/"
+    assert again.name == "sda"
+
+
+def test_nic_driver_fixture(tmp_path):
+    net = tmp_path / "net"
+    # physical NIC with a driver
+    (net / "eth0" / "device").mkdir(parents=True)
+    os.symlink("../../../bus/pci/drivers/gve", str(net / "eth0" / "device" / "driver"))
+    # virtual NIC: no device dir
+    (net / "docker0").mkdir(parents=True)
+    drv, virt = _nic_driver("eth0", sys_class_net=str(net))
+    assert drv == "gve" and not virt
+    drv, virt = _nic_driver("docker0", sys_class_net=str(net))
+    assert drv == "" and virt
+
+
+def test_detect_containerized_marker(tmp_path):
+    # the .dockerenv marker alone is sufficient (PID-1 cgroup detection is
+    # environment-dependent and not asserted here)
+    (tmp_path / ".dockerenv").write_text("")
+    assert detect_containerized(host_root=str(tmp_path))
+
+
+def test_machine_info_integration_serializes():
+    mi = get_machine_info(tpu=MockBackend())
+    d = mi.to_dict()
+    assert "block_devices" in d and "containerized" in d
+    for nic in d["nics"]:
+        assert "driver" in nic and "virtual" in nic
+    # wire roundtrip preserves the new fields
+    again = MachineInfo.from_dict(d)
+    assert again.containerized == mi.containerized
+    assert len(again.block_devices) == len(mi.block_devices)
