@@ -18,6 +18,10 @@ from typing import Any, Dict, List, Optional
 
 import yaml
 
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
 
 class PluginType:
     INIT = "init"
@@ -146,6 +150,17 @@ class PluginSpec:
             return "at least one step required"
         if self.plugin_type == PluginType.COMPONENT_LIST and not self.component_list:
             return "component_list plugins need a component_list"
+        for rule in self.parser.match_rules:
+            # a broken rule regex must be rejected here, at push time —
+            # not explode inside the poller at 3am. An EMPTY regex matches
+            # everything (a typoed YAML key silently defaults to "") and
+            # would fire the rule on every poll — equally rejected.
+            if not rule.regex:
+                return "match rule with empty regex (typoed 'regex:' key?)"
+            try:
+                re.compile(rule.regex)
+            except re.error as e:
+                return f"invalid match-rule regex {rule.regex!r}: {e}"
         for s in self.steps:
             if not s.resolved_script().strip():
                 return f"step {s.name!r} has an empty script"
@@ -186,26 +201,41 @@ class PluginSpec:
         )
 
 
-def specs_from_list(items: List[Dict[str, Any]]) -> List[PluginSpec]:
-    specs = [PluginSpec.from_dict(d) for d in items]
+def specs_from_list(
+    items: List[Dict[str, Any]], on_invalid: str = "raise"
+) -> List[PluginSpec]:
+    """``on_invalid="raise"`` is the push-time contract (setPluginSpecs
+    rejects the whole batch); ``"skip"`` is boot-time leniency — an older
+    or hand-edited plugins.yaml with one bad spec must degrade that
+    plugin, not crash-loop the daemon (same rationale as the built-in
+    name-clash skip in server.py)."""
+    out: List[PluginSpec] = []
     names = set()
-    for s in specs:
-        err = s.validate()
-        if err:
-            raise ValueError(f"plugin {s.name!r}: {err}")
-        if s.name in names:
-            raise ValueError(f"duplicate plugin name {s.name!r}")
+    for d in items:
+        try:
+            s = PluginSpec.from_dict(d)
+            err = s.validate()
+            if err:
+                raise ValueError(f"plugin {s.name!r}: {err}")
+            if s.name in names:
+                raise ValueError(f"duplicate plugin name {s.name!r}")
+        except (ValueError, KeyError):
+            if on_invalid == "skip":
+                logger.error("skipping invalid plugin spec: %r", d)
+                continue
+            raise
         names.add(s.name)
-    return specs
+        out.append(s)
+    return out
 
 
-def load_specs(path: str) -> List[PluginSpec]:
+def load_specs(path: str, on_invalid: str = "raise") -> List[PluginSpec]:
     """Reference: pkg/custom-plugins/spec.go:52 LoadSpecs."""
     with open(path, "r", encoding="utf-8") as f:
         data = yaml.safe_load(f) or []
     if not isinstance(data, list):
         raise ValueError("plugin specs file must contain a YAML list")
-    return specs_from_list(data)
+    return specs_from_list(data, on_invalid=on_invalid)
 
 
 def save_specs(path: str, specs: List[PluginSpec]) -> None:

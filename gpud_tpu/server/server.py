@@ -128,7 +128,10 @@ class Server:
             )
             from gpud_tpu.plugins.spec import load_specs
 
-            self.plugin_specs = load_specs(specs_file)
+            # boot-time leniency: one bad spec in a hand-edited or legacy
+            # plugins.yaml degrades that plugin (skip+log), never
+            # crash-loops the daemon; dispatch stays strict at push time
+            self.plugin_specs = load_specs(specs_file, on_invalid="skip")
             init_err = run_init_plugins(self.tpud_instance, self.plugin_specs)
             if init_err:
                 raise RuntimeError(init_err)  # fail boot (reference: 343-387)

@@ -1,0 +1,293 @@
+"""Plugin-engine edge cases (round-2 verdict, item #3: "plugin-engine
+edge cases (bad JSONPath, step timeout, exit-code contract)").
+
+Reference behavior being mirrored: pkg/custom-plugins — bash steps with
+an exit-code contract, JSONPath extraction with match rules, auto/manual
+run modes, and a spec schema that rejects malformed input before it can
+crash a poller at 3am.
+"""
+
+import json
+
+import pytest
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.plugins.component import PluginComponent, build_components
+from gpud_tpu.plugins.spec import (
+    MatchRule,
+    OutputParser,
+    PluginSpec,
+    PluginStep,
+    extract_path,
+    specs_from_list,
+)
+
+
+def _spec(script, parser=None, timeout=10.0, name="edge", **kw):
+    return PluginSpec(
+        name=name,
+        steps=[PluginStep(name="s1", script=script)],
+        parser=parser or OutputParser(),
+        timeout_seconds=timeout,
+        **kw,
+    )
+
+
+def _component(spec):
+    return PluginComponent(TpudInstance(), spec)
+
+
+# -- extract_path hostility -------------------------------------------------
+
+@pytest.mark.parametrize(
+    "doc,path,expected",
+    [
+        ({"a": {"b": 1}}, "$.a.b", 1),
+        ({"a": [{"b": "x"}]}, "$.a[0].b", "x"),
+        ({"a": [1, 2]}, "$.a[5]", None),          # index out of range
+        ({"a": {"b": 1}}, "$.a.c", None),          # missing key
+        ({"a": 1}, "$.a.b.c", None),               # descend through scalar
+        ([1, 2], "$[1]", 2),
+        ({"a": 1}, "", None),                      # empty path
+        ({"a": 1}, "$", {"a": 1}),                 # whole document
+        ({"a": {"b": None}}, "$.a.b", None),       # legit null is None too
+        # keys outside the \w token grammar are unaddressable — documented
+        # limitation of the dot-path subset, not an error
+        ({"we,ird": 1}, "$.we,ird", None),
+    ],
+)
+def test_extract_path_matrix(doc, path, expected):
+    assert extract_path(doc, path) == expected
+
+
+def test_extract_path_never_raises_on_junk():
+    for path in ("$..", "$[x]", "$.a[", "][", "$.a[999999999999]", "$[-1]"):
+        extract_path({"a": [1]}, path)  # contract: no exception
+
+
+# -- parser edge cases ------------------------------------------------------
+
+def test_bad_json_path_field_degrades_to_healthy():
+    """A json_path that matches nothing extracts nothing; a rule bound to
+    that field can then never fire — the plugin reports Healthy, it does
+    not crash or false-positive."""
+    parser = OutputParser(
+        json_paths={"v": "$.does.not.exist"},
+        match_rules=[MatchRule(regex="bad", field="v", health="Unhealthy")],
+    )
+    c = _component(_spec("echo '{\"ok\": 1}'", parser))
+    r = c.check_once()
+    assert r.health == HealthStateType.HEALTHY
+    assert "v" not in r.extra_info
+
+
+def test_non_json_output_with_json_paths():
+    parser = OutputParser(
+        json_paths={"v": "$.x"},
+        match_rules=[MatchRule(regex="boom", health="Unhealthy")],  # raw rule
+    )
+    c = _component(_spec("echo 'plain text boom'", parser))
+    r = c.check_once()
+    # extraction found no JSON; the raw-output rule still applies
+    assert r.health == HealthStateType.UNHEALTHY
+
+
+def test_multiple_json_docs_in_output():
+    # the parser must find a JSON document inside surrounding log noise
+    script = "echo 'log line'; echo '{\"score\": 7}'; echo 'trailer'"
+    parser = OutputParser(
+        json_paths={"score": "$.score"},
+        match_rules=[MatchRule(regex="7", field="score", health="Degraded")],
+    )
+    r = _component(_spec(script, parser)).check_once()
+    assert r.health == HealthStateType.DEGRADED
+    assert r.extra_info["score"] == "7"
+
+
+def test_extracted_non_string_values_serialized():
+    parser = OutputParser(json_paths={"obj": "$.a", "num": "$.n"})
+    r = _component(
+        _spec("echo '{\"a\": {\"b\": 1}, \"n\": 3.5}'", parser)
+    ).check_once()
+    assert json.loads(r.extra_info["obj"]) == {"b": 1}
+    assert r.extra_info["num"] == "3.5"
+
+
+def test_invalid_rule_regex_rejected_at_validate_time():
+    # a broken regex must fail spec validation (push-time), not explode
+    # inside the poller at runtime
+    spec = _spec(
+        "echo hi",
+        OutputParser(match_rules=[MatchRule(regex="([unclosed", health="Unhealthy")]),
+    )
+    err = spec.validate()
+    assert err is not None and "regex" in err
+
+
+# -- exit-code / timeout contract ------------------------------------------
+
+def test_exit_code_contract_first_failing_step_wins(tmp_path):
+    sentinel = tmp_path / "plugin-never"
+    spec = PluginSpec(
+        name="multi",
+        steps=[
+            PluginStep(name="ok", script="echo first"),
+            PluginStep(name="fail", script="echo second; exit 3"),
+            PluginStep(name="never", script=f"echo third > {sentinel}"),
+        ],
+    )
+    r = _component(spec).check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    assert "exited 3" in r.reason
+    assert "second" in r.raw_output
+    assert not sentinel.exists()  # later steps skipped
+
+
+def test_timeout_kills_step_and_reports():
+    r = _component(_spec("sleep 30", timeout=0.2)).check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    assert "timed out" in r.reason
+
+
+def test_exit_zero_with_unhealthy_match_rule_is_unhealthy():
+    # the reference's contract: exit code 0 + a matching unhealthy rule
+    # still flags (rules outrank exit codes on success)
+    parser = OutputParser(
+        match_rules=[MatchRule(regex="ERROR", health="Unhealthy")]
+    )
+    r = _component(_spec("echo 'ERROR: disk'; exit 0", parser)).check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+
+
+def test_suggested_actions_from_match_rule():
+    parser = OutputParser(
+        match_rules=[
+            MatchRule(
+                regex="REBOOT_ME",
+                health="Unhealthy",
+                suggested_actions=["REBOOT_SYSTEM"],
+                description="plugin wants a reboot",
+            )
+        ]
+    )
+    r = _component(_spec("echo REBOOT_ME", parser)).check_once()
+    assert r.suggested_actions is not None
+    assert r.suggested_actions.repair_actions == ["REBOOT_SYSTEM"]
+
+
+# -- spec schema hostility --------------------------------------------------
+
+@pytest.mark.parametrize(
+    "raw",
+    [
+        [{"name": "x"}],                                  # no steps
+        [{"name": "x", "steps": "not-a-list"}],           # steps wrong type
+        [{"name": "bad name!", "steps": [{"script": "e"}]}],  # invalid chars
+        [{"name": "x", "steps": [{"name": "s"}]}],        # empty script
+        [{"name": "x", "steps": [{"script": "e"}], "plugin_type": "exotic"}],
+        [{"name": "x", "steps": [{"script": "e"}], "run_mode": "sometimes"}],
+        [{"name": "x", "steps": [{"script": "e"}], "interval_seconds": 0.01}],
+        [
+            {
+                "name": "x",
+                "plugin_type": "component_list",
+                "steps": [{"script": "e"}],
+            }
+        ],  # component_list without a list
+    ],
+)
+def test_malformed_specs_rejected(raw):
+    with pytest.raises((ValueError, KeyError)):
+        specs = specs_from_list(raw)
+        for s in specs:
+            err = s.validate()
+            if err:
+                raise ValueError(err)
+
+
+def test_component_list_builds_one_component_per_item():
+    spec = PluginSpec(
+        name="fleet",
+        plugin_type="component_list",
+        component_list=["a", "b"],
+        steps=[PluginStep(name="s", script="echo $TPUD_PLUGIN_ITEM")],
+    )
+    comps = build_components(TpudInstance(), [spec])
+    names = sorted(c.NAME for c in comps)
+    assert names == ["fleet.a", "fleet.b"]
+    r = comps[0].check_once()
+    assert comps[0].item in r.raw_output
+
+
+def test_manual_mode_component_does_not_poll():
+    c = _component(_spec("echo hi", run_mode="manual"))
+    c.start()
+    try:
+        assert c._thread is None  # no poller spawned
+    finally:
+        c.close()
+    # but an explicit trigger works
+    r = c.check_once()
+    assert r.health == HealthStateType.HEALTHY
+
+
+def test_env_carries_plugin_identity():
+    r = _component(_spec("echo name=$TPUD_PLUGIN_NAME")).check_once()
+    assert "name=edge" in r.raw_output
+
+
+def test_empty_regex_rejected_at_validate():
+    # a typoed YAML key defaults regex to "" which matches everything —
+    # rejected at push time, not left firing on every poll
+    spec = _spec("echo hi", OutputParser(match_rules=[MatchRule(regex="")]))
+    err = spec.validate()
+    assert err is not None and "empty regex" in err
+
+
+def test_boot_leniency_skips_bad_spec_keeps_good(tmp_path):
+    """A legacy/hand-edited plugins.yaml with one invalid spec must
+    degrade that plugin only — the daemon boots and serves the good one
+    (push-time stays strict; see specs_from_list on_invalid)."""
+    import yaml as _yaml
+
+    from gpud_tpu.plugins.spec import load_specs
+
+    path = tmp_path / "plugins.yaml"
+    path.write_text(
+        _yaml.safe_dump(
+            [
+                {"name": "good", "steps": [{"name": "s", "script": "echo ok"}]},
+                {"name": "bad!", "steps": [{"name": "s", "script": "echo no"}]},
+                {
+                    "name": "badregex",
+                    "steps": [{"name": "s", "script": "echo no"}],
+                    "parser": {"match_rules": [{"regex": "([unclosed"}]},
+                },
+            ]
+        )
+    )
+    # strict (push-time) raises
+    with pytest.raises(ValueError):
+        load_specs(str(path))
+    # lenient (boot-time) keeps the good one
+    specs = load_specs(str(path), on_invalid="skip")
+    assert [s.name for s in specs] == ["good"]
+
+    # and a full server boot with that file comes up serving the good plugin
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    kmsg = tmp_path / "kmsg"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp_path / "data"), port=0, tls=False, kmsg_path=str(kmsg)
+    )
+    cfg.plugin_specs_file = str(path)
+    s = Server(config=cfg)
+    try:
+        s.start()
+        assert s.registry.get("good") is not None
+        assert s.registry.get("bad!") is None
+    finally:
+        s.stop()

@@ -1,0 +1,168 @@
+"""Storage-layer boundary tests (round-2 verdict, item #3: "eventstore
+retention boundaries") — eventstore, metrics store and metadata behavior
+exactly at and around their retention/edge conditions.
+
+Reference: pkg/eventstore/database.go (retention purge at retention/5),
+pkg/metrics/store (time-series purge), pkg/metadata.
+"""
+
+import threading
+import time
+
+from gpud_tpu.api.v1.types import Event
+from gpud_tpu.eventstore import DEFAULT_RETENTION, EventStore
+from gpud_tpu.metadata import Metadata
+from gpud_tpu.metrics.store import MetricsStore
+
+
+# -- eventstore retention boundaries ---------------------------------------
+
+def test_purge_boundary_is_exclusive_of_cutoff(tmp_db):
+    """An event timestamped exactly AT the cutoff must survive the purge
+    — off-by-one here silently shortens retention."""
+    es = EventStore(tmp_db)
+    b = es.bucket("boundary")
+    cutoff = 1_000_000.0
+    b.insert(Event(time=cutoff - 0.001, name="older", message=""))
+    b.insert(Event(time=cutoff, name="at-cutoff", message=""))
+    b.insert(Event(time=cutoff + 0.001, name="newer", message=""))
+    b.purge(before=cutoff)
+    names = {e.name for e in b.get(0)}
+    assert "older" not in names
+    assert {"at-cutoff", "newer"} <= names
+
+
+def test_get_since_boundary_inclusive(tmp_db):
+    es = EventStore(tmp_db)
+    b = es.bucket("since")
+    t = 500.0
+    b.insert(Event(time=t, name="exact", message=""))
+    assert [e.name for e in b.get(t)] == ["exact"]
+    assert b.get(t + 0.0001) == []
+
+
+def test_default_retention_is_fourteen_days(tmp_db):
+    assert DEFAULT_RETENTION == 14 * 86400
+    es = EventStore(tmp_db)
+    b = es.bucket("ret")
+    now = time.time()
+    b.insert(Event(time=now - DEFAULT_RETENTION - 60, name="expired", message=""))
+    b.insert(Event(time=now - DEFAULT_RETENTION + 60, name="kept", message=""))
+    b.purge(before=now - es.retention_seconds)
+    assert [e.name for e in b.get(0)] == ["kept"]
+
+
+def test_purge_returns_deleted_count_and_is_idempotent(tmp_db):
+    es = EventStore(tmp_db)
+    b = es.bucket("count")
+    # time=0.0 means "now" (Event default) — start at 1.0 for fixed stamps
+    for i in range(1, 6):
+        b.insert(Event(time=float(i), name=f"e{i}", message=""))
+    assert b.purge(before=4.0) == 3
+    assert b.purge(before=4.0) == 0
+
+
+def test_purge_scoped_to_bucket(tmp_db):
+    es = EventStore(tmp_db)
+    a, b = es.bucket("comp-a"), es.bucket("comp-b")
+    a.insert(Event(time=1.0, name="a1", message=""))
+    b.insert(Event(time=1.0, name="b1", message=""))
+    a.purge(before=10.0)
+    assert a.get(0) == []
+    assert [e.name for e in b.get(0)] == ["b1"]
+
+
+def test_find_is_exact_row_identity(tmp_db):
+    """find() is the idempotent-insert probe: it matches on the exact
+    (time, name, type, message) row, so the same incident re-observed at
+    a different time is a NEW event (history preserves recurrences)."""
+    es = EventStore(tmp_db)
+    b = es.bucket("dedupe")
+    e1 = Event(time=1.0, name="x", message="m")
+    b.insert(e1)
+    assert b.find(Event(time=1.0, name="x", message="m")) is not None
+    assert b.find(Event(time=2.0, name="x", message="m")) is None
+    assert b.find(Event(time=1.0, name="x", message="other")) is None
+
+
+def test_empty_and_unicode_messages_roundtrip(tmp_db):
+    es = EventStore(tmp_db)
+    b = es.bucket("uni")
+    b.insert(Event(time=1.0, name="empty", message=""))
+    b.insert(Event(time=2.0, name="uni", message="链路 ↯ down — ICI"))
+    got = {e.name: e.message for e in b.get(0)}
+    assert got["empty"] == ""
+    assert got["uni"] == "链路 ↯ down — ICI"
+
+
+def test_concurrent_inserts_across_buckets(tmp_db):
+    es = EventStore(tmp_db)
+    errors = []
+
+    def writer(comp):
+        try:
+            b = es.bucket(comp)
+            for i in range(50):
+                b.insert(Event(time=float(i), name=f"{comp}-{i}", message="x"))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(f"c{j}",)) for j in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    for j in range(4):
+        assert len(es.bucket(f"c{j}").get(0)) == 50
+
+
+# -- metrics store boundaries ----------------------------------------------
+
+def test_metrics_read_since_boundary(tmp_db):
+    """`since` is truncated to whole seconds (metrics are minute-cadence
+    sweeps): read(100.x) includes the sample at 100."""
+    ms = MetricsStore(tmp_db)
+    ms.record([(100.0, "m", {"chip": "0"}, 1.0), (200.0, "m", {"chip": "0"}, 2.0)])
+    vals = [m.value for m in ms.read(100.0, name="m")]
+    assert vals == [1.0, 2.0]
+    assert [m.value for m in ms.read(100.9, name="m")] == [1.0, 2.0]
+    assert [m.value for m in ms.read(101.0, name="m")] == [2.0]
+
+
+def test_metrics_purge_boundary(tmp_db):
+    ms = MetricsStore(tmp_db)
+    ms.record([(100.0, "m", {}, 1.0), (200.0, "m", {}, 2.0)])
+    ms.purge(before=200.0)
+    vals = [m.value for m in ms.read(0.0, name="m")]
+    assert vals == [2.0]
+
+
+def test_metrics_name_filter_isolation(tmp_db):
+    ms = MetricsStore(tmp_db)
+    ms.record([(1.0, "a", {}, 1.0), (1.0, "b", {}, 2.0)])
+    assert [m.name for m in ms.read(0.0, name="a")] == ["a"]
+    assert len(ms.read(0.0)) == 2
+
+
+# -- metadata edge cases ----------------------------------------------------
+
+def test_metadata_overwrite_delete_missing(tmp_db):
+    md = Metadata(tmp_db)
+    assert md.get("nope") in (None, "")
+    md.set("k", "v1")
+    md.set("k", "v2")          # overwrite
+    assert md.get("k") == "v2"
+    md.delete("k")
+    assert md.get("k") in (None, "")
+    md.delete("k")             # idempotent
+
+
+def test_metadata_value_edge_shapes(tmp_db):
+    md = Metadata(tmp_db)
+    md.set("empty", "")
+    md.set("unicode", "机器-⊕-id")
+    md.set("large", "x" * 100_000)
+    assert md.get("empty") == ""
+    assert md.get("unicode") == "机器-⊕-id"
+    assert len(md.get("large")) == 100_000
