@@ -43,3 +43,37 @@ def tmp_db(tmp_path):
     db = DB(str(tmp_path / "state.db"))
     yield db
     db.close()
+
+
+def wait_until(cond, timeout=5.0, interval=0.01):
+    """Poll ``cond`` until truthy or timeout; returns the final value."""
+    import time
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = cond()
+        if v:
+            return v
+        time.sleep(interval)
+    return cond()
+
+
+@pytest.fixture(scope="module")
+def live_server(tmp_path_factory):
+    """Module-scoped daemon with mock TPU backend, fixture kmsg, no TLS,
+    and the egress-dependent latency probe disabled (shared by the SDK /
+    dispatcher suites — keep config changes HERE, not per-module)."""
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    tmp = tmp_path_factory.mktemp("live-server")
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp / "data"), port=0, tls=False, kmsg_path=str(kmsg)
+    )
+    cfg.components_disabled = ["network-latency"]  # egress-blocked sandbox
+    s = Server(config=cfg)
+    s.start()
+    yield s
+    s.stop()

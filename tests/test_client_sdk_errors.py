@@ -1,0 +1,74 @@
+"""Client SDK error-path coverage against a live server (reference:
+client/v1 is exercised by e2e; here the UNHAPPY paths get the same
+treatment — ClientError surfacing, 404s, refusal semantics, connection
+failures)."""
+
+import pytest
+
+from gpud_tpu.client.v1 import ClientError, Client
+
+
+@pytest.fixture(scope="module")
+def client(live_server):
+    return Client(f"http://localhost:{live_server.port}")
+
+
+def test_healthz_and_components(client):
+    assert client.healthz()["status"] == "ok"
+    comps = client.get_components()
+    assert "cpu" in comps
+
+
+def test_unknown_route_raises_api_error(client):
+    with pytest.raises(ClientError) as ei:
+        client._req("GET", "/v1/no-such-route")
+    assert ei.value.status == 404
+
+
+def test_set_healthy_unknown_component(client):
+    with pytest.raises(ClientError) as ei:
+        client.set_healthy("no-such-component")
+    assert ei.value.status in (400, 404)
+
+
+def test_deregister_builtin_refused(client):
+    with pytest.raises(ClientError) as ei:
+        client.deregister_component("cpu")
+    assert ei.value.status in (400, 403, 409)
+    # and the component is still there
+    assert "cpu" in client.get_components()
+
+
+def test_trigger_unknown_component(client):
+    with pytest.raises(ClientError) as ei:
+        client.trigger_check(component="no-such")
+    assert ei.value.status in (400, 404)
+
+
+def test_inject_fault_validation_surfaces(client):
+    with pytest.raises(ClientError) as ei:
+        client.inject_fault(tpu_error_name="no_such_error")
+    assert ei.value.status == 400
+    assert "unknown" in str(ei.value).lower()
+
+
+def test_events_metrics_accept_time_filters(client):
+    assert isinstance(client.get_events(start_time=0), list)
+    assert isinstance(client.get_metrics(since=0), list)
+
+
+def test_connection_refused_is_distinguishable():
+    c = Client("http://127.0.0.1:1", timeout=0.5)
+    with pytest.raises(Exception) as ei:
+        c.healthz()
+    assert not isinstance(ei.value, ClientError)  # transport error, not API
+
+
+def test_api_error_carries_status_and_body(client):
+    try:
+        client._req("POST", "/v1/components/trigger-check", params={"component": "nope"})
+    except ClientError as e:
+        assert e.status >= 400
+        assert isinstance(e.body, str)
+    else:
+        pytest.fail("expected ClientError")

@@ -12,28 +12,12 @@ import base64
 
 import pytest
 
-from gpud_tpu.config import default_config
-from gpud_tpu.server.server import Server
 from gpud_tpu.session.dispatch import Dispatcher
 
 
 @pytest.fixture(scope="module")
-def srv(tmp_path_factory):
-    tmp = tmp_path_factory.mktemp("dmatrix")
-    kmsg = tmp / "kmsg.fixture"
-    kmsg.write_text("")
-    cfg = default_config(
-        data_dir=str(tmp / "data"), port=0, tls=False, kmsg_path=str(kmsg)
-    )
-    s = Server(config=cfg)
-    s.start()
-    yield s
-    s.stop()
-
-
-@pytest.fixture(scope="module")
-def dispatch(srv):
-    return Dispatcher(srv)
+def dispatch(live_server):
+    return Dispatcher(live_server)
 
 
 # -- matrix ----------------------------------------------------------------
