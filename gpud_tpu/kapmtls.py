@@ -10,11 +10,27 @@ Layout::
 
     <root>/releases/<version>/{client.crt,client.key}
     <root>/current -> releases/<version>
+
+Consumer contract (how an agent must read the credentials): resolve
+``current`` ONCE, open the resolved directory, and read both files
+through that directory handle (``openat``-style). Two independent path
+opens through the symlink can straddle a rotation and pair a cert with
+the wrong key. Re-pushes of the ACTIVE version swap the release
+directory's content with ``renameat2(RENAME_EXCHANGE)`` where the kernel
+supports it, so a held directory handle keeps serving the complete OLD
+pair for its lifetime — a dirfd consumer never observes a torn pair.
+Vacated release dirs are garbage-collected only after GC_GRACE_SECONDS
+so an in-flight load through a just-replaced handle still completes.
+On filesystems WITHOUT RENAME_EXCHANGE the re-push falls back to a
+move-aside dance; there a loader can transiently hit ENOENT and must
+retry once (tests/test_kapmtls_agent.py models the dirfd consumer).
 """
 
 from __future__ import annotations
 
+import ctypes
 import os
+import shutil
 import time
 from dataclasses import dataclass
 from typing import List, Optional
@@ -24,6 +40,24 @@ from gpud_tpu.log import audit, get_logger
 logger = get_logger(__name__)
 
 DEFAULT_ROOT = "/var/lib/tpud/kapmtls"
+
+_RENAME_EXCHANGE = 2  # linux/fs.h
+_AT_FDCWD = -100
+
+
+def _exchange_dirs(a: str, b: str) -> bool:
+    """Atomically swap two paths via renameat2(RENAME_EXCHANGE); False
+    when the kernel/filesystem doesn't support it (caller falls back to
+    the move-aside dance)."""
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        ret = libc.renameat2(
+            _AT_FDCWD, os.fsencode(a), _AT_FDCWD, os.fsencode(b),
+            _RENAME_EXCHANGE,
+        )
+        return ret == 0
+    except (OSError, AttributeError):
+        return False
 
 
 @dataclass
@@ -42,10 +76,35 @@ class Status:
         }
 
 
+# vacated release dirs (.old-*/.tmp-*) survive this long so in-flight
+# dirfd loads complete; collected at the next install
+GC_GRACE_SECONDS = 60.0
+
+
 class CertManager:
     def __init__(self, root: str = DEFAULT_ROOT) -> None:
         self.root = root
         self.releases_dir = os.path.join(root, "releases")
+        self.gc_grace_seconds = GC_GRACE_SECONDS
+
+    def _gc_stale_dirs(self, grace: Optional[float] = None) -> None:
+        """Collect vacated staging/old dirs older than the grace period."""
+        if grace is None:
+            grace = self.gc_grace_seconds
+        try:
+            entries = os.listdir(self.releases_dir)
+        except OSError:
+            return
+        now = time.time()
+        for e in entries:
+            if ".tmp-" not in e and ".old-" not in e:
+                continue
+            p = os.path.join(self.releases_dir, e)
+            try:
+                if now - os.path.getmtime(p) >= grace:
+                    shutil.rmtree(p, ignore_errors=True)
+            except OSError:
+                pass
 
     def _release_dir(self, version: str) -> str:
         if not version or "/" in version or version.startswith("."):
@@ -60,6 +119,7 @@ class CertManager:
             d = self._release_dir(version)
         except ValueError as e:
             return str(e)
+        self._gc_stale_dirs()
         tmp = d + f".tmp-{int(time.time() * 1e6)}"
         try:
             os.makedirs(tmp, exist_ok=True)
@@ -72,7 +132,21 @@ class CertManager:
             old = None
             active_repush = False
             if os.path.isdir(d):
-                # re-push of an existing version: move the old dir aside so
+                # re-push of an existing version. Preferred path: atomic
+                # content swap — `current` never moves, and a consumer
+                # holding the directory open keeps the complete old pair
+                # (see the consumer contract in the module docstring)
+                if _exchange_dirs(tmp, d):
+                    # tmp now holds the OLD release; park it for deferred
+                    # GC — deleting immediately would unlink files under
+                    # a consumer that resolved just before the exchange
+                    try:
+                        os.rename(tmp, d + f".old-{int(time.time() * 1e6)}")
+                    except OSError:
+                        shutil.rmtree(tmp, ignore_errors=True)
+                    audit("kapmtls_install", version=version)
+                    return None
+                # fallback (no RENAME_EXCHANGE): move the old dir aside so
                 # the version path is free for the new release
                 old = d + f".old-{int(time.time() * 1e6)}"
                 link = os.path.join(self.root, "current")
@@ -102,10 +176,8 @@ class CertManager:
                 raise
             if active_repush:
                 self._retarget_current(os.path.join("releases", version))
-            if old is not None:
-                import shutil
-
-                shutil.rmtree(old, ignore_errors=True)
+            # `old` (if any) is left for deferred GC — same in-flight
+            # consumer rationale as the exchange path
         except OSError as e:
             return str(e)
         audit("kapmtls_install", version=version)

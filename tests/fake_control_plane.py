@@ -55,7 +55,11 @@ class FakeControlPlane:
                     frame = await q.get()
                     if frame is None:
                         break
-                    await resp.write((json.dumps(frame) + "\n").encode())
+                    if isinstance(frame, bytes):
+                        # raw bytes (hostile-manager tests): sent verbatim
+                        await resp.write(frame)
+                    else:
+                        await resp.write((json.dumps(frame) + "\n").encode())
             except (ConnectionResetError, asyncio.CancelledError):
                 pass
             return resp
@@ -79,6 +83,15 @@ class FakeControlPlane:
         asyncio.run_coroutine_threadsafe(
             q.put({"req_id": req_id, "data": data}), self._loop
         ).result(timeout=5)
+
+    def send_raw(self, machine_id: str, payload: bytes) -> None:
+        """Push raw bytes down the read stream (malformed-frame tests)."""
+        q = self.sessions.get(machine_id)
+        if q is None:
+            raise RuntimeError(f"no session for {machine_id}")
+        asyncio.run_coroutine_threadsafe(q.put(payload), self._loop).result(
+            timeout=5
+        )
 
     def wait_response(self, req_id: str, timeout: float = 10.0) -> Optional[dict]:
         import time

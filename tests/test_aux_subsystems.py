@@ -183,14 +183,63 @@ def test_processes_component_mock():
     assert cr.health_state_type() == "Healthy"
 
 
-def test_kapmtls_repush_active_version_keeps_current_valid(tmp_path, monkeypatch):
-    """Re-pushing the active version must end with `current` resolving to
-    the new release, and at every retarget `current` points at an existing
-    dir (the install pivots through the tmp dir)."""
+def _exchange_supported(tmp_path) -> bool:
     import os
 
     import gpud_tpu.kapmtls as kap
 
+    a, b = str(tmp_path / "xa"), str(tmp_path / "xb")
+    os.makedirs(a), os.makedirs(b)
+    return kap._exchange_dirs(a, b)
+
+
+def test_kapmtls_repush_active_version_exchange_never_moves_current(
+    tmp_path, monkeypatch
+):
+    """Primary re-push path (renameat2 RENAME_EXCHANGE): the release
+    directory's content is swapped atomically and `current` is never
+    retargeted — a held directory handle keeps a complete pair. The old
+    content is parked as .old-* for deferred GC."""
+    import os
+
+    import pytest as _pytest
+
+    if not _exchange_supported(tmp_path / "probe"):
+        _pytest.skip("RENAME_EXCHANGE unsupported on this fs/kernel")
+    mgr = CertManager(root=str(tmp_path / "kap"))
+    cert, key = _self_signed_pem()
+    assert mgr.install("v1", cert, key) is None
+    assert mgr.activate("v1") is None
+
+    targets = []
+    monkeypatch.setattr(
+        CertManager, "_retarget_current", lambda self, t: targets.append(t)
+    )
+    cert2, key2 = _self_signed_pem()
+    assert mgr.install("v1", cert2, key2) is None
+    assert targets == []  # exchange path: current untouched
+    st = mgr.status()
+    assert st.current_version == "v1" and st.ready
+    got = open(os.path.join(mgr.root, "current", "client.crt")).read()
+    assert got == cert2
+    # the vacated release waits out the consumer grace period, then GC's
+    leftover = [p for p in os.listdir(mgr.releases_dir) if "." in p]
+    assert len(leftover) == 1 and ".old-" in leftover[0]
+    mgr._gc_stale_dirs(grace=0.0)
+    assert [p for p in os.listdir(mgr.releases_dir) if "." in p] == []
+
+
+def test_kapmtls_repush_active_version_fallback_pivots_through_tmp(
+    tmp_path, monkeypatch
+):
+    """Fallback (no RENAME_EXCHANGE support): the install pivots
+    `current` through the tmp dir, and at every retarget `current`
+    resolves to an existing directory."""
+    import os
+
+    import gpud_tpu.kapmtls as kap
+
+    monkeypatch.setattr(kap, "_exchange_dirs", lambda a, b: False)
     mgr = CertManager(root=str(tmp_path))
     cert, key = _self_signed_pem()
     assert mgr.install("v1", cert, key) is None
@@ -213,10 +262,12 @@ def test_kapmtls_repush_active_version_keeps_current_valid(tmp_path, monkeypatch
     assert targets[-1] == os.path.join("releases", "v1")
     st = mgr.status()
     assert st.current_version == "v1" and st.ready
-    # new content actually installed
     got = open(os.path.join(str(tmp_path), "current", "client.crt")).read()
     assert got == cert2
-    # no stray .old-* / .tmp-* dirs left behind
+    # the moved-aside release waits out the consumer grace period
+    leftover = [p for p in os.listdir(os.path.join(str(tmp_path), "releases")) if "." in p]
+    assert len(leftover) == 1 and ".old-" in leftover[0]
+    mgr._gc_stale_dirs(grace=0.0)
     leftover = [p for p in os.listdir(os.path.join(str(tmp_path), "releases")) if "." in p]
     assert leftover == []
 

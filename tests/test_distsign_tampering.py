@@ -1,0 +1,122 @@
+"""distsign adversarial matrix (reference: pkg/release/distsign — key
+chain + package signing). The happy path and wrong-key cases are covered
+in test_release_cli.py; this suite attacks every byte an attacker can
+touch: signature files, the signing key's own chain signature, truncated
+and bit-flipped artifacts."""
+
+import os
+
+import pytest
+
+from gpud_tpu.release import distsign
+
+
+@pytest.fixture()
+def chain(tmp_path):
+    root_priv, root_pub = distsign.write_keypair(str(tmp_path), "root")
+    sign_priv, sign_pub = distsign.write_keypair(str(tmp_path), "signing")
+    key_sig = distsign.sign_key(root_priv, sign_pub)
+    pkg = tmp_path / "tpud-1.0.tar.gz"
+    pkg.write_bytes(os.urandom(4096))
+    pkg_sig = distsign.sign_package(sign_priv, str(pkg))
+    return {
+        "root_priv": root_priv, "root_pub": root_pub,
+        "sign_priv": sign_priv, "sign_pub": sign_pub,
+        "key_sig": key_sig, "pkg": str(pkg), "pkg_sig": pkg_sig,
+        "dir": tmp_path,
+    }
+
+
+def _flip_byte(path, offset=-1):
+    data = bytearray(open(path, "rb").read())
+    data[offset] ^= 0x01
+    open(path, "wb").write(bytes(data))
+
+
+def test_intact_chain_verifies(chain):
+    assert distsign.verify_package(
+        chain["sign_pub"], chain["pkg"], sig_path=chain["pkg_sig"],
+        root_pub_path=chain["root_pub"], key_sig_path=chain["key_sig"],
+    ) is None
+
+
+def test_single_bit_flip_in_package(chain):
+    _flip_byte(chain["pkg"], offset=100)
+    assert distsign.verify_package(
+        chain["sign_pub"], chain["pkg"], sig_path=chain["pkg_sig"]
+    ) is not None
+
+
+def test_single_bit_flip_in_package_signature(chain):
+    _flip_byte(chain["pkg_sig"])
+    assert distsign.verify_package(
+        chain["sign_pub"], chain["pkg"], sig_path=chain["pkg_sig"]
+    ) is not None
+
+
+def test_single_bit_flip_in_key_signature_breaks_chain(chain):
+    _flip_byte(chain["key_sig"])
+    err = distsign.verify_package(
+        chain["sign_pub"], chain["pkg"], sig_path=chain["pkg_sig"],
+        root_pub_path=chain["root_pub"], key_sig_path=chain["key_sig"],
+    )
+    assert err is not None
+
+
+def test_substituted_signing_key_rejected_by_chain(chain, tmp_path):
+    """The attacker swaps in their own signing keypair and re-signs the
+    package; without a root signature over the new key the chain fails."""
+    evil_priv, evil_pub = distsign.write_keypair(str(tmp_path), "evil")
+    _flip_byte(chain["pkg"], offset=10)  # attacker's modified package
+    evil_sig = distsign.sign_package(evil_priv, chain["pkg"])
+    # pure package verify against the attacker's key "succeeds"...
+    assert distsign.verify_package(evil_pub, chain["pkg"], sig_path=evil_sig) is None
+    # ...which is exactly why the chain check exists: the root never
+    # signed the evil key
+    err = distsign.verify_package(
+        evil_pub, chain["pkg"], sig_path=evil_sig,
+        root_pub_path=chain["root_pub"], key_sig_path=chain["key_sig"],
+    )
+    assert err is not None
+
+
+def test_truncated_package_rejected(chain):
+    data = open(chain["pkg"], "rb").read()
+    open(chain["pkg"], "wb").write(data[: len(data) // 2])
+    assert distsign.verify_package(
+        chain["sign_pub"], chain["pkg"], sig_path=chain["pkg_sig"]
+    ) is not None
+
+
+def test_empty_signature_file_rejected(chain):
+    open(chain["pkg_sig"], "wb").write(b"")
+    assert distsign.verify_package(
+        chain["sign_pub"], chain["pkg"], sig_path=chain["pkg_sig"]
+    ) is not None
+
+
+def test_signature_for_different_package_rejected(chain, tmp_path):
+    other = tmp_path / "other.tar.gz"
+    other.write_bytes(os.urandom(1024))
+    other_sig = distsign.sign_package(chain["sign_priv"], str(other))
+    assert distsign.verify_package(
+        chain["sign_pub"], chain["pkg"], sig_path=other_sig
+    ) is not None
+
+
+def test_root_key_cannot_stand_in_for_signing_key(chain):
+    """Signing discipline: the root key signs KEYS, not packages — a
+    package signature made with the root key must not verify against the
+    signing pubkey (and vice versa)."""
+    root_made = distsign.sign_package(chain["root_priv"], chain["pkg"])
+    assert distsign.verify_package(
+        chain["sign_pub"], chain["pkg"], sig_path=root_made
+    ) is not None
+
+
+def test_verify_key_rejects_garbage_inputs(chain, tmp_path):
+    junk = tmp_path / "junk.sig"
+    junk.write_bytes(b"not a signature")
+    assert not distsign.verify_key(
+        chain["root_pub"], chain["sign_pub"], str(junk)
+    )

@@ -55,9 +55,11 @@ def _keypair(common_name: str):
 
 
 class FakeAgent:
-    """Continuously consumes <root>/current like a real mTLS agent:
-    loads the keypair into an SSLContext and records the CN it saw.
-    Any load error (missing file, cert/key mismatch, partial write)
+    """Continuously consumes <root>/current per the documented consumer
+    contract (kapmtls.py module docstring): resolve ``current`` once,
+    hold the release DIRECTORY open, read both files through that handle
+    — then prove the pair actually matches (cert pubkey == key pubkey,
+    the check ssl.load_cert_chain enforces). Any load error or torn pair
     is a rotation-atomicity failure."""
 
     def __init__(self, root: str) -> None:
@@ -67,22 +69,42 @@ class FakeAgent:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True)
 
+    def _load_once(self) -> str:
+        """One credential load through a held dirfd; returns the CN."""
+        resolved = os.path.realpath(os.path.join(self.root, "current"))
+        dfd = os.open(resolved, os.O_RDONLY | os.O_DIRECTORY)
+        try:
+            def read(name):
+                fd = os.open(name, os.O_RDONLY, dir_fd=dfd)
+                try:
+                    return os.read(fd, 1 << 20)
+                finally:
+                    os.close(fd)
+
+            crt_pem, key_pem = read("client.crt"), read("client.key")
+        finally:
+            os.close(dfd)
+        cert = x509.load_pem_x509_certificate(crt_pem)
+        key = serialization.load_pem_private_key(key_pem, password=None)
+        pub_c = cert.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+        pub_k = key.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+        if pub_c != pub_k:
+            raise ssl.SSLError("KEY_VALUES_MISMATCH: torn cert/key pair")
+        return cert.subject.get_attributes_for_oid(NameOID.COMMON_NAME)[0].value
+
     def _loop(self) -> None:
-        crt = os.path.join(self.root, "current", "client.crt")
-        key = os.path.join(self.root, "current", "client.key")
         while not self._stop.is_set():
             if not os.path.exists(os.path.join(self.root, "current")):
                 time.sleep(0.001)
                 continue
             try:
-                ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
-                ctx.load_cert_chain(crt, key)
-                with open(crt, "rb") as f:
-                    cn = (
-                        x509.load_pem_x509_certificate(f.read())
-                        .subject.get_attributes_for_oid(NameOID.COMMON_NAME)[0]
-                        .value
-                    )
+                cn = self._load_once()
                 if not self.seen_cns or self.seen_cns[-1] != cn:
                     self.seen_cns.append(cn)
             except Exception as e:  # noqa: BLE001 — any failure is the bug

@@ -141,3 +141,29 @@ def test_auth_park_over_real_http(tmp_path):
         s.stop()
     finally:
         cp.stop()
+
+
+def test_hostile_manager_frames_do_not_break_session(stack):
+    """Malformed read-stream lines from the control plane — garbage JSON,
+    wrong-shape frames, an oversized frame — must be dropped; a valid
+    request afterwards is still answered (the serve loop survived)."""
+    cp, srv = stack
+    cp.connected.wait(10)
+    mid = "e2e-machine"
+    cp.send_raw(mid, b"this is not json at all\n")
+    cp.send_raw(mid, b"{\"req_id\": 42, \"data\": \"not-a-dict\"}\n")
+    cp.send_raw(mid, b"{\"no_req_id\": true}\n")
+    cp.send_raw(mid, b"[1, 2, 3]\n")
+    cp.send_raw(mid, b"{}\n")
+    # an oversized-but-valid frame (1 MB of padding) must not wedge parsing
+    import json as _json
+
+    big = _json.dumps(
+        {"req_id": "huge", "data": {"method": "states", "pad": "x" * (1 << 20)}}
+    ).encode() + b"\n"
+    cp.send_raw(mid, big)
+    # the session still serves a normal request after all of that
+    cp.send_request(mid, "after-hostile", {"method": "states"})
+    resp = cp.wait_response("after-hostile", timeout=10)
+    assert resp is not None, "session died after hostile frames"
+    assert "states" in resp.get("data", {})

@@ -1,0 +1,99 @@
+"""Accelerator-type → topology parsing matrix (reference analog:
+pkg/nvidia/product name→capability mapping tests). The table pins the
+public facts the whole daemon keys off: chips-per-host, ICI link counts,
+HBM capacities, host counts — a regression here silently mis-sizes every
+expectation downstream (chip-counts, ICI baseline, HBM totals)."""
+
+import pytest
+
+from gpud_tpu.tpu.topology import (
+    GENERATIONS,
+    expected_local_chips,
+    normalize_generation,
+    parse_accelerator_type,
+)
+
+_GiB = 1024**3
+
+# (accel_type, gen, total_chips, total_cores, hosts, chips_per_host,
+#  links_per_chip, hbm_per_chip)
+MATRIX = [
+    # suffix counts TensorCores (v2/v3/v4/v5p)
+    ("v2-8",    "v2",  4,   8,   1,  4, 4,  8 * _GiB),
+    ("v3-32",   "v3",  16,  32,  4,  4, 4,  16 * _GiB),
+    ("v4-8",    "v4",  4,   8,   1,  4, 6,  32 * _GiB),
+    ("v4-32",   "v4",  16,  32,  4,  4, 6,  32 * _GiB),
+    ("v4-4096", "v4",  2048, 4096, 512, 4, 6, 32 * _GiB),
+    ("v5p-8",   "v5p", 4,   8,   1,  4, 6,  95 * _GiB),
+    ("v5p-256", "v5p", 128, 256, 32, 4, 6,  95 * _GiB),
+    # suffix counts chips (v5e/v6e)
+    ("v5e-1",   "v5e", 1,   1,   1,  1, 4,  16 * _GiB),
+    ("v5e-4",   "v5e", 4,   4,   1,  4, 4,  16 * _GiB),
+    ("v5e-8",   "v5e", 8,   8,   1,  8, 4,  16 * _GiB),
+    ("v5e-64",  "v5e", 64,  64,  8,  8, 4,  16 * _GiB),
+    ("v5e-256", "v5e", 256, 256, 32, 8, 4,  16 * _GiB),
+    ("v6e-8",   "v6e", 8,   8,   1,  8, 4,  32 * _GiB),
+    ("v6e-256", "v6e", 256, 256, 32, 8, 4,  32 * _GiB),
+    # alias spelling
+    ("v5litepod-16", "v5e", 16, 16, 2, 8, 4, 16 * _GiB),
+]
+
+
+@pytest.mark.parametrize("accel,gen,chips,cores,hosts,cph,links,hbm", MATRIX)
+def test_topology_matrix(accel, gen, chips, cores, hosts, cph, links, hbm):
+    t = parse_accelerator_type(accel)
+    assert t is not None, accel
+    assert t.generation == gen
+    assert t.total_chips == chips
+    assert t.total_cores == cores
+    assert t.hosts == hosts
+    assert t.chips_per_host == cph
+    assert t.ici_links_per_chip == links
+    assert t.hbm_bytes_per_chip == hbm
+    assert t.multi_host == (hosts > 1)
+    assert expected_local_chips(accel) == cph
+
+
+@pytest.mark.parametrize(
+    "bad",
+    ["", "v7-8", "tpu", "v5p", "v5p-", "-8", "v5p-abc", "8-v5p", "gpu-8",
+     "v5p_8", "v5p-0x8"],
+)
+def test_unparseable_types_return_none(bad):
+    assert parse_accelerator_type(bad) is None
+    assert expected_local_chips(bad) == 0
+
+
+def test_case_and_whitespace_tolerance():
+    t = parse_accelerator_type("  V5P-256  ")
+    assert t is not None and t.generation == "v5p"
+
+
+@pytest.mark.parametrize(
+    "alias,gen",
+    [
+        ("TPU v4", "v4"),
+        ("TPU v5 lite", "v5e"),
+        ("TPU v5 lite0", "v5e"),   # jax device_kind with trailing digit
+        ("tpu v5p", "v5p"),
+        ("TPU v6 lite", "v6e"),
+        ("v5litepod", "v5e"),
+        ("v5e", "v5e"),
+        ("unknown thing", "unknown thing"),  # passthrough, not a crash
+    ],
+)
+def test_generation_aliases(alias, gen):
+    assert normalize_generation(alias) == gen
+
+
+def test_generation_table_invariants():
+    for name, spec in GENERATIONS.items():
+        assert spec.name == name
+        assert spec.cores_per_chip in (1, 2)
+        assert spec.chips_per_host in (4, 8)
+        # 3D-torus generations expose 6 links, 2D expose 4
+        assert spec.ici_links_per_chip in (4, 6)
+        assert spec.hbm_bytes_per_chip >= 8 * _GiB
+        # suffix-counts-chips implies single-core chips (v5e/v6e)
+        if spec.suffix_counts_chips:
+            assert spec.cores_per_chip == 1

@@ -1,0 +1,197 @@
+"""Wire-type serialization roundtrips for the whole api/v1 surface
+(reference: api/v1/types.go — these shapes ARE the control-plane
+contract; a lossy to_dict/from_dict pair corrupts fleet state silently).
+
+Three properties per type: (1) populated → dict → object is lossless,
+(2) from_dict of an EMPTY dict yields working defaults (an old manager
+omitting new fields must not crash a new agent), (3) unknown extra keys
+are ignored (a NEW manager must not crash an old agent)."""
+
+import pytest
+
+from gpud_tpu.api.v1.types import (
+    BlockDeviceInfo,
+    ComponentInfo,
+    DiskInfo,
+    Event,
+    HealthState,
+    MachineInfo,
+    Metric,
+    NICInfo,
+    PackageStatus,
+    SuggestedActions,
+    TPUChipInfo,
+    TPUInfo,
+)
+
+SAMPLES = [
+    (
+        HealthState,
+        HealthState(
+            component="accelerator-tpu-ici",
+            health="Unhealthy",
+            reason="link down",
+            error="",
+            suggested_actions=SuggestedActions(
+                description="reboot",
+                repair_actions=["REBOOT_SYSTEM", "HARDWARE_INSPECTION"],
+            ),
+            extra_info={"links_up": "22", "poll_mode": "fast"},
+        ),
+    ),
+    (
+        Event,
+        Event(
+            component="x",
+            time=1700000000.5,
+            name="tpu_chip_lost",
+            type="Fatal",
+            message="accel2: device lost",
+            extra_info={"chip": "2"},
+        ),
+    ),
+    (
+        Metric,
+        Metric(
+            unix_seconds=1700000000,
+            name="tpud_tpu_temperature_celsius",
+            labels={"chip": "3"},
+            value=61.5,
+        ),
+    ),
+    (
+        SuggestedActions,
+        SuggestedActions(description="d", repair_actions=["IGNORE_NO_ACTION_REQUIRED"]),
+    ),
+    (
+        TPUChipInfo,
+        TPUChipInfo(
+            chip_id=2,
+            device_path="/dev/vfio/14",
+            pci_address="0000:00:06.0",
+            serial="s-2",
+            hbm_total_bytes=95 * 1024**3,
+            cores_per_chip=2,
+        ),
+    ),
+    (
+        TPUInfo,
+        TPUInfo(
+            product="TPU v5p",
+            accelerator_type="v5p-256",
+            topology="128 chips / 32 hosts",
+            generation="v5p",
+            chip_count=4,
+            hosts_per_slice=32,
+            worker_id=7,
+            runtime_version="rt",
+            driver_version="drv",
+            chips=[TPUChipInfo(chip_id=0), TPUChipInfo(chip_id=1)],
+        ),
+    ),
+    (DiskInfo, DiskInfo(device="/dev/sda1", mount_point="/", fstype="ext4",
+                        total_bytes=10, used_bytes=5)),
+    (
+        NICInfo,
+        NICInfo(name="eth0", mac="aa:bb", addresses=["10.0.0.2"], mtu=1460,
+                speed_mbps=10000, driver="gve", virtual=False),
+    ),
+    (
+        BlockDeviceInfo,
+        BlockDeviceInfo(
+            name="sda", type="disk", size_bytes=1 << 40, model="PD",
+            rotational=False, removable=False,
+            children=[
+                BlockDeviceInfo(name="sda1", type="part", mount_point="/",
+                                fstype="ext4", used_bytes=9)
+            ],
+        ),
+    ),
+    (
+        PackageStatus,
+        PackageStatus(name="p", is_installed=True, installing=False,
+                      progress=100, target_version="2", current_version="2"),
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "cls,obj", SAMPLES, ids=[c.__name__ for c, _ in SAMPLES]
+)
+def test_roundtrip_lossless(cls, obj):
+    d = obj.to_dict()
+    again = cls.from_dict(d)
+    assert again.to_dict() == d
+
+
+@pytest.mark.parametrize(
+    "cls,obj", SAMPLES, ids=[c.__name__ for c, _ in SAMPLES]
+)
+def test_from_empty_dict_yields_defaults(cls, obj):
+    again = cls.from_dict({})
+    if again is None:
+        # optional wire types (SuggestedActions, TPUInfo) decode an empty
+        # payload as "absent" — that IS the default contract
+        return
+    # must serialize without raising; roundtrip of defaults is stable
+    assert cls.from_dict(again.to_dict()).to_dict() == again.to_dict()
+
+
+@pytest.mark.parametrize(
+    "cls,obj", SAMPLES, ids=[c.__name__ for c, _ in SAMPLES]
+)
+def test_unknown_keys_ignored(cls, obj):
+    d = obj.to_dict()
+    d["__future_field__"] = {"nested": [1, 2]}
+    again = cls.from_dict(d)
+    assert "__future_field__" not in again.to_dict()
+
+
+def test_machine_info_nested_roundtrip():
+    mi = MachineInfo(
+        machine_id="m",
+        hostname="h",
+        containerized=True,
+        tpu_info=TPUInfo(product="TPU v5e", chip_count=8),
+        disks=[DiskInfo(device="/dev/sda1")],
+        nics=[NICInfo(name="eth0", driver="gve")],
+        block_devices=[
+            BlockDeviceInfo(name="sda", children=[BlockDeviceInfo(name="sda1")])
+        ],
+    )
+    d = mi.to_dict()
+    again = MachineInfo.from_dict(d)
+    assert again.to_dict() == d
+    assert again.tpu_info.chip_count == 8
+    assert again.block_devices[0].children[0].name == "sda1"
+
+
+def test_health_state_without_actions_omits_key():
+    hs = HealthState(component="cpu", health="Healthy", reason="ok")
+    d = hs.to_dict()
+    again = HealthState.from_dict(d)
+    assert again.suggested_actions is None
+
+
+def test_event_time_precision_preserved():
+    e = Event(component="x", time=1700000000.123456, name="n", message="")
+    assert Event.from_dict(e.to_dict()).time == pytest.approx(
+        1700000000.123456, abs=1e-6
+    )
+
+
+def test_component_info_roundtrip():
+    ci = ComponentInfo(
+        component="cpu",
+        start_time=1.0,
+        end_time=2.0,
+        states=[HealthState(component="cpu", health="Healthy", reason="ok")],
+        events=[Event(component="cpu", time=1.5, name="e", message="m")],
+        metrics=[Metric(unix_seconds=1, name="n", labels={}, value=0.5)],
+    )
+    d = ci.to_dict()
+    again = ComponentInfo.from_dict(d)
+    assert again.to_dict() == d
+    assert again.states[0].health == "Healthy"
+    assert again.events[0].name == "e"
+    assert again.metrics[0].value == 0.5
