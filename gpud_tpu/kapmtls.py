@@ -87,6 +87,23 @@ class CertManager:
         self.releases_dir = os.path.join(root, "releases")
         self.gc_grace_seconds = GC_GRACE_SECONDS
 
+    _STAGING_RE = None  # compiled lazily below
+
+    @classmethod
+    def _staging_stamp(cls, name: str) -> Optional[float]:
+        """Unix time (seconds) a staging/old dir was created, parsed from
+        its `<version>.(tmp|old)-<usec>` suffix — mtime is useless here
+        (rename preserves the ORIGINAL install mtime, which would make a
+        just-vacated dir look ancient and defeat the grace period)."""
+        import re
+
+        if cls._STAGING_RE is None:
+            cls._STAGING_RE = re.compile(r"\.(?:tmp|old)-(\d+)$")
+        m = cls._STAGING_RE.search(name)
+        if m is None:
+            return None
+        return int(m.group(1)) / 1e6
+
     def _gc_stale_dirs(self, grace: Optional[float] = None) -> None:
         """Collect vacated staging/old dirs older than the grace period."""
         if grace is None:
@@ -97,18 +114,21 @@ class CertManager:
             return
         now = time.time()
         for e in entries:
-            if ".tmp-" not in e and ".old-" not in e:
-                continue
-            p = os.path.join(self.releases_dir, e)
-            try:
-                if now - os.path.getmtime(p) >= grace:
-                    shutil.rmtree(p, ignore_errors=True)
-            except OSError:
-                pass
+            stamp = self._staging_stamp(e)
+            if stamp is None:
+                continue  # not a staging dir (strict suffix match)
+            if now - stamp >= grace:
+                shutil.rmtree(
+                    os.path.join(self.releases_dir, e), ignore_errors=True
+                )
 
     def _release_dir(self, version: str) -> str:
         if not version or "/" in version or version.startswith("."):
             raise ValueError(f"invalid version {version!r}")
+        if self._staging_stamp(version) is not None:
+            # a version named like a staging dir would be silently
+            # garbage-collected later — reject at install time
+            raise ValueError(f"version {version!r} matches the staging-dir pattern")
         return os.path.join(self.releases_dir, version)
 
     # -- install -----------------------------------------------------------
@@ -143,7 +163,11 @@ class CertManager:
                     try:
                         os.rename(tmp, d + f".old-{int(time.time() * 1e6)}")
                     except OSError:
-                        shutil.rmtree(tmp, ignore_errors=True)
+                        # parking failed: leave it — the .tmp- name is
+                        # already GC-eligible after the grace period, and
+                        # deleting now is the unlink-under-a-consumer
+                        # this whole path exists to avoid
+                        pass
                     audit("kapmtls_install", version=version)
                     return None
                 # fallback (no RENAME_EXCHANGE): move the old dir aside so

@@ -1,0 +1,256 @@
+"""Host-component scenario depth (reference: each components/* package
+carries table-driven scenario tests — SURVEY §2.3). Fixtures stand in
+for /sys/fs/fuse, /proc/modules, library trees, container runtimes and
+the kubelet read-only API; every component's degrade/unhealthy edges are
+driven, not just the happy path."""
+
+import json
+import os
+
+import pytest
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components import host_extra
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.components.host_extra import (
+    ContainerdComponent,
+    DockerComponent,
+    FuseComponent,
+    KernelModuleComponent,
+    KubeletComponent,
+    LibraryComponent,
+    PCIComponent,
+)
+from gpud_tpu.process import RunResult
+
+
+def _rr(exit_code=0, output="", error=""):
+    return RunResult(exit_code=exit_code, output=output, error=error)
+
+
+# -- fuse -------------------------------------------------------------------
+
+def _fuse_conn(root, name, waiting, max_bg):
+    d = root / name
+    d.mkdir(parents=True)
+    (d / "waiting").write_text(f"{waiting}\n")
+    (d / "max_background").write_text(f"{max_bg}\n")
+
+
+def test_fuse_healthy_and_congested(tmp_path):
+    c = FuseComponent(TpudInstance())
+    c.connections_dir = str(tmp_path)
+    _fuse_conn(tmp_path, "38", waiting=0, max_bg=12)
+    _fuse_conn(tmp_path, "44", waiting=2, max_bg=12)
+    r = c.check_once()
+    assert r.health == HealthStateType.HEALTHY
+    assert "2 fuse connections" in r.reason
+    # one connection saturates (>=90% of max_background waiting)
+    _fuse_conn(tmp_path, "51", waiting=11, max_bg=12)
+    r = c.check_once()
+    assert r.health == HealthStateType.DEGRADED
+    assert "51" in r.reason
+
+
+def test_fuse_unparseable_connection_skipped(tmp_path):
+    c = FuseComponent(TpudInstance())
+    c.connections_dir = str(tmp_path)
+    bad = tmp_path / "99"
+    bad.mkdir()
+    (bad / "waiting").write_text("not-a-number\n")
+    _fuse_conn(tmp_path, "40", waiting=0, max_bg=12)
+    r = c.check_once()
+    assert r.health == HealthStateType.HEALTHY
+
+
+def test_fuse_zero_max_background_never_divides(tmp_path):
+    c = FuseComponent(TpudInstance())
+    c.connections_dir = str(tmp_path)
+    _fuse_conn(tmp_path, "40", waiting=5, max_bg=0)
+    assert c.check_once().health == HealthStateType.HEALTHY
+
+
+# -- kernel-module ----------------------------------------------------------
+
+def test_kernel_module_missing_flags_unhealthy(monkeypatch):
+    c = KernelModuleComponent(
+        TpudInstance(kernel_modules_to_check=["gasket", "overlay"])
+    )
+    monkeypatch.setattr(c, "_loaded_modules", lambda: {"overlay", "ext4"})
+    r = c.check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    assert "gasket" in r.reason and "overlay" not in r.reason
+
+
+def test_kernel_module_all_loaded(monkeypatch):
+    c = KernelModuleComponent(TpudInstance(kernel_modules_to_check=["a", "b"]))
+    monkeypatch.setattr(c, "_loaded_modules", lambda: {"a", "b", "c"})
+    r = c.check_once()
+    assert r.health == HealthStateType.HEALTHY
+    assert "all 2 modules" in r.reason
+
+
+# -- library ----------------------------------------------------------------
+
+class _RealishTPU:
+    def tpu_lib_exists(self):
+        return True
+
+    def is_mock(self):
+        return False
+
+
+def test_library_found_in_nested_dir(tmp_path):
+    c = LibraryComponent(TpudInstance(tpu_instance=_RealishTPU()))
+    nested = tmp_path / "python3.10" / "site-packages" / "libtpu"
+    nested.mkdir(parents=True)
+    (nested / "libtpu.so").write_text("")
+    c.search_dirs = [str(tmp_path)]
+    r = c.check_once()
+    assert r.health == HealthStateType.HEALTHY
+
+
+def test_library_missing_degrades(tmp_path):
+    c = LibraryComponent(TpudInstance(tpu_instance=_RealishTPU()))
+    c.search_dirs = [str(tmp_path)]
+    r = c.check_once()
+    assert r.health == HealthStateType.DEGRADED
+    assert "libtpu.so" in r.reason
+
+
+def test_library_unsupported_on_mock_backend():
+    from gpud_tpu.tpu.instance import MockBackend
+
+    c = LibraryComponent(TpudInstance(tpu_instance=MockBackend()))
+    assert not c.is_supported()
+
+
+# -- docker -----------------------------------------------------------------
+
+def test_docker_running_containers(monkeypatch):
+    monkeypatch.setattr(
+        host_extra, "run_command",
+        lambda *a, **k: _rr(0, "web\ndb\nworker\n"),
+    )
+    r = DockerComponent(TpudInstance()).check_once()
+    assert r.health == HealthStateType.HEALTHY
+    assert "3 containers" in r.reason
+
+
+def test_docker_daemon_down(monkeypatch):
+    monkeypatch.setattr(
+        host_extra, "run_command",
+        lambda *a, **k: _rr(1, "", "Cannot connect to the Docker daemon"),
+    )
+    r = DockerComponent(TpudInstance()).check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    assert "not responding" in r.reason
+
+
+# -- containerd socket damping ---------------------------------------------
+
+def test_containerd_socket_miss_damping(tmp_path):
+    c = ContainerdComponent(TpudInstance())
+    c.socket_path = str(tmp_path / "containerd.sock")  # absent
+    r1, r2 = c.check_once(), c.check_once()
+    assert r1.health == HealthStateType.HEALTHY and "1/3 strikes" in r1.reason
+    assert r2.health == HealthStateType.HEALTHY and "2/3 strikes" in r2.reason
+    r3 = c.check_once()
+    assert r3.health == HealthStateType.UNHEALTHY
+    # socket restored: strikes reset (fresh damping window)
+    (tmp_path / "containerd.sock").write_text("")
+    c.check_once()
+    os.unlink(str(tmp_path / "containerd.sock"))
+    r = c.check_once()
+    assert "1/3 strikes" in r.reason
+
+
+# -- kubelet ----------------------------------------------------------------
+
+class _FakeResp:
+    def __init__(self, payload: bytes):
+        self._p = payload
+
+    def read(self):
+        return self._p
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def test_kubelet_pods_and_node_name(monkeypatch):
+    payload = json.dumps(
+        {"items": [{"spec": {"nodeName": "tpu-node-3"}}, {"spec": {}}]}
+    ).encode()
+    import urllib.request
+
+    monkeypatch.setattr(
+        urllib.request, "urlopen", lambda *a, **k: _FakeResp(payload)
+    )
+    r = KubeletComponent(TpudInstance()).check_once()
+    assert r.health == HealthStateType.HEALTHY
+    assert r.extra_info["node_name"] == "tpu-node-3"
+    assert r.extra_info["pods"] == "2"
+
+
+def test_kubelet_api_failure_unhealthy(monkeypatch):
+    import urllib.request
+
+    def boom(*a, **k):
+        raise OSError("connection reset")
+
+    monkeypatch.setattr(urllib.request, "urlopen", boom)
+    r = KubeletComponent(TpudInstance()).check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    assert "connection reset" in r.reason
+
+
+# -- pci / ACS --------------------------------------------------------------
+
+def test_pci_acs_enabled_on_baremetal(monkeypatch):
+    from gpud_tpu import host as pkghost
+
+    monkeypatch.setattr(pkghost, "virtualization", lambda: "none")
+    monkeypatch.setattr(
+        host_extra, "run_command",
+        lambda *a, **k: _rr(0, "Capabilities: ACSCtl: SrcValid+ TransBlk-"),
+    )
+    r = PCIComponent(TpudInstance()).check_once()
+    assert r.health == HealthStateType.DEGRADED
+    assert "ACS enabled" in r.reason
+
+
+def test_pci_acs_disabled_on_baremetal(monkeypatch):
+    from gpud_tpu import host as pkghost
+
+    monkeypatch.setattr(pkghost, "virtualization", lambda: "none")
+    monkeypatch.setattr(
+        host_extra, "run_command",
+        lambda *a, **k: _rr(0, "Capabilities: ACSCtl: SrcValid- TransBlk-"),
+    )
+    r = PCIComponent(TpudInstance()).check_once()
+    assert r.health == HealthStateType.HEALTHY
+
+
+def test_pci_virtualized_skips(monkeypatch):
+    from gpud_tpu import host as pkghost
+
+    monkeypatch.setattr(pkghost, "virtualization", lambda: "kvm")
+    r = PCIComponent(TpudInstance()).check_once()
+    assert r.health == HealthStateType.HEALTHY
+    assert "skipped" in r.reason
+
+
+def test_pci_lspci_unavailable_skips(monkeypatch):
+    from gpud_tpu import host as pkghost
+
+    monkeypatch.setattr(pkghost, "virtualization", lambda: "none")
+    monkeypatch.setattr(
+        host_extra, "run_command", lambda *a, **k: _rr(127, "", "not found")
+    )
+    r = PCIComponent(TpudInstance()).check_once()
+    assert r.health == HealthStateType.HEALTHY
+    assert "skipped" in r.reason

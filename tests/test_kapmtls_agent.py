@@ -104,7 +104,13 @@ class FakeAgent:
                 time.sleep(0.001)
                 continue
             try:
-                cn = self._load_once()
+                try:
+                    cn = self._load_once()
+                except FileNotFoundError:
+                    # documented contract: on filesystems without
+                    # RENAME_EXCHANGE a loader can transiently hit ENOENT
+                    # during the fallback dance and must retry once
+                    cn = self._load_once()
                 if not self.seen_cns or self.seen_cns[-1] != cn:
                     self.seen_cns.append(cn)
             except Exception as e:  # noqa: BLE001 — any failure is the bug
@@ -216,6 +222,44 @@ def test_version_path_traversal_rejected(tmp_path):
     assert mgr.install(".hidden", c, k) is not None
     assert mgr.install("", c, k) is not None
     assert not os.path.exists(str(tmp_path.parent / "evil"))
+
+
+def test_gc_grace_uses_vacate_time_not_mtime(tmp_path):
+    """A release installed long ago and re-pushed NOW parks an .old dir
+    whose mtime is ancient; GC must key off the vacate stamp in the dir
+    NAME, or it deletes the dir milliseconds after parking — under a
+    consumer's feet."""
+    mgr = CertManager(root=str(tmp_path))
+    c1, k1 = _keypair("v1")
+    assert mgr.install("v1", c1, k1) is None
+    # age the release (simulates an install > grace ago)
+    old_time = time.time() - 3600
+    os.utime(str(tmp_path / "releases" / "v1"), (old_time, old_time))
+    c2, k2 = _keypair("v1b")
+    assert mgr.install("v1", c2, k2) is None  # re-push parks the old dir
+    parked = [p for p in os.listdir(str(tmp_path / "releases")) if ".old-" in p]
+    assert len(parked) == 1
+    # another install triggers GC — the freshly-parked dir must survive
+    c3, k3 = _keypair("v2")
+    assert mgr.install("v2", c3, k3) is None
+    assert any(
+        ".old-" in p for p in os.listdir(str(tmp_path / "releases"))
+    ), "grace period ignored: freshly-vacated release collected"
+    # once the stamp is old, GC collects it
+    mgr._gc_stale_dirs(grace=0.0)
+    assert not any(
+        ".old-" in p for p in os.listdir(str(tmp_path / "releases"))
+    )
+
+
+def test_version_matching_staging_pattern_rejected(tmp_path):
+    """A version literally named like a staging dir would be silently
+    garbage-collected later — rejected at install time."""
+    mgr = CertManager(root=str(tmp_path))
+    c, k = _keypair("x")
+    assert mgr.install("v1.old-2", c, k) is not None
+    assert mgr.install("v1.tmp-99", c, k) is not None
+    assert mgr.install("v1.older-2", c, k) is None  # only the exact pattern
 
 
 def test_status_hides_staging_dirs(tmp_path):

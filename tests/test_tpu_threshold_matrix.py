@@ -1,0 +1,133 @@
+"""TPU accelerator-component threshold matrices (reference style:
+temperature/component_test.go tables over margin/threshold combos).
+The mock backend's telemetry is shaped per-case via a stub sampler so
+every health transition edge is pinned exactly."""
+
+import pytest
+
+from gpud_tpu.api.v1.types import HealthStateType, RepairActionType
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.components.tpu.hbm import TPUHbmComponent
+from gpud_tpu.components.tpu.temperature import (
+    DEFAULT_DEGRADED_C,
+    DEFAULT_UNHEALTHY_C,
+    TPUTemperatureComponent,
+)
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.tpu.instance import MockBackend, TPUChipTelemetry
+
+
+def _tel(per_chip):
+    """{cid: dict-of-fields} → telemetry mapping."""
+    out = {}
+    for cid, fields in per_chip.items():
+        t = TPUChipTelemetry(chip_id=cid, hbm_total_bytes=16 << 30)
+        for k, v in fields.items():
+            setattr(t, k, v)
+        out[cid] = t
+    return out
+
+
+def _temp_component(tel):
+    c = TPUTemperatureComponent(TpudInstance(tpu_instance=MockBackend()))
+    c.sampler.telemetry = lambda: tel
+    return c
+
+
+# -- temperature ------------------------------------------------------------
+
+TEMP_MATRIX = [
+    # (worst_temp, slowdown, expected_health)
+    (45.0, False, HealthStateType.HEALTHY),
+    (DEFAULT_DEGRADED_C - 0.1, False, HealthStateType.HEALTHY),
+    (DEFAULT_DEGRADED_C, False, HealthStateType.DEGRADED),       # at threshold
+    (DEFAULT_UNHEALTHY_C - 0.1, False, HealthStateType.DEGRADED),
+    (DEFAULT_UNHEALTHY_C, False, HealthStateType.UNHEALTHY),     # at threshold
+    (60.0, True, HealthStateType.UNHEALTHY),  # slowdown flag outranks temp
+]
+
+
+@pytest.mark.parametrize("worst,slowdown,expected", TEMP_MATRIX)
+def test_temperature_threshold_matrix(worst, slowdown, expected):
+    tel = _tel(
+        {0: {"temperature_c": 40.0}, 1: {"temperature_c": worst,
+                                         "thermal_slowdown": slowdown}}
+    )
+    r = _temp_component(tel).check_once()
+    assert r.health == expected, (worst, slowdown, r.reason)
+    if expected == HealthStateType.UNHEALTHY:
+        assert "1" in r.reason  # the culprit chip is named
+        assert RepairActionType.HARDWARE_INSPECTION in (
+            r.suggested_actions.repair_actions
+        )
+
+
+def test_temperature_threshold_overrides():
+    tel = _tel({0: {"temperature_c": 70.0}})
+    c = _temp_component(tel)
+    c.degraded_c, c.unhealthy_c = 60.0, 69.0  # operator lowered thresholds
+    r = c.check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+
+
+def test_temperature_extra_info_per_chip():
+    tel = _tel({0: {"temperature_c": 41.5}, 3: {"temperature_c": 44.25}})
+    r = _temp_component(tel).check_once()
+    assert r.extra_info["chip0_temp_c"] == "41.5"
+    assert r.extra_info["chip3_temp_c"] == "44.2"  # .1f formatting
+
+
+# -- HBM ECC ----------------------------------------------------------------
+
+def _hbm_component(tel, db=None):
+    inst = TpudInstance(
+        tpu_instance=MockBackend(),
+        db_rw=db,
+        event_store=EventStore(db) if db is not None else None,
+    )
+    c = TPUHbmComponent(inst)
+    c.sampler.telemetry = lambda: tel
+    return c
+
+
+def test_hbm_pending_flag_alone_is_unhealthy():
+    tel = _tel({0: {"hbm_ecc_pending": True}})
+    r = _hbm_component(tel).check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    assert RepairActionType.REBOOT_SYSTEM in r.suggested_actions.repair_actions
+
+
+def test_hbm_uncorrectable_count_alone_is_unhealthy():
+    tel = _tel({2: {"hbm_ecc_uncorrectable": 1}})
+    r = _hbm_component(tel).check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    assert "2" in r.reason
+
+
+def test_hbm_correctable_only_stays_healthy():
+    tel = _tel({0: {"hbm_ecc_correctable": 500}})
+    r = _hbm_component(tel).check_once()
+    assert r.health == HealthStateType.HEALTHY
+
+
+def test_hbm_event_recorded_once_while_pending(tmp_db):
+    tel = _tel({1: {"hbm_ecc_pending": True}})
+    c = _hbm_component(tel, db=tmp_db)
+    c.check_once()
+    c.check_once()  # still pending: must not insert a duplicate event
+    evs = [e for e in c.events(0) if e.name == "hbm_ecc_uncorrectable"]
+    assert len(evs) == 1
+    assert "chip(s) [1]" in evs[0].message
+
+
+def test_hbm_usage_pct_reported():
+    tel = _tel({0: {"hbm_used_bytes": 8 << 30}})
+    r = _hbm_component(tel).check_once()
+    assert r.extra_info["chip0_hbm_used_pct"] == "50.0"
+
+
+def test_hbm_zero_total_no_division():
+    t = TPUChipTelemetry(chip_id=0, hbm_total_bytes=0, hbm_used_bytes=0)
+    r = _hbm_component({0: t}).check_once()
+    assert r.health == HealthStateType.HEALTHY
+    assert "chip0_hbm_used_pct" not in r.extra_info
