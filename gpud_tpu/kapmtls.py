@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import re
 import shutil
 import time
 from dataclasses import dataclass
@@ -80,6 +81,9 @@ class Status:
 # dirfd loads complete; collected at the next install
 GC_GRACE_SECONDS = 60.0
 
+# strict staging suffix: <version>.(tmp|old)-<usec-stamp>
+_STAGING_RE = re.compile(r"\.(?:tmp|old)-(\d+)$")
+
 
 class CertManager:
     def __init__(self, root: str = DEFAULT_ROOT) -> None:
@@ -87,19 +91,13 @@ class CertManager:
         self.releases_dir = os.path.join(root, "releases")
         self.gc_grace_seconds = GC_GRACE_SECONDS
 
-    _STAGING_RE = None  # compiled lazily below
-
-    @classmethod
-    def _staging_stamp(cls, name: str) -> Optional[float]:
+    @staticmethod
+    def _staging_stamp(name: str) -> Optional[float]:
         """Unix time (seconds) a staging/old dir was created, parsed from
         its `<version>.(tmp|old)-<usec>` suffix — mtime is useless here
         (rename preserves the ORIGINAL install mtime, which would make a
         just-vacated dir look ancient and defeat the grace period)."""
-        import re
-
-        if cls._STAGING_RE is None:
-            cls._STAGING_RE = re.compile(r"\.(?:tmp|old)-(\d+)$")
-        m = cls._STAGING_RE.search(name)
+        m = _STAGING_RE.search(name)
         if m is None:
             return None
         return int(m.group(1)) / 1e6
@@ -125,10 +123,11 @@ class CertManager:
     def _release_dir(self, version: str) -> str:
         if not version or "/" in version or version.startswith("."):
             raise ValueError(f"invalid version {version!r}")
-        if self._staging_stamp(version) is not None:
-            # a version named like a staging dir would be silently
-            # garbage-collected later — reject at install time
-            raise ValueError(f"version {version!r} matches the staging-dir pattern")
+        if ".tmp-" in version or ".old-" in version:
+            # the same substring filter status() uses to hide staging
+            # dirs: anything installable must be visible in status() and
+            # never GC-eligible — reject the whole namespace up front
+            raise ValueError(f"version {version!r} uses the staging-dir namespace")
         return os.path.join(self.releases_dir, version)
 
     # -- install -----------------------------------------------------------

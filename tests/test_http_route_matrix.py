@@ -1,0 +1,136 @@
+"""HTTP API route × method/shape matrix over the shared live server —
+the routes test_server_e2e.py doesn't reach (/admin/*, /v1/plugins,
+query-param filters) plus wrong-method and response-shape contracts for
+every route (reference: pkg/server handler tests, SURVEY §2.5)."""
+
+import json
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def base(live_server):
+    return f"http://localhost:{live_server.port}"
+
+
+def _get(base, path):
+    req = urllib.request.Request(base + path)
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def _req(base, method, path, body=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        base + path, method=method, data=data,
+        headers={"Content-Type": "application/json"} if data else {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# -- every route answers its method ----------------------------------------
+
+ROUTES_GET = [
+    "/healthz", "/openapi.json", "/v1/components", "/v1/states",
+    "/v1/events", "/v1/metrics", "/v1/info", "/v1/plugins", "/metrics",
+    "/machine-info", "/admin/config", "/admin/packages",
+    "/v1/components/trigger-check?componentName=cpu",
+]
+
+
+@pytest.mark.parametrize("path", ROUTES_GET)
+def test_get_routes_answer(base, path):
+    status, body = _get(base, path)
+    assert status == 200, (path, status, body[:200])
+    assert body  # never an empty 200
+
+
+def test_admin_config_shape(base):
+    status, body = _get(base, "/admin/config")
+    d = json.loads(body)
+    assert status == 200
+    # the effective config must surface the knobs operators ask about
+    assert "port" in d and "data_dir" in d
+
+
+def test_admin_packages_shape(base):
+    status, body = _get(base, "/admin/packages")
+    assert status == 200
+    assert isinstance(json.loads(body), list)
+
+
+def test_plugins_route_empty_list(base):
+    status, body = _get(base, "/v1/plugins")
+    assert status == 200
+    assert json.loads(body) == []
+
+
+def test_states_component_filter(base):
+    status, body = _get(base, "/v1/states?components=cpu")
+    d = json.loads(body)
+    assert [c["component"] for c in d] == ["cpu"]
+
+
+def test_states_unknown_filter_empty(base):
+    status, body = _get(base, "/v1/states?components=nope")
+    assert status == 200
+    assert json.loads(body) == []
+
+
+def test_events_since_filter_parses(base):
+    status, _ = _get(base, "/v1/events?startTime=0")
+    assert status == 200
+    status, body = _get(base, "/v1/events?startTime=not-a-number")
+    assert status == 400, body
+
+
+def test_wrong_method_is_405_not_500(base):
+    status, _ = _req(base, "POST", "/healthz", {})
+    assert status == 405
+    status, _ = _req(base, "DELETE", "/v1/states")
+    assert status == 405
+
+
+def test_unknown_path_404(base):
+    status, _ = _get(base, "/v1/definitely-not-a-route")
+    assert status == 404
+
+
+def test_inject_fault_roundtrip_shape(base):
+    status, body = _req(
+        base, "POST", "/inject-fault",
+        {"tpu_error_name": "tpu_chip_lost", "chip_id": 1},
+    )
+    assert status == 200
+    assert json.loads(body).get("injected") is True
+
+
+def test_inject_fault_get_method_rejected(base):
+    status, _ = _get(base, "/inject-fault")
+    assert status == 405
+
+
+def test_prometheus_exposition_format(base):
+    _, body = _get(base, "/metrics")
+    # minimal exposition-format sanity: HELP/TYPE pairs, no blank metric names
+    assert "# HELP " in body and "# TYPE " in body
+    for ln in body.splitlines():
+        if ln and not ln.startswith("#"):
+            assert ln.split("{")[0].split(" ")[0], ln
+
+
+def test_openapi_covers_every_registered_route(base, live_server):
+    _, body = _get(base, "/openapi.json")
+    doc = json.loads(body)
+    paths = set(doc["paths"])
+    for p in ("/healthz", "/v1/states", "/v1/events", "/v1/metrics",
+              "/inject-fault", "/machine-info", "/admin/config"):
+        assert p in paths, f"{p} missing from openapi"

@@ -1,0 +1,217 @@
+"""Cloud provider detection matrix (reference: pkg/providers/* — per-cloud
+IMDS fetchers with fake transports). Every detector is driven with a fake
+IMDS, plus partial-metadata and failure-shape cases."""
+
+import json
+
+import pytest
+
+from gpud_tpu.providers import detect as det
+from gpud_tpu.providers.detect import (
+    DetectResult,
+    detect_aws,
+    detect_azure,
+    detect_gcp,
+    detect_metadata_mount,
+    detect_oci,
+)
+
+
+def _getter(routes):
+    """Fake IMDS transport: url-substring → response (str or Exception)."""
+
+    def get(url, headers, timeout=1.0):
+        for frag, resp in routes.items():
+            if frag in url:
+                if isinstance(resp, Exception):
+                    raise resp
+                return resp
+        raise OSError(f"unrouted {url}")
+
+    return get
+
+
+# -- GCP --------------------------------------------------------------------
+
+def test_gcp_tpu_vm_full():
+    g = _getter({
+        "instance/zone": "projects/12345/zones/us-east5-b",
+        "machine-type": "projects/12345/machineTypes/ct5p-hightpu-4t",
+        "accelerator-type": "v5p-256",
+        "tpu-env": "TPU_CHIPS_PER_HOST: '4'",
+    })
+    r = detect_gcp(get_fn=g)
+    assert r.provider == "gcp"
+    assert r.zone == "us-east5-b" and r.region == "us-east5"
+    assert r.instance_type == "ct5p-hightpu-4t"
+    assert r.accelerator_type == "v5p-256"
+    assert "tpu-env" in r.raw
+
+
+def test_gcp_non_tpu_vm_partial_attributes():
+    g = _getter({
+        "instance/zone": "projects/1/zones/europe-west4-a",
+        "machine-type": "projects/1/machineTypes/n2-standard-8",
+        # no accelerator attributes routed → OSError → tolerated
+    })
+    r = detect_gcp(get_fn=g)
+    assert r.provider == "gcp" and r.accelerator_type == ""
+    assert r.instance_type == "n2-standard-8"
+
+
+def test_gcp_absent_returns_none():
+    assert detect_gcp(get_fn=_getter({})) is None
+
+
+# -- AWS --------------------------------------------------------------------
+
+def test_aws_identity_document(monkeypatch):
+    monkeypatch.setattr(det, "_imds_v2_token", lambda: "tok-123")
+    seen_headers = {}
+
+    def g(url, headers, timeout=1.0):
+        seen_headers.update(headers)
+        assert "instance-identity/document" in url
+        return json.dumps({
+            "region": "us-west-2",
+            "availabilityZone": "us-west-2b",
+            "instanceType": "trn1.32xlarge",
+        })
+
+    r = detect_aws(get_fn=g)
+    assert r.provider == "aws" and r.region == "us-west-2"
+    assert r.instance_type == "trn1.32xlarge"
+    assert seen_headers.get("X-aws-ec2-metadata-token") == "tok-123"
+
+
+def test_aws_malformed_document_returns_none(monkeypatch):
+    monkeypatch.setattr(det, "_imds_v2_token", lambda: "")
+    r = detect_aws(get_fn=_getter({"instance-identity": "<html>error</html>"}))
+    assert r is None
+
+
+# -- Azure ------------------------------------------------------------------
+
+def test_azure_compute_document():
+    r = detect_azure(get_fn=_getter({
+        "metadata/instance/compute": json.dumps({
+            "location": "eastus2", "zone": "1", "vmSize": "ND96asr_v4",
+        })
+    }))
+    assert r.provider == "azure" and r.region == "eastus2"
+    assert r.zone == "1" and r.instance_type == "ND96asr_v4"
+
+
+def test_azure_absent_returns_none():
+    assert detect_azure(get_fn=_getter({})) is None
+
+
+# -- OCI --------------------------------------------------------------------
+
+def test_oci_v2_with_bearer_header():
+    seen = {}
+
+    def g(url, headers, timeout=1.0):
+        seen.update(headers)
+        if "canonicalRegionName" in url:
+            return "us-ashburn-1"
+        if "shape" in url:
+            return "BM.GPU4.8"
+        if "availabilityDomain" in url:
+            return "AD-1"
+        raise OSError("unrouted")
+
+    r = detect_oci(get_fn=g)
+    assert r.provider == "oci" and r.region == "us-ashburn-1"
+    assert r.instance_type == "BM.GPU4.8" and r.zone == "AD-1"
+    assert seen.get("Authorization") == "Bearer Oracle"
+
+
+def test_oci_partial_shape_tolerated():
+    def g(url, headers, timeout=1.0):
+        if "canonicalRegionName" in url:
+            return "eu-frankfurt-1"
+        raise OSError("unrouted")
+
+    r = detect_oci(get_fn=g)
+    assert r.provider == "oci" and r.instance_type == ""
+
+
+# -- metadata-mount clouds (nebius/nscale) ----------------------------------
+
+def test_metadata_mount_nebius(tmp_path):
+    (tmp_path / "parent-id").write_text("project-abc\n")
+    (tmp_path / "instance-id").write_text("computeinstance-xyz\n")
+    (tmp_path / "gpu-cluster-id").write_text("cluster-7\n")
+    r = detect_metadata_mount(root=str(tmp_path))
+    assert r.provider == "nebius"
+    assert r.raw["instance_id"] == "project-abc/cluster-7/computeinstance-xyz"
+
+
+def test_metadata_mount_nscale_marker(tmp_path):
+    (tmp_path / "parent-id").write_text("p\n")
+    (tmp_path / "instance-id").write_text("i\n")
+    (tmp_path / "org-id").write_text("org-9\n")
+    r = detect_metadata_mount(root=str(tmp_path))
+    assert r.provider == "nscale"
+
+
+def test_metadata_mount_incomplete_returns_none(tmp_path):
+    (tmp_path / "parent-id").write_text("p\n")  # no instance-id
+    assert detect_metadata_mount(root=str(tmp_path)) is None
+    assert detect_metadata_mount(root=str(tmp_path / "missing")) is None
+
+
+# -- aggregation ordering ----------------------------------------------------
+
+def test_detect_prefers_gcp_over_others(monkeypatch):
+    monkeypatch.setattr(
+        det, "DETECTORS",
+        [
+            lambda: DetectResult(provider="aws", region="us-west-2"),
+            lambda: DetectResult(provider="gcp", region="us-east5"),
+        ],
+    )
+    r = det.detect(timeout=5.0)
+    assert r.provider == "gcp"
+
+
+def test_detect_straggler_does_not_block(monkeypatch):
+    import time as _time
+
+    def slow():
+        _time.sleep(30)
+        return DetectResult(provider="aws")
+
+    monkeypatch.setattr(
+        det, "DETECTORS",
+        [slow, lambda: DetectResult(provider="oci", region="r")],
+    )
+    t0 = _time.time()
+    r = det.detect(timeout=3.0)
+    assert _time.time() - t0 < 10
+    assert r.provider == "oci"
+
+
+def test_detect_falls_back_to_asn(monkeypatch):
+    from gpud_tpu import asn as asnmod
+
+    monkeypatch.setattr(det, "DETECTORS", [lambda: None])
+
+    class Info:
+        provider = "hetzner"
+        asn = 24940
+        org = "Hetzner Online"
+
+    monkeypatch.setattr(asnmod, "lookup", lambda ip: Info())
+    r = det.detect(timeout=2.0)
+    assert r.provider == "hetzner"
+    assert r.raw["asn"] == "24940"
+
+
+def test_detect_unknown_when_everything_fails(monkeypatch):
+    from gpud_tpu import asn as asnmod
+
+    monkeypatch.setattr(det, "DETECTORS", [lambda: None])
+    monkeypatch.setattr(asnmod, "lookup", lambda ip: None)
+    assert det.detect(timeout=2.0).provider == "unknown"
