@@ -225,7 +225,10 @@ class CertManager:
     def activate(self, version: str) -> Optional[str]:
         """Atomic ``current`` symlink swap (symlink-at-temp-path + rename,
         reference: atomic release dirs + current symlink)."""
-        d = self._release_dir(version)
+        try:
+            d = self._release_dir(version)
+        except ValueError as e:
+            return str(e)  # same error-string contract as install()
         if not os.path.isdir(d):
             return f"release {version!r} not installed"
         if not self._release_ready(d):
@@ -240,9 +243,7 @@ class CertManager:
     @staticmethod
     def _version_key(v: str):
         """Natural ordering so v10 > v9 (lexicographic would invert them)."""
-        import re as _re
-
-        return [int(p) if p.isdigit() else p for p in _re.split(r"(\d+)", v)]
+        return [int(p) if p.isdigit() else p for p in re.split(r"(\d+)", v)]
 
     def rollback(self) -> Optional[str]:
         """Activate the newest release strictly older than current — a

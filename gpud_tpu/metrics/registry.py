@@ -8,6 +8,7 @@ scraper → SQLite pipeline.
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
@@ -143,6 +144,12 @@ class Registry:
 
 
 def _format_value(v: float) -> str:
+    # non-finite values per the exposition format — one inf/NaN gauge
+    # (e.g. a stray division) must not 500 the whole /metrics endpoint
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    if math.isnan(v):
+        return "NaN"
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
     return repr(v)

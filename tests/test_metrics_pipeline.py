@@ -1,0 +1,146 @@
+"""Metrics pipeline unit depth (reference: pkg/metrics{,/scraper,/store,
+/syncer} — 2935 test LoC): registry semantics, Prometheus exposition
+escaping/format, gather→store→read pipeline, syncer retention."""
+
+import threading
+
+from gpud_tpu.metrics.registry import Registry
+from gpud_tpu.metrics.store import MetricsStore, Syncer
+
+
+def test_gauge_set_get_per_labelset():
+    r = Registry()
+    g = r.gauge("g", "help")
+    g.set(1.0)
+    g.set(2.0, {"chip": "0"})
+    g.set(3.0, {"chip": "1"})
+    assert g.get() == 1.0
+    assert g.get({"chip": "0"}) == 2.0
+    assert g.get({"chip": "1"}) == 3.0
+    g.set(9.0, {"chip": "0"})  # overwrite, not accumulate
+    assert g.get({"chip": "0"}) == 9.0
+
+
+def test_counter_accumulates_and_never_needs_init():
+    r = Registry()
+    c = r.counter("c", "help")
+    assert c.get() == 0.0
+    c.inc()
+    c.inc(2.5, {"e": "x"})
+    c.inc(0.5, {"e": "x"})
+    assert c.get() == 1.0
+    assert c.get({"e": "x"}) == 3.0
+
+
+def test_same_name_returns_same_metric():
+    r = Registry()
+    a = r.gauge("dup", "h")
+    b = r.gauge("dup", "h")
+    assert a is b
+    a.set(5.0)
+    assert b.get() == 5.0
+
+
+def test_label_order_is_canonical():
+    r = Registry()
+    g = r.gauge("g", "h")
+    g.set(1.0, {"b": "2", "a": "1"})
+    assert g.get({"a": "1", "b": "2"}) == 1.0  # order-insensitive identity
+    out = r.render_prometheus()
+    assert 'g{a="1",b="2"} 1' in out  # rendered sorted
+
+
+def test_prometheus_escaping_label_values_and_help():
+    r = Registry()
+    g = r.gauge("esc", 'help with "quotes" and \\slash\nnewline')
+    g.set(1.0, {"path": 'C:\\dir "x"\nend'})
+    out = r.render_prometheus()
+    # label value escaping per exposition format
+    assert '\\"x\\"' in out
+    assert "\\n" in out
+    # HELP line must stay a single line
+    help_lines = [ln for ln in out.splitlines() if ln.startswith("# HELP esc")]
+    assert len(help_lines) == 1
+
+
+def test_float_formatting_stable():
+    r = Registry()
+    g = r.gauge("f", "h")
+    g.set(0.30000000000000004)
+    g.set(float("inf"), {"k": "i"})
+    out = r.render_prometheus()
+    assert "+Inf" in out or "inf" in out.lower()
+    g.set(float("nan"), {"k": "n"})
+    out = r.render_prometheus()
+    assert "NaN" in out or "nan" in out.lower()
+
+
+def test_remove_and_clear_labelsets():
+    r = Registry()
+    g = r.gauge("rm", "h")
+    g.set(1.0, {"chip": "0"})
+    g.set(2.0, {"chip": "1"})
+    g.remove({"chip": "0"})
+    assert g.get({"chip": "0"}) is None
+    assert g.get({"chip": "1"}) == 2.0
+    g.clear()
+    assert g.get({"chip": "1"}) is None
+
+
+def test_gather_rows_roundtrip_through_store(tmp_db):
+    r = Registry()
+    g = r.gauge("pipe_metric", "h")
+    g.set(42.5, {"chip": "3"})
+    rows = r.gather(now=1700000000.0)
+    store = MetricsStore(tmp_db)
+    store.record(rows)
+    got = store.read(0, name="pipe_metric")
+    assert len(got) == 1
+    m = got[0]
+    assert m.value == 42.5 and m.labels == {"chip": "3"}
+    assert m.unix_seconds == 1700000000
+
+
+def test_syncer_sync_once_and_retention(tmp_db):
+    r = Registry()
+    g = r.gauge("sync_metric", "h")
+    store = MetricsStore(tmp_db, retention_seconds=3600)
+    sy = Syncer(registry=r, store=store, interval_seconds=60)
+    g.set(1.0)
+    n1 = sy.sync_once()
+    assert n1 >= 1
+    g.set(2.0)
+    sy.sync_once()
+    vals = [m.value for m in store.read(0, name="sync_metric")]
+    assert vals.count(1.0) == 1 and vals.count(2.0) == 1
+
+
+def test_concurrent_metric_updates_no_corruption():
+    r = Registry()
+    c = r.counter("conc", "h")
+    g = r.gauge("conc_g", "h")
+
+    def work(tid):
+        for i in range(500):
+            c.inc(1.0, {"t": str(tid)})
+            g.set(float(i), {"t": str(tid)})
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for t in range(4):
+        assert c.get({"t": str(t)}) == 500.0
+        assert g.get({"t": str(t)}) == 499.0
+    # render under the final state never raises / truncates
+    out = r.render_prometheus()
+    assert out.count("conc{") == 4
+
+
+def test_unregister_removes_from_exposition():
+    r = Registry()
+    r.gauge("gone", "h").set(1.0)
+    assert "gone" in r.render_prometheus()
+    r.unregister("gone")
+    assert "gone" not in r.render_prometheus()

@@ -177,20 +177,28 @@ def test_detect_prefers_gcp_over_others(monkeypatch):
 
 
 def test_detect_straggler_does_not_block(monkeypatch):
+    import threading
     import time as _time
 
+    release = threading.Event()  # released in teardown so the abandoned
+    # worker never stalls interpreter exit (concurrent.futures joins
+    # non-daemon workers at atexit)
+
     def slow():
-        _time.sleep(30)
+        release.wait(30)
         return DetectResult(provider="aws")
 
     monkeypatch.setattr(
         det, "DETECTORS",
         [slow, lambda: DetectResult(provider="oci", region="r")],
     )
-    t0 = _time.time()
-    r = det.detect(timeout=3.0)
-    assert _time.time() - t0 < 10
-    assert r.provider == "oci"
+    try:
+        t0 = _time.time()
+        r = det.detect(timeout=3.0)
+        assert _time.time() - t0 < 10
+        assert r.provider == "oci"
+    finally:
+        release.set()
 
 
 def test_detect_falls_back_to_asn(monkeypatch):
