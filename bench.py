@@ -198,8 +198,34 @@ def bench_sysfs_ici_detection(trials: int = 12) -> None:
             os.environ["TPUD_ICI_SYSFS_ROOT"] = prior_ici_root
 
 
-def bench_tpu_scan() -> None:
-    """Exercise the accelerator-side ICI window scan (stderr report only)."""
+def bench_tpu_scan(max_seconds: float = 240.0) -> None:
+    """Exercise the accelerator-side ICI window scan (stderr report only).
+
+    Bounded: remote-accelerator client init / first compile can stall for
+    minutes on a degraded tunnel, and this optional bench runs BEFORE the
+    primary JSON line is printed — a hang here must not eat the whole
+    bench result."""
+    import threading
+
+    done = threading.Event()
+
+    def run():
+        try:
+            _bench_tpu_scan_inner()
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    if not done.wait(max_seconds):
+        print(
+            f"[bench] tpu scan abandoned after {max_seconds:.0f}s "
+            "(accelerator client stalled); continuing",
+            file=sys.stderr,
+        )
+
+
+def _bench_tpu_scan_inner() -> None:
     try:
         import numpy as np
         import jax
@@ -334,16 +360,23 @@ def bench_footprint(measure_seconds: float = 185.0) -> None:
 
 def main() -> int:
     res = bench_fault_detection()
-    bench_sysfs_ici_detection()
-    bench_footprint()
-    bench_tpu_scan()
+    # the secondary benches are stderr-only color; none may take down the
+    # primary JSON line
+    for secondary in (bench_sysfs_ici_detection, bench_footprint, bench_tpu_scan):
+        try:
+            secondary()
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] {secondary.__name__} failed: {e}", file=sys.stderr)
     p50 = res["p50_ms"]
+    # inf (nothing detected) must not leak into the JSON line — bare
+    # Infinity is not valid JSON; -1 signals a failed run numerically
+    finite = p50 not in (float("inf"), float("-inf")) and p50 == p50
     out = {
         "metric": "fault-detect p50 latency",
-        "value": round(p50, 2),
+        "value": round(p50, 2) if finite else -1.0,
         "unit": "ms",
         # reference gate: 1-minute component poll cadence (60_000 ms)
-        "vs_baseline": round(60000.0 / p50, 1) if p50 > 0 else 0.0,
+        "vs_baseline": round(60000.0 / p50, 1) if finite and p50 > 0 else 0.0,
     }
     print(json.dumps(out))
     return 0 if res["rate"] >= 1.0 else 1

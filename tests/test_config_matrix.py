@@ -1,0 +1,84 @@
+"""Config surface contract matrix (reference: pkg/config — 1460 test
+LoC over defaults/paths/env resolution)."""
+
+import os
+
+import pytest
+
+from gpud_tpu.config import (
+    Config,
+    DEFAULT_EVENTS_RETENTION,
+    DEFAULT_METRICS_RETENTION,
+    DEFAULT_PORT,
+    default_config,
+    resolve_data_dir,
+)
+
+
+def test_reference_parity_defaults():
+    cfg = Config()
+    # these numbers ARE the reference contract (SURVEY §6 cadence table)
+    assert DEFAULT_PORT == 15132
+    assert DEFAULT_METRICS_RETENTION == 3 * 3600
+    assert DEFAULT_EVENTS_RETENTION == 14 * 86400
+    assert cfg.compact_period_seconds == 0      # compact disabled by default
+    assert cfg.tls is True
+    assert cfg.enable_auto_update is True
+
+
+def test_resolve_data_dir_priority(monkeypatch, tmp_path):
+    # explicit arg > env > uid-based default
+    monkeypatch.setenv("TPUD_DATA_DIR", str(tmp_path / "env"))
+    assert resolve_data_dir(str(tmp_path / "arg")) == str(tmp_path / "arg")
+    assert resolve_data_dir("") == str(tmp_path / "env")
+    monkeypatch.delenv("TPUD_DATA_DIR")
+    d = resolve_data_dir("")
+    assert d in ("/var/lib/tpud", os.path.expanduser("~/.tpud"))
+
+
+def test_derived_paths_follow_data_dir(tmp_path):
+    cfg = Config(data_dir=str(tmp_path))
+    assert cfg.state_file() == str(tmp_path / "tpud.state")
+    assert cfg.fifo_file() == str(tmp_path / "tpud.fifo")
+    assert cfg.packages_dir() == str(tmp_path / "packages")
+    assert cfg.target_version_file() == str(tmp_path / "target_version")
+    assert cfg.resolved_plugin_specs_file() == str(tmp_path / "plugins.yaml")
+
+
+def test_in_memory_mode_state_file():
+    cfg = Config(db_in_memory=True)
+    assert cfg.state_file() == ":memory:"
+
+
+def test_explicit_plugin_specs_file_wins(tmp_path):
+    cfg = Config(data_dir=str(tmp_path), plugin_specs_file="/etc/tpud/p.yaml")
+    assert cfg.resolved_plugin_specs_file() == "/etc/tpud/p.yaml"
+
+
+@pytest.mark.parametrize(
+    "field,value,ok",
+    [
+        ("port", 0, True),           # ephemeral (tests)
+        ("port", 15132, True),
+        ("port", 65535, True),
+        ("port", 65536, False),
+        ("port", -1, False),
+        ("metrics_retention_seconds", 60, True),
+        ("metrics_retention_seconds", 59, False),
+        ("events_retention_seconds", 59, False),
+    ],
+)
+def test_validate_matrix(field, value, ok):
+    cfg = Config(**{field: value})
+    err = cfg.validate()
+    assert (err is None) == ok, (field, value, err)
+
+
+def test_default_config_applies_overrides():
+    cfg = default_config(port=0, tls=False, endpoint="https://cp")
+    assert cfg.port == 0 and cfg.tls is False and cfg.endpoint == "https://cp"
+
+
+def test_default_config_rejects_unknown_override():
+    with pytest.raises(AttributeError):
+        default_config(not_a_real_knob=True)

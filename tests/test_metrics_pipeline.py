@@ -106,13 +106,24 @@ def test_syncer_sync_once_and_retention(tmp_db):
     g = r.gauge("sync_metric", "h")
     store = MetricsStore(tmp_db, retention_seconds=3600)
     sy = Syncer(registry=r, store=store, interval_seconds=60)
+    clock = [1_700_000_000.0]
+    sy.time_now_fn = lambda: clock[0]
     g.set(1.0)
     n1 = sy.sync_once()
     assert n1 >= 1
     g.set(2.0)
+    clock[0] += 60
     sy.sync_once()
     vals = [m.value for m in store.read(0, name="sync_metric")]
     assert vals.count(1.0) == 1 and vals.count(2.0) == 1
+    # retention actually purges: advance past the window and sync again —
+    # the first sample ages out, the newer ones survive
+    clock[0] += 3600
+    g.set(3.0)
+    sy.sync_once()
+    vals = [m.value for m in store.read(0, name="sync_metric")]
+    assert 1.0 not in vals, "retention purge never ran"
+    assert 3.0 in vals
 
 
 def test_concurrent_metric_updates_no_corruption():

@@ -1,0 +1,153 @@
+"""Self-update lifecycle edges (reference: pkg/update — 2138 test LoC;
+the exit-code lifecycle e2e lives in test_subprocess_e2e; here the unit
+edges: file shapes, no-hook safety, hook failure, watcher behavior)."""
+
+import os
+import threading
+import time
+
+from gpud_tpu.update import (
+    ENV_UPDATE_HOOK,
+    VersionFileWatcher,
+    read_target_version,
+    write_target_version,
+)
+
+
+def test_version_file_atomic_write_and_trailing_newline(tmp_path):
+    p = tmp_path / "target_version"
+    write_target_version(str(p), "1.2.3")
+    assert p.read_text() == "1.2.3\n"
+    assert read_target_version(str(p)) == "1.2.3"
+    assert not (tmp_path / "target_version.tmp").exists()
+
+
+def test_missing_and_empty_version_file(tmp_path):
+    assert read_target_version(str(tmp_path / "nope")) == ""
+    p = tmp_path / "empty"
+    p.write_text("")
+    assert read_target_version(str(p)) == ""
+    # empty target never triggers
+    w = VersionFileWatcher(str(p), current_version="1.0")
+    assert w.check_once() is False
+
+
+def test_same_version_is_noop(tmp_path):
+    p = tmp_path / "tv"
+    write_target_version(str(p), "1.0")
+    fired = []
+    w = VersionFileWatcher(str(p), current_version="1.0", on_update=fired.append)
+    assert w.check_once() is False
+    assert fired == []
+
+
+def test_version_change_triggers_with_target(tmp_path):
+    p = tmp_path / "tv"
+    write_target_version(str(p), "2.0")
+    fired = []
+    w = VersionFileWatcher(str(p), current_version="1.0", on_update=fired.append)
+    assert w.check_once() is True
+    assert fired == ["2.0"]
+
+
+def test_downgrade_also_triggers(tmp_path):
+    # the watcher tracks the TARGET, not direction — rollbacks are updates
+    p = tmp_path / "tv"
+    write_target_version(str(p), "0.9")
+    fired = []
+    w = VersionFileWatcher(str(p), current_version="1.0", on_update=fired.append)
+    assert w.check_once() is True
+    assert fired == ["0.9"]
+
+
+def test_no_hook_never_exits_and_warns_once(tmp_path, monkeypatch, caplog):
+    """Without an install hook the watcher must NOT restart-exit (the
+    restarted process would be the same version — a permanent crash
+    loop), and the warning must not spam every 30s poll."""
+    monkeypatch.delenv(ENV_UPDATE_HOOK, raising=False)
+    p = tmp_path / "tv"
+    write_target_version(str(p), "9.9")
+    w = VersionFileWatcher(str(p), current_version="1.0")
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="tpud.update"):
+        assert w.check_once() is True  # triggered, but stayed alive
+        w.check_once()
+        w.check_once()
+    warns = [r for r in caplog.records if "is not set" in r.getMessage()]
+    assert len(warns) <= 1
+
+
+def test_hook_failure_stays_alive(tmp_path, monkeypatch):
+    hook = tmp_path / "hook.sh"
+    hook.write_text("#!/bin/bash\nexit 7\n")
+    monkeypatch.setenv(ENV_UPDATE_HOOK, str(hook))
+    p = tmp_path / "tv"
+    write_target_version(str(p), "3.0")
+    w = VersionFileWatcher(str(p), current_version="1.0")
+    # a failing hook must return (no os._exit) so the daemon keeps serving
+    assert w.check_once() is True
+
+
+def test_hook_receives_target_version_env(tmp_path, monkeypatch):
+    out = tmp_path / "seen"
+    hook = tmp_path / "hook.sh"
+    hook.write_text(f"#!/bin/bash\necho -n $TARGET_VERSION > {out}\nexit 1\n")
+    # exit 1: fail AFTER recording so the watcher doesn't os._exit the
+    # test process
+    monkeypatch.setenv(ENV_UPDATE_HOOK, str(hook))
+    p = tmp_path / "tv"
+    write_target_version(str(p), "4.2.0")
+    VersionFileWatcher(str(p), current_version="1.0").check_once()
+    assert out.read_text() == "4.2.0"
+
+
+def test_watcher_loop_fires_and_stops_promptly(tmp_path):
+    p = tmp_path / "tv"
+    fired = threading.Event()
+    w = VersionFileWatcher(
+        str(p), current_version="1.0",
+        on_update=lambda t: fired.set(), interval=0.05,
+    )
+    w.start()
+    try:
+        time.sleep(0.15)  # a few empty polls
+        assert not fired.is_set()
+        write_target_version(str(p), "5.0")
+        assert fired.wait(5)
+    finally:
+        t0 = time.time()
+        w.close()
+        assert time.time() - t0 < 2.0
+
+
+def test_watcher_loop_survives_on_update_exception(tmp_path):
+    p = tmp_path / "tv"
+    calls = []
+
+    def boom(target):
+        calls.append(target)
+        raise RuntimeError("installer bug")
+
+    w = VersionFileWatcher(
+        str(p), current_version="1.0", on_update=boom, interval=0.05
+    )
+    w.start()
+    try:
+        write_target_version(str(p), "6.0")
+        deadline = time.time() + 5
+        while len(calls) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        # the loop caught the exception and kept polling (>=2 attempts)
+        assert len(calls) >= 2
+    finally:
+        w.close()
+
+
+def test_env_interval_override_clamped(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUD_UPDATE_POLL_SECONDS", "0")
+    w = VersionFileWatcher(str(tmp_path / "tv"))
+    assert w.interval >= 0.25  # zero would busy-spin
+    monkeypatch.setenv("TPUD_UPDATE_POLL_SECONDS", "not-a-number")
+    w2 = VersionFileWatcher(str(tmp_path / "tv"))
+    assert w2.interval > 0
