@@ -1,0 +1,82 @@
+"""CRI protobuf wire-codec hostility (the hand-written codec parses
+bytes from an untrusted containerd socket; reference: k8s.io/cri-api via
+generated code — our codec must be at least as defensive)."""
+
+import pytest
+
+from gpud_tpu.cri import (
+    encode_field_bytes,
+    encode_field_str,
+    encode_field_varint,
+    encode_varint,
+    parse_message,
+)
+
+
+def test_varint_boundary_values():
+    for v in (0, 1, 127, 128, 300, 2**32, 2**63 - 1):
+        data = encode_field_varint(1, v)
+        fields = parse_message(data)
+        assert fields[1][0] == v
+
+
+def test_multiple_fields_and_repeats():
+    data = (
+        encode_field_str(1, "a")
+        + encode_field_str(1, "b")
+        + encode_field_varint(2, 7)
+    )
+    fields = parse_message(data)
+    assert [x.decode() for x in fields[1]] == ["a", "b"]
+    assert fields[2] == [7]
+
+
+def test_unknown_field_numbers_preserved_not_fatal():
+    data = encode_field_str(999, "future") + encode_field_varint(1, 5)
+    fields = parse_message(data)
+    assert fields[1] == [5]
+    assert fields[999][0] == b"future"
+
+
+@pytest.mark.parametrize(
+    "blob",
+    [
+        b"\xff" * 16,                      # endless varint continuation bits
+        b"\x0a\xff" + b"x" * 4,            # declared length 255, 4 bytes present
+        b"\x0a",                           # length-delimited tag, no length
+        encode_varint(1 << 40),            # bare varint, no tag semantics
+        b"\x0d\x01\x02",                   # 32-bit fixed wire type, truncated
+        b"\x09\x01",                       # 64-bit fixed wire type, truncated
+    ],
+)
+def test_hostile_blobs_raise_cleanly(blob):
+    # contract: ValueError (handled upstream), never IndexError/hang
+    with pytest.raises(ValueError):
+        parse_message(blob)
+
+
+def test_empty_message_is_empty_dict():
+    assert parse_message(b"") == {}
+
+
+def test_nested_message_roundtrip():
+    inner = encode_field_str(1, "id-1") + encode_field_varint(2, 1)
+    outer = encode_field_bytes(1, inner) + encode_field_bytes(1, inner)
+    fields = parse_message(outer)
+    assert len(fields[1]) == 2
+    nested = parse_message(fields[1][0])
+    assert nested[1][0] == b"id-1" and nested[2][0] == 1
+
+
+def test_huge_declared_length_does_not_allocate():
+    # declared length of ~1 GiB with 3 bytes present must fail fast, not
+    # attempt a giant slice/allocation
+    blob = b"\x0a" + encode_varint(1 << 30) + b"abc"
+    with pytest.raises(ValueError):
+        parse_message(blob)
+
+
+def test_non_utf8_string_fields_surface_as_bytes():
+    data = encode_field_bytes(1, b"\xff\xfe")
+    fields = parse_message(data)
+    assert fields[1][0] == b"\xff\xfe"
