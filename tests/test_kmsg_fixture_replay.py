@@ -1,0 +1,87 @@
+"""Checked-in kmsg log replay (reference: pkg/kmsg/testdata and
+xid/testdata check in real kernel logs and assert exact match sets).
+
+The fixture is a realistic v5p-VM boot log — benign boot noise that has
+historically false-positived (MCE replay, DMAR status, thermal trips,
+vfio enable lines) — followed by a correlated fault burst. The scan-mode
+path (read_all → catalog) must detect EXACTLY the burst, attribute the
+right classes, and stay silent on every boot line."""
+
+import os
+
+from gpud_tpu.components.tpu import catalog
+from gpud_tpu.kmsg.watcher import read_all
+
+FIXTURE = os.path.join(
+    os.path.dirname(__file__), "fixtures", "kmsg", "v5p_boot_with_faults.log"
+)
+
+EXPECTED = {
+    "tpu_vfio_aer",              # uncorrected AER on the vfio-bound TPU
+    "tpu_pcie_recovery_failed",  # root port gave up
+    "tpu_pcie_slot_link_down",   # hotplug slot lost the device
+    "tpu_dev_unbind_requested",  # vfio asked userspace to release it
+    "tpu_edac_uncorrectable",    # host DIMM UE in the same window
+    "tpu_runtime_oom_killed",    # runtime got OOM-killed in the fallout
+    "tpu_vfio_reset_recovery",   # device came back via BAR restore
+}
+
+
+def test_fixture_parses_fully():
+    msgs = read_all(path=FIXTURE)
+    assert len(msgs) == 25  # every line parses; nothing silently dropped
+    assert msgs[0].message.startswith("Linux version")
+    # fixture timestamps are monotonic (timestamp_us is pure fixture
+    # data; m.time would collapse to wall-clock when boot_time() is 0)
+    ts = [m.timestamp_us for m in msgs]
+    assert ts == sorted(ts)
+
+
+def test_exact_detection_set():
+    msgs = read_all(path=FIXTURE)
+    hits = {}
+    for m in msgs:
+        r = catalog.match(m.message)
+        if r is not None:
+            hits.setdefault(r.entry.name, []).append(m.message)
+    assert set(hits) == EXPECTED, (
+        f"missing={EXPECTED - set(hits)} unexpected={set(hits) - EXPECTED}"
+    )
+    # each class fired exactly once in this log
+    assert all(len(v) == 1 for v in hits.values()), hits
+
+
+def test_boot_section_is_silent():
+    msgs = read_all(path=FIXTURE)
+    # first minute since boot, in fixture time (timestamp_us)
+    boot = [m for m in msgs if (m.timestamp_us - msgs[0].timestamp_us) < 60e6]
+    assert len(boot) == 18  # the whole boot section, none of the burst
+    for m in boot:
+        r = catalog.match(m.message)
+        assert r is None, f"boot line misclassified as {r.entry.name}: {m.message!r}"
+
+
+def test_burst_classes_have_sane_severities():
+    by_name = {e.name: e for e in catalog.CATALOG}
+    # the chip-dropping classes must be reboot/hw-actionable
+    for name in ("tpu_vfio_aer", "tpu_pcie_recovery_failed",
+                 "tpu_pcie_slot_link_down"):
+        assert by_name[name].critical
+        assert by_name[name].repair_actions
+    # fallout records are informational, not health-flipping
+    for name in ("tpu_dev_unbind_requested", "tpu_runtime_oom_killed"):
+        assert not by_name[name].critical
+
+
+def test_scan_mode_component_over_fixture(monkeypatch):
+    """The error-kmsg component's scan path (no event store) reads the
+    whole fixture ring and reports the burst in one check."""
+    from gpud_tpu.components.base import TpudInstance
+    from gpud_tpu.components.tpu.error_kmsg import TPUErrorKmsgComponent
+
+    monkeypatch.setenv("TPUD_KMSG_FILE_PATH", FIXTURE)
+    c = TPUErrorKmsgComponent(TpudInstance())
+    r = c.check_once()
+    assert r.health != "Healthy"
+    for name in ("tpu_vfio_aer", "tpu_pcie_recovery_failed"):
+        assert name in r.reason or name in str(r.extra_info), (name, r.reason)
