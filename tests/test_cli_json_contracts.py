@@ -1,0 +1,119 @@
+"""Machine-readable CLI output contracts (`--json` surfaces are consumed
+by fleet tooling; their schemas are API, not cosmetics). In-process
+(argv → main) rather than subprocess so the suite stays fast; the
+subprocess e2e covers process-level wiring."""
+
+import json
+
+import pytest
+
+from gpud_tpu.cli import main
+
+
+def _run(capsys, argv):
+    code = main(argv)
+    out = capsys.readouterr().out
+    return code, out
+
+
+def test_scan_json_schema(capsys, tmp_path, monkeypatch):
+    kmsg = tmp_path / "kmsg"
+    kmsg.write_text("")
+    # cmd_scan exports the kmsg path into the env for scan-mode
+    # components; monkeypatch guarantees the key is restored so the
+    # in-process invocation can't leak into later tests
+    monkeypatch.setenv("TPUD_KMSG_FILE_PATH", str(kmsg))
+    code, out = _run(
+        capsys, ["scan", "--json", "--kmsg-path", str(kmsg)]
+    )
+    assert code == 0
+    doc = json.loads(out)  # stdout is pure JSON — no table mixed in
+    assert isinstance(doc, list) and doc
+    names = [r["component"] for r in doc]
+    assert "cpu" in names and "accelerator-tpu-ici" in names
+    for r in doc:
+        assert set(r) == {"component", "health", "reason", "extra_info"}
+        assert r["health"] in ("Healthy", "Degraded", "Unhealthy")
+        assert isinstance(r["extra_info"], dict)
+
+
+def test_scan_strict_exit_code(capsys, tmp_path, monkeypatch):
+    kmsg = tmp_path / "kmsg"
+    # a catalogued fatal line in the ring buffer → scan sees it
+    kmsg.write_text("2,1,1000,-;TPU-ERR: tpu_chip_lost chip=0\n")
+    monkeypatch.setenv("TPUD_KMSG_FILE_PATH", str(kmsg))
+    code, out = _run(
+        capsys, ["scan", "--json", "--strict", "--kmsg-path", str(kmsg)]
+    )
+    assert code == 1  # strict: unhealthy → non-zero for scripting
+    doc = json.loads(out)
+    kmsg_rows = [r for r in doc if r["component"] == "accelerator-tpu-error-kmsg"]
+    assert kmsg_rows[0]["health"] != "Healthy"
+    assert "tpu_chip_lost" in kmsg_rows[0]["reason"]
+
+
+def test_fleet_scan_json_schema(capsys, tmp_path):
+    # build two host DBs with ICI history, one containing a drop
+    from gpud_tpu.components.tpu.ici_store import ICIStore
+    from gpud_tpu.sqlite import DB
+    from gpud_tpu.tpu.instance import ICILinkSnapshot, LinkState
+
+    import time as _time
+
+    now = _time.time()
+    paths = []
+    for host, down in (("h1", False), ("h2", True)):
+        p = str(tmp_path / f"{host}.db")
+        db = DB(p)
+        store = ICIStore(db)
+        for i, ts in enumerate((now - 120, now - 60, now - 1)):
+            links = [
+                ICILinkSnapshot(
+                    chip_id=0, link_id=0,
+                    state=LinkState.DOWN if down and i == 1 else LinkState.UP,
+                )
+            ]
+            store.insert_snapshot(links, ts=ts)
+        db.close()
+        paths.append(p)
+    code, out = _run(capsys, ["fleet-scan", "--json", *paths])
+    assert code == 0
+    doc = json.loads(out)
+    assert set(doc) >= {"links", "summary", "devices"}
+    s = doc["summary"]
+    assert s["healthy"] + s["degraded"] + s["unhealthy"] == len(doc["links"])
+    # links is {"<host>/<link>": "healthy|degraded|unhealthy"}
+    flagged = {n: l for n, l in doc["links"].items() if l != "healthy"}
+    assert flagged and all("h2" in n for n in flagged)
+    assert all("h1" not in n for n in flagged)
+
+
+def test_machine_info_json(capsys):
+    code, out = _run(capsys, ["machine-info"])
+    assert code == 0
+    mi = json.loads(out)
+    assert mi["hostname"]
+    assert "block_devices" in mi and "containerized" in mi
+    assert isinstance(mi["tpu_info"]["chip_count"], int)
+    assert mi["tpu_info"]["chip_count"] > 0
+
+
+def test_metadata_json_empty_store(capsys, tmp_path):
+    code, out = _run(capsys, ["metadata", "--data-dir", str(tmp_path)])
+    assert code == 0
+    assert isinstance(json.loads(out), dict)
+
+
+def test_version_flag(capsys):
+    with pytest.raises(SystemExit) as ei:
+        main(["--version"])
+    assert ei.value.code == 0
+    from gpud_tpu.version import __version__
+
+    assert __version__ in capsys.readouterr().out
+
+
+def test_unknown_subcommand_exits_2(capsys):
+    with pytest.raises(SystemExit) as ei:
+        main(["frobnicate"])
+    assert ei.value.code == 2
