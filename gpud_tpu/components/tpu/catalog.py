@@ -325,11 +325,27 @@ CATALOG: List[CatalogEntry] = [
        EventType.CRITICAL,
        "PCIe completion timeout on TPU path",
        _REBOOT, reboot_threshold=2, exclude=_NON_TPU_DRIVERS),
+    # Kernel format: drivers/pci/pcie/dpc.c ("DPC: containment event,
+    # status:%#06x source:%#06x") — downstream port containment detaches
+    # the device below it (the TPU) until recovery
+    _e(64, "tpu_pcie_dpc_containment",
+       r"(pcieport .*DPC: (containment event|unmasked uncorrectable error detected)|TPU-ERR: tpu_pcie_dpc_containment)",
+       EventType.FATAL,
+       "PCIe downstream port containment — device detached pending recovery",
+       _REBOOT_HW, reboot_threshold=1, exclude=_NON_TPU_DRIVERS),
+    # second arm: verbatim bandwidth notification
+    # (drivers/pci/pci.c pcie_report_downtraining: "%u.%03u Gb/s available
+    # PCIe bandwidth, limited by %s x%d link at %s") — anchored to
+    # TPU-bound drivers ONLY: the core prints this line for EVERY
+    # downtrained device at enumeration with a bare "pci" prefix (a
+    # downtrained NIC would spam a TPU event every boot), so the generic
+    # form stays unmatched and only driver-attributed re-prints count
     _e(42, "tpu_pcie_link_downgrade",
-       r"(pcie.*(link.*(downgrad|degrad)|speed dropped|downtrain)|TPU-ERR: tpu_pcie_link_downgrade)",
+       r"(pcie.*(link.*(downgrad|degrad)|speed dropped|downtrain)|(vfio-pci|accel|apex) [0-9a-f:.]+:.*available PCIe bandwidth, limited by|TPU-ERR: tpu_pcie_link_downgrade)",
        EventType.WARNING,
        "PCIe link trained below expected speed/width",
-       _HW, reboot_threshold=2, critical=False),
+       _HW, reboot_threshold=2, critical=False,
+       exclude=_NON_TPU_DRIVERS),
     _e(41, "tpu_pcie_correctable",
        r"(pcieport.*AER.*correct|TPU-ERR: tpu_pcie_correctable)",
        EventType.WARNING,

@@ -121,7 +121,18 @@ ORGANIC = {
         "pcieport 0000:00:04.0: AER: Completion Timeout (First)"
     ],
     "tpu_pcie_link_downgrade": [
-        "pcie 0000:00:04.0: link speed dropped to 8.0 GT/s"
+        "pcie 0000:00:04.0: link speed dropped to 8.0 GT/s",
+        # verbatim: drivers/pci/pci.c pcie_report_downtraining, attributed
+        # to the TPU's bound driver (the bare "pci"-prefixed boot print
+        # fires for every downtrained device and is deliberately benign)
+        "vfio-pci 0000:00:05.0: 31.504 Gb/s available PCIe bandwidth, limited by "
+        "8.0 GT/s PCIe x4 link at 0000:00:03.0 (capable of 63.008 Gb/s with "
+        "16.0 GT/s PCIe x4 link)",
+    ],
+    "tpu_pcie_dpc_containment": [
+        # verbatim: drivers/pci/pcie/dpc.c
+        "pcieport 0000:00:03.0: DPC: containment event, status:0x1f01 source:0x0000",
+        "pcieport 0000:00:03.0: DPC: unmasked uncorrectable error detected",
     ],
     "tpu_pcie_correctable": [
         "pcieport 0000:00:04.0: AER: Corrected error received"
@@ -178,6 +189,8 @@ KERNEL_GROUNDED = {
     "tpu_vfio_aer_correctable",   # drivers/pci/pcie/aer.c (corrected severity)
     "tpu_pcie_recovery_failed",   # drivers/pci/pcie/err.c
     "tpu_pcie_slot_link_down",    # drivers/pci/hotplug/pciehp_ctrl.c
+    "tpu_pcie_dpc_containment",   # drivers/pci/pcie/dpc.c
+    "tpu_pcie_link_downgrade",    # drivers/pci/pci.c (bw notification arm)
     "tpu_dev_unbind_requested",   # drivers/vfio/pci/vfio_pci_core.c
     "tpu_vfio_reset_recovery",    # drivers/vfio/pci/vfio_pci_core.c
     "tpu_iommu_fault",            # drivers/iommu/{intel/dmar.c,amd/iommu.c}
@@ -241,6 +254,17 @@ BENIGN = [
     "Out of memory: Killed process 3452 (chrome) total-vm:8234kB, anon-rss:100kB",
     # AER recovery success is not a failure
     "pcieport 0000:00:04.0: AER: device recovery successful",
+    # bandwidth notifications not attributed to a TPU-bound driver must
+    # not classify — neither a named NIC nor the bare "pci"-prefixed
+    # enumeration print that fires for EVERY downtrained device at boot
+    "mlx5_core 0000:01:00.0: 63.008 Gb/s available PCIe bandwidth, limited by "
+    "8.0 GT/s PCIe x8 link at 0000:00:01.0",
+    "bnxt_en 0000:02:00.0: 31.504 Gb/s available PCIe bandwidth, limited by "
+    "8.0 GT/s PCIe x4 link at 0000:00:03.0",
+    "pci 0000:01:00.0: 31.504 Gb/s available PCIe bandwidth, limited by "
+    "8.0 GT/s PCIe x4 link at 0000:00:03.0",
+    # DPC on a port whose child is a known non-TPU device
+    "pcieport 0000:00:1c.5: nvme: DPC: containment event, status:0x1f01 source:0x0000",
 ]
 
 
