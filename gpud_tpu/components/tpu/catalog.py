@@ -326,13 +326,16 @@ CATALOG: List[CatalogEntry] = [
        "PCIe completion timeout on TPU path",
        _REBOOT, reboot_threshold=2, exclude=_NON_TPU_DRIVERS),
     # Kernel format: drivers/pci/pcie/dpc.c ("DPC: containment event,
-    # status:%#06x source:%#06x") — downstream port containment detaches
-    # the device below it (the TPU) until recovery
+    # status:%#06x source:%#06x"). The line names only the ROOT PORT —
+    # never the child device — so the catalog cannot tell a contained TPU
+    # from a contained NVMe/NIC. Same posture as the IOMMU entry:
+    # informational event trail for correlation; if the contained device
+    # WAS the TPU, chip-counts / ICI flip health when it detaches.
     _e(64, "tpu_pcie_dpc_containment",
        r"(pcieport .*DPC: (containment event|unmasked uncorrectable error detected)|TPU-ERR: tpu_pcie_dpc_containment)",
-       EventType.FATAL,
-       "PCIe downstream port containment — device detached pending recovery",
-       _REBOOT_HW, reboot_threshold=1, exclude=_NON_TPU_DRIVERS),
+       EventType.WARNING,
+       "PCIe downstream port containment (root-port attributed; correlate with chip loss)",
+       _NONE, reboot_threshold=0, critical=False),
     # second arm: verbatim bandwidth notification
     # (drivers/pci/pci.c pcie_report_downtraining: "%u.%03u Gb/s available
     # PCIe bandwidth, limited by %s x%d link at %s") — anchored to

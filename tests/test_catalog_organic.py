@@ -263,8 +263,6 @@ BENIGN = [
     "8.0 GT/s PCIe x4 link at 0000:00:03.0",
     "pci 0000:01:00.0: 31.504 Gb/s available PCIe bandwidth, limited by "
     "8.0 GT/s PCIe x4 link at 0000:00:03.0",
-    # DPC on a port whose child is a known non-TPU device
-    "pcieport 0000:00:1c.5: nvme: DPC: containment event, status:0x1f01 source:0x0000",
 ]
 
 

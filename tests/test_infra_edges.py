@@ -1,0 +1,215 @@
+"""Infrastructure-module edges: TLS cert generation, netutil measurement
+math, ASN parsing, log setup + audit logger behavior (reference: pkg/log,
+pkg/netutil, pkg/asn unit suites)."""
+
+import json
+import logging
+import logging.handlers
+import socket
+import ssl
+import threading
+
+import pytest
+
+from gpud_tpu import asn as asnmod
+from gpud_tpu import netutil
+from gpud_tpu.log import AuditLogger
+from gpud_tpu.server import tls as tlsmod
+
+
+# -- TLS --------------------------------------------------------------------
+
+def test_self_signed_cert_usable_for_tls():
+    cert_path, key_path = tlsmod.generate_self_signed("unit.tpud.local")
+    ctx = tlsmod.server_ssl_context(cert_path, key_path)
+    assert isinstance(ctx, ssl.SSLContext)
+    # a real TLS handshake against a one-shot server proves the pair works
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+
+    def accept():
+        conn, _ = srv.accept()
+        try:
+            ctx.wrap_socket(conn, server_side=True)
+        except ssl.SSLError:
+            pass  # client aborts after handshake — fine
+
+    t = threading.Thread(target=accept, daemon=True)
+    t.start()
+    client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client.check_hostname = False
+    client.verify_mode = ssl.CERT_NONE
+    with socket.create_connection(("127.0.0.1", port), timeout=5) as raw:
+        with client.wrap_socket(raw, server_hostname="unit.tpud.local") as s:
+            assert s.version() is not None  # handshake completed
+    srv.close()
+
+
+def test_self_signed_certs_are_unique():
+    c1, k1 = tlsmod.generate_self_signed()
+    c2, k2 = tlsmod.generate_self_signed()
+    assert open(c1).read() != open(c2).read()  # fresh keypair per boot
+    assert open(k1).read() != open(k2).read()
+    import os as _os
+
+    assert _os.stat(k1).st_mode & 0o777 == 0o600  # key is private
+
+
+def test_cert_contains_common_name():
+    from cryptography import x509
+
+    cert_path, _ = tlsmod.generate_self_signed("cn.example")
+    cert = x509.load_pem_x509_certificate(open(cert_path, "rb").read())
+    assert "cn.example" in cert.subject.rfc4514_string()
+    # SAN covers localhost for the local API client
+    san = cert.extensions.get_extension_for_class(x509.SubjectAlternativeName)
+    assert "localhost" in san.value.get_values_for_type(x509.DNSName)
+
+
+# -- netutil ----------------------------------------------------------------
+
+def test_private_ip_is_an_address():
+    ip = netutil.private_ip()
+    assert ip == "" or len(ip.split(".")) == 4 or ":" in ip
+
+
+def test_port_probe_against_real_listener():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    try:
+        assert netutil.is_port_open("127.0.0.1", port, timeout=2)
+        rtt = netutil.tcp_rtt_ms("127.0.0.1", port, timeout=2)
+        assert rtt is not None and 0 <= rtt < 2000
+    finally:
+        srv.close()
+    assert not netutil.is_port_open("127.0.0.1", port, timeout=0.5)
+    assert netutil.tcp_rtt_ms("127.0.0.1", port, timeout=0.5) is None
+
+
+def test_measure_edges_mixed_reachability():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    try:
+        res = netutil.measure_edges(
+            [("local", "127.0.0.1", port), ("dead", "127.0.0.1", 1)],
+            timeout=0.5,
+        )
+    finally:
+        srv.close()
+    assert res["local"] is not None
+    assert res["dead"] is None
+
+
+# -- ASN --------------------------------------------------------------------
+
+def test_asn_lookup_shapes():
+    payload = {
+        "network": {
+            "autonomous_system": {
+                "asn": 396982, "organization": "GOOGLE-CLOUD-PLATFORM",
+            }
+        }
+    }
+    info = asnmod.lookup("8.8.8.8", fetch_fn=lambda url: payload)
+    assert info is not None
+    assert info.asn == 396982
+    assert "google" in info.provider.lower() or info.org
+
+
+def test_asn_lookup_handles_partial_and_none():
+    assert asnmod.lookup("1.2.3.4", fetch_fn=lambda url: None) is None
+    info = asnmod.lookup("1.2.3.4", fetch_fn=lambda url: {"network": {}})
+    assert info is None or info.asn == 0
+
+
+# -- audit logger ------------------------------------------------------------
+
+def test_audit_logger_writes_ndjson(tmp_path):
+    path = tmp_path / "audit.log"
+    a = AuditLogger(str(path))
+    a.log("reboot", requested_by="session", delay=5)
+    a.log("set_healthy", component="cpu")
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 2
+    rec = json.loads(lines[0])
+    assert rec["action"] == "reboot"
+    assert rec["requested_by"] == "session"
+    assert "ts" in rec or "time" in rec
+
+
+def test_audit_logger_nop_without_path():
+    a = AuditLogger("")
+    a.log("anything", x=1)  # must not raise
+
+
+def test_audit_logger_concurrent_writes_line_atomic(tmp_path):
+    path = tmp_path / "audit.log"
+    a = AuditLogger(str(path))
+
+    def work(tid):
+        for i in range(100):
+            a.log("stress", tid=tid, i=i)
+
+    threads = [threading.Thread(target=work, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    lines = path.read_text().strip().splitlines()
+    assert len(lines) == 400
+    for ln in lines:
+        json.loads(ln)  # every line is a complete record — no interleaving
+
+
+# -- log setup ---------------------------------------------------------------
+
+def _flush_file_handlers():
+    # only the file handlers matter here; a stream handler may point at a
+    # pytest-captured stream an earlier test already closed
+    for h in logging.getLogger("tpud").handlers:
+        if isinstance(h, logging.FileHandler):
+            h.flush()
+
+
+def test_log_setup_configure_once_semantics(tmp_path, monkeypatch):
+    """setup() attaches handlers exactly once; later calls only adjust
+    the level (the daemon calls it at boot and again on updateConfig).
+    Run against a pristine logger state, restored afterwards."""
+    import gpud_tpu.log as logmod
+
+    root = logging.getLogger("tpud")
+    saved_handlers = root.handlers[:]
+    saved_level = root.level
+    saved_configured = logmod._configured
+    try:
+        root.handlers = []
+        monkeypatch.setattr(logmod, "_configured", False)
+        logfile = tmp_path / "tpud.log"
+        logmod.setup(level="debug", log_file=str(logfile))
+        lg = logmod.get_logger("tpud.unit-test")
+        lg.debug("debug-visible")
+        _flush_file_handlers()
+        assert "debug-visible" in logfile.read_text()
+        rotating = [
+            h for h in root.handlers
+            if isinstance(h, logging.handlers.RotatingFileHandler)
+        ]
+        assert len(rotating) == 1  # lumberjack-style rotation attached
+
+        # second setup: level changes, NO second handler appears
+        logmod.setup(level="info", log_file=str(tmp_path / "other.log"))
+        assert len(root.handlers) == 1
+        lg.debug("debug-hidden")
+        _flush_file_handlers()
+        assert "debug-hidden" not in logfile.read_text()
+        assert not (tmp_path / "other.log").exists()
+    finally:
+        root.handlers = saved_handlers
+        root.setLevel(saved_level)
+        logmod._configured = saved_configured
