@@ -470,22 +470,43 @@ class MatchedError:
 
 # Hot-loop prefilter: the matcher runs on EVERY kernel log line (reference
 # hot loop #2, SURVEY §3.1), and a healthy host's lines match nothing — a
-# single coarse-token scan rejects them without walking all 45 patterns
-# (~40x cheaper on benign lines). Every catalog pattern's alternatives are
-# anchored by at least one of these tokens; tests assert the invariant
-# over the full organic-line corpus.
+# single coarse-token scan rejects them without walking all 56 patterns.
+# Every catalog pattern's alternatives are anchored by at least one of
+# these tokens; tests assert the invariant over the full organic-line
+# corpus. The scan itself runs in the native library when present
+# (native/tpud_native.cpp tpud_prefilter_match — a case-folded substring
+# sweep, no regex engine per line); the regex below is the fallback and
+# the parity oracle.
+PREFILTER_TOKENS = [
+    "tpu", "accel", "gasket", "apex", "ici", "interchip", "hbm", "ecc",
+    "edac", "mce", "machine", "pcie", "aer", "dmar", "amd-vi", "iommu",
+    "megascale", "dcn", "slice", "vrm", "voltage", "power", "sram",
+    "scalar", "tensor", "correctable", "memory", "row remap", "vfio",
+]
 _PREFILTER = re.compile(
-    r"tpu|accel|gasket|apex|ici|interchip|hbm|ecc|edac|mce|machine"
-    r"|pcie|aer|dmar|amd-vi|iommu|megascale|dcn|slice|vrm|voltage"
-    r"|power|sram|scalar|tensor|correctable|memory|row remap|vfio",
-    re.IGNORECASE,
+    "|".join(re.escape(t) for t in PREFILTER_TOKENS), re.IGNORECASE
 )
+
+try:  # arm the native fast path (absence is fine)
+    from gpud_tpu import native as _native
+
+    _NATIVE_PREFILTER = _native.prefilter_init(PREFILTER_TOKENS)
+except Exception:  # noqa: BLE001
+    _NATIVE_PREFILTER = False
+
+
+def _prefilter_hit(line: str) -> bool:
+    if _NATIVE_PREFILTER:
+        hit = _native.prefilter_match(line)
+        if hit is not None:
+            return hit
+    return _PREFILTER.search(line) is not None
 
 
 def match(line: str) -> Optional[MatchedError]:
     """Match one kmsg line against the catalog (first hit wins; catalog is
     ordered most-specific-first within each class)."""
-    if _PREFILTER.search(line) is None:
+    if not _prefilter_hit(line):
         return None
     for entry in CATALOG:
         if entry.pattern.search(line):

@@ -90,6 +90,15 @@ def load() -> Optional[ctypes.CDLL]:
             lib.tpud_deduper_len.argtypes = [ctypes.c_void_p]
         except AttributeError:
             continue
+        # newer optional symbols: a stale .so keeps every fast path it
+        # DOES have — missing ones simply stay on the Python fallback
+        try:
+            lib.tpud_prefilter_init.restype = ctypes.c_int
+            lib.tpud_prefilter_init.argtypes = [ctypes.c_char_p]
+            lib.tpud_prefilter_match.restype = ctypes.c_int
+            lib.tpud_prefilter_match.argtypes = [ctypes.c_char_p]
+        except AttributeError:
+            logger.info("native library lacks the prefilter (older build)")
         _LIB = lib
         logger.info("native library loaded from %s", path)
         return _LIB
@@ -196,3 +205,38 @@ class NativeDeduper:
             self._lib.tpud_deduper_free(self._h)
         except Exception:  # noqa: BLE001
             pass
+
+
+# -- catalog prefilter ---------------------------------------------------------
+
+_PREFILTER_READY = False
+
+
+def prefilter_init(tokens: List[str]) -> bool:
+    """Push the catalog's coarse-token set into the native scanner.
+    Returns True when the native prefilter is armed."""
+    global _PREFILTER_READY
+    lib = load()
+    if lib is None or not hasattr(lib, "tpud_prefilter_init") or not tokens:
+        # an EMPTY token set must not arm the native side: zero views
+        # would reject every line, the opposite of the empty-regex
+        # fallback semantics
+        _PREFILTER_READY = False
+        return False
+    n = lib.tpud_prefilter_init("\n".join(tokens).encode("utf-8"))
+    _PREFILTER_READY = n == len(tokens)
+    return _PREFILTER_READY
+
+
+def prefilter_match(line: str) -> Optional[bool]:
+    """Native coarse scan; None when unavailable (caller falls back to
+    the Python regex)."""
+    if not _PREFILTER_READY:
+        return None
+    lib = _LIB
+    if lib is None:
+        return None
+    try:
+        return bool(lib.tpud_prefilter_match(line.encode("utf-8", "replace")))
+    except Exception:  # noqa: BLE001 — fall back, never drop a line
+        return None

@@ -20,6 +20,7 @@
 #include <string>
 #include <unordered_map>
 #include <utility>
+#include <vector>
 
 extern "C" {
 
@@ -162,6 +163,62 @@ int tpud_deduper_seen(void* handle, const char* key, double now) {
 
 int64_t tpud_deduper_len(void* handle) {
   return static_cast<int64_t>(static_cast<TpudDeduper*>(handle)->seen.size());
+}
+
+// ---------------------------------------------------------------------------
+// 4. Catalog prefilter — case-insensitive multi-token substring scan.
+//    Runs on EVERY kernel log line (reference hot loop #2): a healthy
+//    host's lines match no token, and this coarse scan rejects them
+//    before the 56-pattern catalog walk. Token set is pushed once from
+//    gpud_tpu/components/tpu/catalog.py (single source of truth); the
+//    Python regex stays as the fallback and the parity oracle.
+// ---------------------------------------------------------------------------
+
+struct TpudPrefilter {
+  std::string tokens;                 // backing store (lowercased)
+  std::vector<std::pair<const char*, size_t>> views;
+};
+
+static TpudPrefilter* g_prefilter = nullptr;
+
+// tokens: '\n'-separated list; replaces any previous set
+int tpud_prefilter_init(const char* tokens) {
+  if (!tokens) return 0;
+  auto* p = new TpudPrefilter();
+  p->tokens.assign(tokens);
+  for (char& c : p->tokens) {
+    if (c >= 'A' && c <= 'Z') c += 32;
+  }
+  size_t start = 0;
+  const std::string& t = p->tokens;
+  while (start <= t.size()) {
+    size_t nl = t.find('\n', start);
+    size_t end = (nl == std::string::npos) ? t.size() : nl;
+    if (end > start) p->views.emplace_back(t.data() + start, end - start);
+    if (nl == std::string::npos) break;
+    start = nl + 1;
+  }
+  delete g_prefilter;
+  g_prefilter = p;
+  return static_cast<int>(p->views.size());
+}
+
+// returns 1 when any token occurs in the line (case-insensitive)
+int tpud_prefilter_match(const char* line) {
+  if (!g_prefilter || !line) return 1;  // uninitialized: never drop lines
+  // lowercase once into a bounded stack buffer; kmsg lines are <= 8KiB
+  char buf[8192];
+  size_t n = 0;
+  for (; n + 1 < sizeof(buf) && line[n]; ++n) {
+    char c = line[n];
+    buf[n] = (c >= 'A' && c <= 'Z') ? static_cast<char>(c + 32) : c;
+  }
+  buf[n] = '\0';
+  if (line[n] != '\0') return 1;  // truncated: be permissive, never drop
+  for (const auto& v : g_prefilter->views) {
+    if (v.second <= n && memmem(buf, n, v.first, v.second) != nullptr) return 1;
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -199,3 +199,93 @@ def test_default_deduper_prefers_native(tmp_db):
     # and the Syncer default picks it up
     s = Syncer(lambda ln: None, EventStore(tmp_db).bucket("x"))
     assert type(s.deduper) is type(d)
+
+
+def test_native_prefilter_parity_with_regex():
+    """The native token sweep and the Python regex must agree on every
+    line — organic corpus, benign corpus, and randomized noise."""
+    import random
+    import string
+
+    from gpud_tpu import native
+    from gpud_tpu.components.tpu import catalog
+    from tests.test_catalog_organic import BENIGN, ORGANIC
+
+    if not native.prefilter_init(catalog.PREFILTER_TOKENS):
+        import pytest
+
+        pytest.skip("native library unavailable")
+    lines = [ln for lns in ORGANIC.values() for ln in lns] + list(BENIGN)
+    rng = random.Random(7)
+    lines += [
+        "".join(rng.choices(string.printable[:-5], k=rng.randint(0, 200)))
+        for _ in range(500)
+    ]
+    lines += ["", "ACCEL0 UPPER", "mixed Vfio-Pci case"]
+    for ln in lines:
+        native_hit = native.prefilter_match(ln)
+        regex_hit = catalog._PREFILTER.search(ln) is not None
+        assert native_hit == regex_hit, ln[:120]
+    # beyond the native lowercase buffer the contract weakens to
+    # "never stricter": truncated lines pass permissively
+    assert native.prefilter_match("x" * 9000) is True
+
+
+def test_prefilter_never_hides_a_catalog_match():
+    """Invariant: every line the 56-entry catalog matches passes the
+    prefilter (both implementations) — the coarse scan may only reject
+    true negatives."""
+    from gpud_tpu.components.tpu import catalog
+    from tests.test_catalog_organic import ORGANIC
+
+    for name, lns in ORGANIC.items():
+        for ln in lns:
+            assert catalog._prefilter_hit(ln), (name, ln)
+            assert catalog.match(ln) is not None, (name, ln)
+
+
+def test_native_prefilter_uninitialized_is_permissive():
+    """An unarmed native prefilter must never drop lines (returns None →
+    caller falls back to the regex)."""
+    from gpud_tpu import native
+
+    if native.load() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    native._PREFILTER_READY = False
+    try:
+        assert native.prefilter_match("anything") is None
+    finally:
+        from gpud_tpu.components.tpu import catalog
+
+        native.prefilter_init(catalog.PREFILTER_TOKENS)
+
+
+def test_native_prefilter_truncation_is_permissive():
+    """A line longer than the native lowercase buffer must pass the
+    prefilter (be handed to the catalog), never be silently dropped —
+    even when its only token sits past the truncation point."""
+    from gpud_tpu import native
+    from gpud_tpu.components.tpu import catalog
+
+    if not native.prefilter_init(catalog.PREFILTER_TOKENS):
+        import pytest
+
+        pytest.skip("native library unavailable")
+    long_line = "x" * 8500 + " uncorrectable HBM ECC error"
+    assert native.prefilter_match(long_line) is True
+    assert catalog.match(long_line) is not None  # end-to-end still detects
+
+
+def test_native_prefilter_empty_tokens_not_armed():
+    from gpud_tpu import native
+    from gpud_tpu.components.tpu import catalog
+
+    if native.load() is None:
+        import pytest
+
+        pytest.skip("native library unavailable")
+    assert native.prefilter_init([]) is False
+    assert native.prefilter_match("anything") is None  # falls back
+    assert native.prefilter_init(catalog.PREFILTER_TOKENS) is True
