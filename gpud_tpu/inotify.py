@@ -11,6 +11,7 @@ from __future__ import annotations
 import errno
 import os
 import select
+import time
 from typing import Optional
 
 
@@ -28,6 +29,7 @@ class InotifyWatch:
         self.ifd = ifd
         self._libc = libc
         self._mask = mask
+        self._closed = False
         self._poller = select.poll()
         self._poller.register(ifd, select.POLLIN)
 
@@ -53,6 +55,8 @@ class InotifyWatch:
 
     def add_path(self, path: str) -> bool:
         """Watch an additional path on the same instance (informer trees)."""
+        if self._closed:
+            return False
         try:
             return self._libc.inotify_add_watch(self.ifd, path.encode(), self._mask) >= 0
         except Exception:  # noqa: BLE001
@@ -60,20 +64,42 @@ class InotifyWatch:
 
     def wait(self, timeout_ms: int) -> bool:
         """Block until the file is modified (or timeout); drains the event
-        queue. Returns True when an event arrived."""
+        queue. Returns True when an event arrived.
+
+        Threading contract: ``close()`` must be called from the thread
+        that waits (both consumers — the kmsg tail and the package
+        informer — do exactly that). The ``_closed`` guard below is a
+        misuse backstop, NOT cross-thread synchronization: a truly
+        concurrent close-mid-wait cannot be made safe at this layer (the
+        kernel may recycle the fd number between check and read). The
+        backstop sleeps out the timeout so a violated contract degrades
+        to latency, never to an EBADF crash or a 100% busy-spin of the
+        consumer loop."""
+        if self._closed:
+            time.sleep(timeout_ms / 1000.0)
+            return False
         events = self._poller.poll(timeout_ms)
         if not events:
+            return False
+        if self._closed:
+            time.sleep(timeout_ms / 1000.0)
             return False
         try:
             while True:
                 if not os.read(self.ifd, 4096):
                     break
         except OSError as e:
+            if e.errno == errno.EBADF:
+                self._closed = True  # fd gone: every later wait sleeps
+                return False
             if e.errno not in (errno.EAGAIN, errno.EWOULDBLOCK):
                 raise
         return True
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         try:
             os.close(self.ifd)
         except OSError:

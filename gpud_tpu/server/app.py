@@ -362,6 +362,10 @@ def build_app(srv: "Server") -> web.Application:
     r.add_get("/v1/components", list_components)
     r.add_delete("/v1/components", deregister_component)
     r.add_get("/v1/components/trigger-check", trigger_check)
+    # reference parity: a dedicated trigger-tag route exists alongside
+    # trigger-check (pkg/server/handlers_components.go:20-31); both land
+    # on the same handler here, which dispatches on the query params
+    r.add_get("/v1/components/trigger-tag", trigger_check)
     r.add_post("/v1/components/set-healthy", set_healthy)
     r.add_get("/v1/states", states)
     r.add_get("/v1/events", events)

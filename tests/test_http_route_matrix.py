@@ -134,3 +134,16 @@ def test_openapi_covers_every_registered_route(base, live_server):
     for p in ("/healthz", "/v1/states", "/v1/events", "/v1/metrics",
               "/inject-fault", "/machine-info", "/admin/config"):
         assert p in paths, f"{p} missing from openapi"
+
+
+def test_trigger_tag_route_parity(base):
+    # reference parity: dedicated trigger-tag route
+    status, body = _get(base, "/v1/components/trigger-tag?tagName=host")
+    assert status == 200
+    triggered = json.loads(body)
+    assert triggered  # host-tagged components exist
+    status, _ = _get(base, "/v1/components/trigger-tag?tagName=nope")
+    assert status == 404
+    # and it appears in the generated openapi
+    _, body = _get(base, "/openapi.json")
+    assert "/v1/components/trigger-tag" in json.loads(body)["paths"]
