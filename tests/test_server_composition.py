@@ -1,0 +1,109 @@
+"""Server composition-root invariants (reference: pkg/server/server.go:117
+— the assembly order and the flags that reshape it)."""
+
+import os
+import stat
+
+import pytest
+
+from gpud_tpu.config import default_config
+from gpud_tpu.server.server import Server
+
+
+def _cfg(tmp_path, **kw):
+    kmsg = tmp_path / "kmsg"
+    kmsg.write_text("")
+    kw.setdefault("data_dir", str(tmp_path / "data"))
+    kw.setdefault("port", 0)
+    kw.setdefault("tls", False)
+    kw.setdefault("kmsg_path", str(kmsg))
+    kw.setdefault("components_disabled", ["network-latency"])
+    return default_config(**kw)
+
+
+def test_components_enabled_allowlist(tmp_path):
+    cfg = _cfg(tmp_path, components_enabled=["cpu", "memory", "os"])
+    s = Server(config=cfg)
+    try:
+        s.start()
+        names = {c.name() for c in s.registry.all()}
+        assert names == {"cpu", "memory", "os"}
+    finally:
+        s.stop()
+
+
+def test_components_disabled_removed(tmp_path):
+    cfg = _cfg(tmp_path, components_disabled=["cpu", "network-latency"])
+    s = Server(config=cfg)
+    try:
+        s.start()
+        names = {c.name() for c in s.registry.all()}
+        assert "cpu" not in names
+        assert "memory" in names
+    finally:
+        s.stop()
+
+
+def test_token_fifo_created_as_fifo_and_recreated(tmp_path):
+    cfg = _cfg(tmp_path)
+    # poison the path with a REGULAR file; boot must replace it
+    os.makedirs(cfg.resolved_data_dir(), exist_ok=True)
+    with open(cfg.fifo_file(), "w") as f:
+        f.write("not a fifo")
+    s = Server(config=cfg)
+    try:
+        s.start()
+        st = os.stat(cfg.fifo_file())
+        assert stat.S_ISFIFO(st.st_mode)
+    finally:
+        s.stop()
+
+
+def test_state_file_lives_in_data_dir(tmp_path):
+    cfg = _cfg(tmp_path)
+    s = Server(config=cfg)
+    try:
+        s.start()
+        assert os.path.isfile(os.path.join(cfg.resolved_data_dir(), "tpud.state"))
+    finally:
+        s.stop()
+
+
+def test_boot_is_reentrant_safe_against_double_start(tmp_path):
+    s = Server(config=_cfg(tmp_path))
+    try:
+        s.start()
+        port = s.port
+        s.start()  # second start must not double-register or rebind
+        assert s.port == port
+        names = [c.name() for c in s.registry.all()]
+        assert len(names) == len(set(names))
+    finally:
+        s.stop()
+
+
+def test_stop_is_idempotent(tmp_path):
+    s = Server(config=_cfg(tmp_path))
+    s.start()
+    s.stop()
+    s.stop()  # second stop must not raise
+
+
+def test_metrics_syncer_running_after_boot(tmp_path):
+    import time
+
+    s = Server(config=_cfg(tmp_path))
+    try:
+        s.start()
+        s.metrics_syncer.sync_once()
+        rows = s.metrics_store.read(time.time() - 60)
+        assert rows  # components registered gauges and the pipe works
+    finally:
+        s.stop()
+
+
+def test_invalid_config_refuses_boot(tmp_path):
+    cfg = _cfg(tmp_path)
+    cfg.metrics_retention_seconds = 1  # below validate() floor
+    with pytest.raises(Exception):
+        Server(config=cfg).start()
