@@ -202,7 +202,12 @@ class Server:
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         """Start pollers + API listener (non-blocking; reference spawns
-        goroutines at server.go:390-450)."""
+        goroutines at server.go:390-450). Idempotent: a second start on a
+        running server is a no-op — re-running the assembly would leak a
+        duplicate fifo watcher and crash a second serve loop against the
+        already-bound port."""
+        if self._thread is not None and self._thread.is_alive():
+            return
         for comp in self.registry.all():
             if comp.name() in self.supported_names:
                 comp.start()

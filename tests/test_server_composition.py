@@ -18,6 +18,10 @@ def _cfg(tmp_path, **kw):
     kw.setdefault("tls", False)
     kw.setdefault("kmsg_path", str(kmsg))
     kw.setdefault("components_disabled", ["network-latency"])
+    # default_config inherits TPUD_ENDPOINT/TPUD_TOKEN from the env
+    # (containerized enrollment); unit tests must never dial out
+    kw.setdefault("endpoint", "")
+    kw.setdefault("token", "")
     return default_config(**kw)
 
 
@@ -69,13 +73,18 @@ def test_state_file_lives_in_data_dir(tmp_path):
         s.stop()
 
 
-def test_boot_is_reentrant_safe_against_double_start(tmp_path):
+def test_double_start_is_a_noop(tmp_path):
+    import threading
+
     s = Server(config=_cfg(tmp_path))
     try:
         s.start()
         port = s.port
-        s.start()  # second start must not double-register or rebind
+        threads_before = threading.active_count()
+        s.start()  # idempotent: no second serve loop, no duplicate watchers
         assert s.port == port
+        assert threading.active_count() == threads_before
+        assert s._start_error is None
         names = [c.name() for c in s.registry.all()]
         assert len(names) == len(set(names))
     finally:
@@ -92,12 +101,22 @@ def test_stop_is_idempotent(tmp_path):
 def test_metrics_syncer_running_after_boot(tmp_path):
     import time
 
-    s = Server(config=_cfg(tmp_path))
+    from gpud_tpu.metrics.registry import Registry
+
+    # a FRESH registry: the assertion must prove THIS server's components
+    # populated it, not gauges leaked into the process-global default by
+    # earlier tests
+    reg = Registry()
+    s = Server(config=_cfg(tmp_path), metrics_registry=reg)
     try:
         s.start()
-        s.metrics_syncer.sync_once()
-        rows = s.metrics_store.read(time.time() - 60)
-        assert rows  # components registered gauges and the pipe works
+        deadline = time.time() + 10
+        rows = []
+        while not rows and time.time() < deadline:
+            s.metrics_syncer.sync_once()
+            rows = s.metrics_store.read(time.time() - 60)
+            time.sleep(0.1)
+        assert rows, "no component gauges reached the store"
     finally:
         s.stop()
 
@@ -105,5 +124,5 @@ def test_metrics_syncer_running_after_boot(tmp_path):
 def test_invalid_config_refuses_boot(tmp_path):
     cfg = _cfg(tmp_path)
     cfg.metrics_retention_seconds = 1  # below validate() floor
-    with pytest.raises(Exception):
-        Server(config=cfg).start()
+    with pytest.raises(ValueError, match="metrics retention"):
+        Server(config=cfg)
