@@ -208,19 +208,27 @@ class Server:
         already-bound port."""
         if self._thread is not None and self._thread.is_alive():
             return
-        for comp in self.registry.all():
-            if comp.name() in self.supported_names:
-                comp.start()
-        self.kmsg_watcher.start()
-        self.event_store.start_purger()
-        self.metrics_syncer.start()
-        self.self_metrics.start()
-        self.package_manager.start()
-        if self.update_watcher is not None:
-            self.update_watcher.start()
-        self._reapply_config_overrides()
-        self._maybe_start_session()
-        self._start_token_fifo()
+        # retry-after-failed-start: clear the stale listener verdict so a
+        # successful rebind isn't condemned by the previous error, and
+        # never re-run the component/watcher assembly (their own start()
+        # methods are idempotent, but the fifo watcher's is a thread)
+        self._started.clear()
+        self._start_error = None
+        if not getattr(self, "_assembled", False):
+            self._assembled = True
+            for comp in self.registry.all():
+                if comp.name() in self.supported_names:
+                    comp.start()
+            self.kmsg_watcher.start()
+            self.event_store.start_purger()
+            self.metrics_syncer.start()
+            self.self_metrics.start()
+            self.package_manager.start()
+            if self.update_watcher is not None:
+                self.update_watcher.start()
+            self._reapply_config_overrides()
+            self._maybe_start_session()
+            self._start_token_fifo()
 
         self._thread = threading.Thread(
             target=self._serve, name="tpud-http", daemon=True

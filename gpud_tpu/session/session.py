@@ -93,9 +93,15 @@ class Frame:
     def __init__(self, req_id: str = "", data: Optional[dict] = None) -> None:
         self.req_id = req_id
         self.data = data or {}
+        self._encoded: Optional[str] = None
 
     def to_json(self) -> str:
-        return json.dumps({"req_id": self.req_id, "data": self.data})
+        # cached: the serve loop encodes once to validate serializability;
+        # the transport writer reuses that encoding (frames are not
+        # mutated after construction)
+        if self._encoded is None:
+            self._encoded = json.dumps({"req_id": self.req_id, "data": self.data})
+        return self._encoded
 
     @classmethod
     def from_json(cls, line: str) -> Optional["Frame"]:
@@ -140,6 +146,9 @@ class Session:
         self._connected = threading.Event()
         self.reconnect_count = 0
         self.last_connect_error: str = ""
+        # injectable like jitter_fn/time_sleep_fn: tests shrink it so the
+        # full-queue path doesn't cost 5s of wall clock per probe
+        self.send_timeout = 5.0
         # auth-failure classification (reference: session_reconnect.go
         # 38-226): a revoked token parks the reconnect loop instead of
         # hammering the control plane with the normal backoff forever
@@ -295,16 +304,31 @@ class Session:
                 frame = self.reader.get(timeout=0.5)
             except queue.Empty:
                 continue
+            if frame is None:  # sentinel/garbage must not kill the loop
+                continue
             try:
                 resp = self.dispatch_fn(frame.data)
             except Exception as e:  # noqa: BLE001
                 logger.exception("request dispatch failed")
                 resp = {"error": str(e)}
-            self.send(Frame(req_id=frame.req_id, data=resp))
+            # a dispatcher bug returning non-JSON-serializable data must
+            # become an error response HERE — discovered later inside the
+            # transport writer it would crash the pump mid-stream instead.
+            # to_json() caches, so the writer pays no second serialization.
+            out = Frame(req_id=frame.req_id, data=resp)
+            try:
+                out.to_json()
+            except (TypeError, ValueError):
+                logger.exception("dispatch result not serializable")
+                out = Frame(
+                    req_id=frame.req_id,
+                    data={"error": "internal: dispatch result not serializable"},
+                )
+            self.send(out)
 
     def send(self, frame: Frame) -> bool:
         try:
-            self.writer.put(frame, timeout=5.0)
+            self.writer.put(frame, timeout=self.send_timeout)
             return True
         except queue.Full:
             logger.warning("session writer channel full; dropping frame")

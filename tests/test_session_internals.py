@@ -1,0 +1,141 @@
+"""Session serve-loop internals beyond the transport e2e (reference:
+pkg/session — 12,949 test LoC over the injectable-function seams)."""
+
+import queue
+import threading
+import time
+
+from gpud_tpu.session.session import Frame, Session
+
+
+def _session(dispatch, **kw):
+    kw.setdefault("endpoint", "http://127.0.0.1:1")
+    kw.setdefault("machine_id", "m-int")
+    kw.setdefault("jitter_fn", lambda b: 0.01)
+    return Session(dispatch_fn=dispatch, **kw)
+
+
+# -- Frame wire shape -------------------------------------------------------
+
+def test_frame_rejects_every_wrong_shape():
+    for bad in (
+        "",
+        "not json",
+        "[1,2]",
+        '"just a string"',
+        "42",
+        '{"data": {}}',            # missing req_id entirely is tolerated?
+    ):
+        f = Frame.from_json(bad)
+        # contract: None OR a frame with dict data — never an exception,
+        # never non-dict data reaching the dispatcher
+        assert f is None or isinstance(f.data, dict)
+
+
+def test_frame_roundtrip_preserves_unicode_and_nesting():
+    f = Frame(req_id="r-ü", data={"nested": {"链": [1, {"x": None}]}})
+    again = Frame.from_json(f.to_json())
+    assert again.req_id == "r-ü"
+    assert again.data == {"nested": {"链": [1, {"x": None}]}}
+
+
+def test_frame_to_json_single_line():
+    # the wire is ndjson: embedded newlines in payload must stay escaped
+    f = Frame(req_id="r", data={"msg": "line1\nline2"})
+    assert "\n" not in f.to_json()
+
+
+# -- serve loop -------------------------------------------------------------
+
+def test_serve_responds_in_request_order():
+    seen = []
+    s = _session(lambda req: {"i": req["i"]})
+    s.start_reader_fn = None  # not connecting; drive queues directly
+    t = threading.Thread(target=s._serve, daemon=True)
+    t.start()
+    try:
+        for i in range(10):
+            s.reader.put(Frame(req_id=f"r{i}", data={"method": "x", "i": i}))
+        deadline = time.time() + 5
+        while len(seen) < 10 and time.time() < deadline:
+            try:
+                fr = s.writer.get(timeout=0.2)
+                seen.append(fr)
+            except queue.Empty:
+                pass
+        assert [f.req_id for f in seen] == [f"r{i}" for i in range(10)]
+        assert [f.data["i"] for f in seen] == list(range(10))
+    finally:
+        s._stop.set()
+        s.reader.put(None)  # unblock
+
+
+def test_serve_survives_non_serializable_dispatch_result():
+    """A dispatcher bug returning non-JSON-serializable data must produce
+    an error response, not kill the serve loop."""
+
+    class Weird:
+        pass
+
+    results = iter([{"bad": Weird()}, {"ok": True}])
+    s = _session(lambda req: next(results))
+    t = threading.Thread(target=s._serve, daemon=True)
+    t.start()
+    try:
+        s.reader.put(Frame(req_id="r1", data={"method": "x"}))
+        s.reader.put(Frame(req_id="r2", data={"method": "x"}))
+        got = {}
+        deadline = time.time() + 5
+        while len(got) < 2 and time.time() < deadline:
+            try:
+                fr = s.writer.get(timeout=0.2)
+                got[fr.req_id] = fr.data
+            except queue.Empty:
+                pass
+        assert "r2" in got and got["r2"] == {"ok": True}, got
+        # r1 must come back as a structured error — discovered at serve
+        # time, not later inside the transport writer
+        assert "r1" in got and "error" in got["r1"], got
+    finally:
+        s._stop.set()
+        s.reader.put(None)
+
+
+def test_send_backpressure_returns_false_when_full():
+    s = _session(lambda req: {})
+    s.send_timeout = 0.05  # injectable seam; default is 5s
+    # fill the writer channel to its cap
+    sent = 0
+    while s.send(Frame(req_id=f"f{sent}", data={})):
+        sent += 1
+        assert sent < 10_000, "writer queue appears unbounded"
+    assert sent > 0
+    assert s.send(Frame(req_id="overflow", data={})) is False
+
+
+def test_drain_reader_discards_stale_frames():
+    s = _session(lambda req: {})
+    for i in range(5):
+        s.reader.put(Frame(req_id=f"stale{i}", data={}))
+    s._drain_reader()
+    assert s.reader.empty()
+
+
+def test_stop_from_parked_state_is_prompt():
+    """Drive the REAL park path: the connect raises an auth-classified
+    error, _park_on_auth_failure engages, and stop() from inside the
+    park loop is prompt."""
+
+    def rejecting_connect():
+        raise RuntimeError("HTTP 401 unauthorized: token revoked")
+
+    s = _session(lambda req: {}, token="revoked")
+    s._connect = rejecting_connect
+    s.start()
+    deadline = time.time() + 5
+    while not s.auth_failed and time.time() < deadline:
+        time.sleep(0.01)
+    assert s.auth_failed, "park path never engaged"
+    t0 = time.time()
+    s.stop()
+    assert time.time() - t0 < 3.0
