@@ -77,3 +77,14 @@ def live_server(tmp_path_factory):
     s.start()
     yield s
     s.stop()
+
+
+def write_pstore_dump(dir_path, name, content, mtime=None):
+    """Stage a pstore crash-dump fixture (shared by the pstore suites)."""
+    import os as _os
+
+    p = dir_path / name
+    p.write_text(content)
+    if mtime is not None:
+        _os.utime(str(p), (mtime, mtime))
+    return str(p)

@@ -1,0 +1,107 @@
+"""Login/enrollment edges (reference: pkg/login — 2548 test LoC:
+machine-id overwrite semantics, label namespacing, rejection shapes)."""
+
+import json
+
+import pytest
+
+from gpud_tpu import metadata as md
+from gpud_tpu.login import NODE_LABEL_PREFIX, login, normalize_node_labels
+from gpud_tpu.metadata import Metadata
+
+
+def _login(tmp_db, body, labels=None, token="join-tok", endpoint="https://cp"):
+    captured = {}
+
+    def post(url, req_body):
+        captured["url"] = url
+        captured["body"] = req_body
+        return body
+
+    meta = Metadata(tmp_db)
+    resp = login(endpoint, token, meta, node_labels=labels, post_fn=post)
+    return resp, meta, captured
+
+
+def test_machine_id_overwrite_semantics(tmp_db):
+    meta = Metadata(tmp_db)
+    meta.set(md.KEY_MACHINE_ID, "local-id")
+    captured = {}
+
+    def post(url, req_body):
+        captured["body"] = req_body
+        return {"machine_id": "cp-assigned-7", "token": "sess", "machine_proof": "p"}
+
+    resp = login("https://cp", "join-tok", meta, post_fn=post)
+    # the request announced the LOCAL id; the response REPLACED it
+    assert captured["body"]["machine_id"] == "local-id"
+    assert resp.machine_id == "cp-assigned-7"
+    assert meta.get(md.KEY_MACHINE_ID) == "cp-assigned-7"
+    assert meta.get(md.KEY_TOKEN) == "sess"
+    assert meta.get(md.KEY_MACHINE_PROOF) == "p"
+
+
+def test_missing_optional_response_fields_keep_local_state(tmp_db):
+    meta = Metadata(tmp_db)
+    meta.set(md.KEY_MACHINE_ID, "keep-me")
+    resp = login(
+        "https://cp", "join-tok", meta,
+        post_fn=lambda u, b: {},  # bare-bones manager
+    )
+    assert meta.get(md.KEY_MACHINE_ID) == "keep-me"  # no overwrite without id
+    assert meta.get(md.KEY_TOKEN) == "join-tok"       # join token persisted
+
+
+def test_rejection_raises_and_persists_nothing(tmp_db):
+    meta = Metadata(tmp_db)
+    with pytest.raises(RuntimeError, match="revoked"):
+        login(
+            "https://cp", "bad", meta,
+            post_fn=lambda u, b: {"error": "token revoked"},
+        )
+    assert not meta.get(md.KEY_TOKEN)
+    assert not meta.get(md.KEY_LOGIN_SUCCESS_TS)
+
+
+def test_url_and_endpoint_normalization(tmp_db):
+    _, meta, cap = _login(
+        tmp_db, {"machine_id": "m", "token": "t"},
+        endpoint="https://cp.example/",
+    )
+    assert cap["url"] == "https://cp.example/api/v1/login"
+    assert meta.get(md.KEY_ENDPOINT) == "https://cp.example/"
+
+
+def test_node_labels_namespaced_and_persisted(tmp_db):
+    _, meta, cap = _login(
+        tmp_db, {"machine_id": "m", "token": "t"},
+        labels={"pool": "tpu-a", NODE_LABEL_PREFIX + "explicit": "kept"},
+    )
+    sent = cap["body"]["node_labels"]
+    assert sent[NODE_LABEL_PREFIX + "pool"] == "tpu-a"
+    assert sent[NODE_LABEL_PREFIX + "explicit"] == "kept"  # no double prefix
+    stored = json.loads(meta.get(md.KEY_NODE_LABELS))
+    assert set(stored) == set(sent)
+
+
+def test_request_carries_machine_info_tree(tmp_db):
+    _, _, cap = _login(tmp_db, {"machine_id": "m", "token": "t"})
+    mi = cap["body"]["machine_info"]
+    assert mi["hostname"]
+    assert "block_devices" in mi  # the round-3 depth rides the wire
+
+
+def test_normalize_node_labels_empty():
+    # the populated-dict cases live in test_manager_update_login.py
+    assert normalize_node_labels({}) == {}
+
+
+def test_transport_error_propagates(tmp_db):
+    meta = Metadata(tmp_db)
+
+    def post(url, body):
+        raise OSError("connection reset by control plane")
+
+    with pytest.raises(OSError):
+        login("https://cp", "t", meta, post_fn=post)
+    assert not meta.get(md.KEY_LOGIN_SUCCESS_TS)

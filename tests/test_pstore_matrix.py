@@ -1,0 +1,114 @@
+"""pstore crash-dump classification matrix (reference: pkg/pstore —
+1441 test LoC over real dump fixtures)."""
+
+import os
+
+import pytest
+
+from gpud_tpu.pstore import PstoreHistory, read_crash_files
+
+PANIC_DUMP = """\
+<6>[  100.000000] systemd[1]: started something
+<0>[  245.123456] Kernel panic - not syncing: Fatal exception in interrupt
+<0>[  245.123999] CPU: 3 PID: 0 Comm: swapper/3 Tainted: G W
+<0>[  245.124500] Call Trace:
+"""
+
+OOPS_DUMP = """\
+<4>[  881.000000] BUG: unable to handle page fault for address: ffffdead
+<4>[  881.000100] Oops: 0002 [#1] PREEMPT SMP NOPTI
+<4>[  881.000200] RIP: 0010:tpu_dma_complete+0x24/0x90 [google_tpu]
+"""
+
+GPF_DUMP = "<1>[ 12.0] general protection fault, probably for non-canonical address\n"
+
+HARD_LOCKUP_DUMP = "<0>[ 55.5] watchdog: hard LOCKUP on cpu 7\n"
+
+BENIGN_DUMP = """\
+<6>[    1.000000] Linux version 6.1.0
+<6>[    2.000000] systemd[1]: Reached target basic.target
+"""
+
+
+from tests.conftest import write_pstore_dump as _write
+
+
+@pytest.mark.parametrize(
+    "content,kind,token",
+    [
+        (PANIC_DUMP, "panic", "Kernel panic"),
+        (OOPS_DUMP, "oops", "BUG:"),
+        (GPF_DUMP, "oops", "general protection fault"),
+        (HARD_LOCKUP_DUMP, "oops", "hard LOCKUP"),
+    ],
+)
+def test_classification_matrix(tmp_path, content, kind, token):
+    _write(tmp_path, "dmesg-efi-172000000001", content)
+    recs = read_crash_files(str(tmp_path))
+    assert len(recs) == 1
+    assert recs[0].kind == kind
+    assert token.lower() in recs[0].excerpt.lower()
+
+
+def test_benign_dump_is_unknown_with_head_excerpt(tmp_path):
+    _write(tmp_path, "dmesg-efi-172000000002", BENIGN_DUMP)
+    recs = read_crash_files(str(tmp_path))
+    assert recs[0].kind == "unknown"
+    assert "Linux version" in recs[0].excerpt  # head fallback, not empty
+
+
+def test_non_crash_files_ignored(tmp_path):
+    _write(tmp_path, "pmsg-ramoops-0", "userspace junk")
+    _write(tmp_path, "notes.txt", "operator notes")
+    _write(tmp_path, "console-ramoops-0", PANIC_DUMP)
+    recs = read_crash_files(str(tmp_path))
+    assert [os.path.basename(r.path) for r in recs] == ["console-ramoops-0"]
+
+
+def test_ordering_by_mtime_and_nested_dirs(tmp_path):
+    sub = tmp_path / "196000000" / "000"
+    sub.mkdir(parents=True)
+    _write(tmp_path, "dmesg-efi-2", OOPS_DUMP, mtime=2000)
+    _write(sub, "dmesg-efi-1", PANIC_DUMP, mtime=1000)
+    recs = read_crash_files(str(tmp_path))
+    assert [r.kind for r in recs] == ["panic", "oops"]  # oldest first
+
+
+def test_excerpt_caps_at_five_matches(tmp_path):
+    many = "".join(f"<0>[ {i}.0] BUG: repeated fault {i}\n" for i in range(50))
+    _write(tmp_path, "dmesg-efi-9", many)
+    recs = read_crash_files(str(tmp_path))
+    assert len(recs[0].excerpt.splitlines()) == 5
+
+
+def test_env_override_and_missing_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPUD_PSTORE_DIR", str(tmp_path / "nope"))
+    assert read_crash_files() == []
+    monkeypatch.setenv("TPUD_PSTORE_DIR", str(tmp_path))
+    _write(tmp_path, "dmesg-efi-1", PANIC_DUMP)
+    assert len(read_crash_files()) == 1
+
+
+def test_history_dedupes_across_restarts(tmp_path, tmp_db):
+    _write(tmp_path, "dmesg-efi-1", PANIC_DUMP, mtime=1000)
+    h1 = PstoreHistory(tmp_db)
+    fresh = h1.record_new(read_crash_files(str(tmp_path)))
+    assert len(fresh) == 1
+    # daemon restart: same dump, no new report
+    h2 = PstoreHistory(tmp_db)
+    assert h2.record_new(read_crash_files(str(tmp_path))) == []
+    # the kernel rewrites the dump (new mtime) → a NEW crash
+    _write(tmp_path, "dmesg-efi-1", PANIC_DUMP, mtime=2000)
+    assert len(h2.record_new(read_crash_files(str(tmp_path)))) == 1
+    assert len(h2.all()) == 2
+
+
+def test_unreadable_file_skipped(tmp_path):
+    p = _write(tmp_path, "dmesg-efi-3", PANIC_DUMP)
+    os.chmod(p, 0o000)
+    try:
+        if os.geteuid() == 0:
+            pytest.skip("root ignores file modes")
+        assert read_crash_files(str(tmp_path)) == []
+    finally:
+        os.chmod(p, 0o644)
