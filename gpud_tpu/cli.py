@@ -464,6 +464,89 @@ def cmd_notify(args) -> int:
     return 0
 
 
+def cmd_manager(args) -> int:
+    """Standalone dev control plane (manager/control_plane.py): serve a
+    manager process, or drive one over its operator API."""
+    import json as _json
+
+    if args.manager_cmd == "serve":
+        import signal
+        import threading
+
+        from gpud_tpu.manager.control_plane import ControlPlane
+
+        cp = ControlPlane(
+            port=args.port,
+            grpc_port=args.grpc_port,
+            session_token=args.session_token or None,
+            admin_token=args.admin_token or None,
+        )
+        cp.start()
+        print(
+            _json.dumps(
+                {
+                    "endpoint": cp.endpoint,
+                    "grpc_port": cp.grpc_port,
+                    "instance_id": cp.instance_id,
+                }
+            ),
+            flush=True,
+        )
+        stop = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
+        stop.wait()
+        cp.stop()
+        return 0
+
+    # operator subcommands speak the manager's HTTP API
+    import requests
+
+    try:
+        return _manager_operator_cmd(args, requests, _json)
+    except Exception as e:  # noqa: BLE001 - CLI boundary: no tracebacks
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def _manager_operator_cmd(args, requests, _json) -> int:
+    headers = {}
+    if args.admin_token:
+        headers["Authorization"] = f"Bearer {args.admin_token}"
+    base = args.endpoint.rstrip("/")
+    if args.manager_cmd == "machines":
+        r = requests.get(f"{base}/v1/machines", headers=headers, timeout=10)
+        if r.status_code != 200:
+            print(f"error {r.status_code}: {r.text}", file=sys.stderr)
+            return 1
+        print(_json.dumps(r.json(), indent=2))
+        return 0
+    if args.manager_cmd == "request":
+        body = {}
+        if args.params:
+            params = _json.loads(args.params)
+            if not isinstance(params, dict):
+                print("--params must be a JSON object", file=sys.stderr)
+                return 2
+            body.update(params)
+        # the positional method always wins over a "method" key smuggled
+        # into --params
+        body["method"] = args.method
+        r = requests.post(
+            f"{base}/v1/machines/{args.machine_id}/request",
+            json=body,
+            headers=headers,
+            params={"timeout": str(args.timeout)},
+            timeout=args.timeout + 10,
+        )
+        if r.status_code != 200:
+            print(f"error {r.status_code}: {r.text}", file=sys.stderr)
+            return 1
+        print(_json.dumps(r.json(), indent=2))
+        return 0
+    return 2
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="tpud", description="TPU fleet-health monitoring daemon"
@@ -596,6 +679,29 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common_flags(pn)
     pn.add_argument("phase", choices=["startup", "shutdown"])
     pn.set_defaults(fn=cmd_notify, audited=True)
+
+    pmg = sub.add_parser(
+        "manager", help="standalone dev control plane (serve / drive)"
+    )
+    msub = pmg.add_subparsers(dest="manager_cmd", required=True)
+    ms = msub.add_parser("serve", help="run a manager process")
+    ms.add_argument("--port", type=int, default=15135)
+    ms.add_argument("--grpc-port", type=int, default=15136)
+    ms.add_argument("--session-token", default="")
+    ms.add_argument("--admin-token", default="")
+    ms.set_defaults(fn=cmd_manager)
+    mm = msub.add_parser("machines", help="list connected agents")
+    mm.add_argument("--endpoint", default="http://127.0.0.1:15135")
+    mm.add_argument("--admin-token", default="")
+    mm.set_defaults(fn=cmd_manager)
+    mr = msub.add_parser("request", help="issue one request to an agent")
+    mr.add_argument("machine_id")
+    mr.add_argument("method")
+    mr.add_argument("--params", default="", help="JSON object of parameters")
+    mr.add_argument("--endpoint", default="http://127.0.0.1:15135")
+    mr.add_argument("--admin-token", default="")
+    mr.add_argument("--timeout", type=float, default=30.0)
+    mr.set_defaults(fn=cmd_manager)
 
     return p
 

@@ -1,0 +1,559 @@
+"""Standalone tpud control plane (dev/reference manager).
+
+The reference agent talks to a proprietary SaaS control plane; its repo
+ships only the agent side (reference: pkg/session/session.go:1-60,
+pkg/session/v2/session.proto:16-60). This module closes that gap for
+tpud: a runnable manager that real daemons enroll with and that
+operators can drive — the server-side counterpart of
+``gpud_tpu/session`` — speaking BOTH transports:
+
+- v1: ``POST /api/v1/login`` + dual chunked ndjson streams on
+  ``POST /api/v1/session`` (read = manager→agent requests, write =
+  agent→manager responses), mirroring session/session.py's client.
+- v2: gRPC bidi ``Connect`` with Hello/HelloAck revision negotiation;
+  at rev 2 requests go out as typed ManagerPacket oneof arms
+  (session/v2/typed.py dict_to_request) and responses come back as
+  Result packets; rev-1 agents stay on JSON Frames.
+
+Operator surface (same aiohttp app):
+
+- ``GET  /v1/machines``                  — connected fleet
+- ``POST /v1/machines/{id}/request``     — issue one method request and
+  wait for the agent's response (body: ``{"method": ..., params...}``)
+- ``POST /v1/drain``                     — notify v2 agents + close streams
+
+Run: ``tpud manager serve`` (cli.py) or ``ControlPlane().start()``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+DEFAULT_REQUEST_TIMEOUT = 30.0
+MAX_REQUEST_TIMEOUT = 600.0
+MAX_REVISION = 2
+
+
+class AgentGone(Exception):
+    """The agent disconnected (or was never connected)."""
+
+
+class AgentHandle:
+    """One connected agent: request/response plumbing + metadata."""
+
+    def __init__(self, machine_id: str, transport: str, version: str = "") -> None:
+        self.machine_id = machine_id
+        self.transport = transport  # "v1" | "v2-rev1" | "v2-rev2"
+        self.version = version
+        self.connected_at = time.time()
+        self.last_seen = self.connected_at
+        self.outbound: "queue.Queue[Optional[dict]]" = queue.Queue()
+        self.unsolicited: List[dict] = []  # responses with unknown req_id
+        self._pending: Dict[str, "queue.Queue[dict]"] = {}
+        self._lock = threading.Lock()
+        self._gone = threading.Event()
+        self.draining = threading.Event()  # v2: send DrainNotice on teardown
+        self._seq = 0
+
+    # -- operator side -----------------------------------------------------
+    def request(self, data: dict, timeout: float = DEFAULT_REQUEST_TIMEOUT) -> dict:
+        """Send one method-dict request; block for the agent's response."""
+        if self._gone.is_set():
+            raise AgentGone(self.machine_id)
+        with self._lock:
+            self._seq += 1
+            req_id = f"op-{self._seq}-{uuid.uuid4().hex[:8]}"
+            q: "queue.Queue[dict]" = queue.Queue(maxsize=1)
+            self._pending[req_id] = q
+        self.outbound.put({"req_id": req_id, "data": data})
+        try:
+            return q.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"agent {self.machine_id}: no response to "
+                f"{data.get('method')!r} within {timeout}s"
+            ) from None
+        finally:
+            with self._lock:
+                self._pending.pop(req_id, None)
+
+    # -- transport side ----------------------------------------------------
+    def resolve(self, req_id: str, payload: dict) -> None:
+        self.last_seen = time.time()
+        with self._lock:
+            q = self._pending.get(req_id)
+        if q is None:
+            self.unsolicited.append({"req_id": req_id, "data": payload})
+            del self.unsolicited[:-64]  # bounded
+            return
+        try:
+            q.put_nowait(payload)
+        except queue.Full:
+            pass
+
+    def mark_gone(self) -> None:
+        self._gone.set()
+        self.outbound.put(None)
+        with self._lock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for q in pending:
+            try:
+                q.put_nowait({"error": "agent disconnected"})
+            except queue.Full:
+                pass
+
+    @property
+    def gone(self) -> bool:
+        return self._gone.is_set()
+
+    def to_dict(self) -> dict:
+        return {
+            "machine_id": self.machine_id,
+            "transport": self.transport,
+            "version": self.version,
+            "connected_at": self.connected_at,
+            "last_seen": self.last_seen,
+        }
+
+
+class ControlPlane:
+    """Runnable manager process: v1 HTTP + v2 gRPC + operator API."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        grpc_port: int = 0,
+        *,
+        session_token: Optional[str] = None,
+        admin_token: Optional[str] = None,
+        instance_id: Optional[str] = None,
+    ) -> None:
+        self.port = port
+        self.grpc_port = grpc_port
+        # session_token=None → accept any enrollment and issue a fresh
+        # token per machine (dev mode); set → exact Bearer match required
+        self.session_token = session_token
+        self.admin_token = admin_token
+        self.instance_id = instance_id or f"tpud-manager-{uuid.uuid4().hex[:8]}"
+        self.agents: Dict[str, AgentHandle] = {}
+        self._issued_tokens: Dict[str, str] = {}  # machine_id → token
+        self._lock = threading.Lock()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self._grpc_server = None
+        self.logins: List[dict] = []
+        # separate pools for the two blocking workloads so they can't
+        # starve each other (and the aiohttp loop's small default
+        # executor stays free): every v1 read stream pins one stream
+        # worker for its lifetime; every in-flight operator request pins
+        # one op worker for up to its (clamped) timeout
+        from concurrent.futures import ThreadPoolExecutor
+
+        self.max_v1_agents = 64
+        self._stream_pool = ThreadPoolExecutor(
+            max_workers=self.max_v1_agents, thread_name_prefix="tpud-mgr-stream"
+        )
+        self._op_pool = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="tpud-mgr-op"
+        )
+
+    # -- registry ----------------------------------------------------------
+    def _register(self, handle: AgentHandle) -> None:
+        with self._lock:
+            old = self.agents.get(handle.machine_id)
+            if old is not None:
+                old.mark_gone()
+            self.agents[handle.machine_id] = handle
+        logger.info(
+            "agent %s connected (%s)", handle.machine_id, handle.transport
+        )
+
+    def _unregister(self, handle: AgentHandle) -> None:
+        handle.mark_gone()
+        with self._lock:
+            if self.agents.get(handle.machine_id) is handle:
+                del self.agents[handle.machine_id]
+        logger.info("agent %s disconnected", handle.machine_id)
+
+    def agent(self, machine_id: str) -> AgentHandle:
+        with self._lock:
+            h = self.agents.get(machine_id)
+        if h is None or h.gone:
+            raise AgentGone(machine_id)
+        return h
+
+    def machines(self) -> List[dict]:
+        with self._lock:
+            return [h.to_dict() for h in self.agents.values()]
+
+    # -- auth --------------------------------------------------------------
+    def _check_session_auth(self, machine_id: str, auth_header: str) -> bool:
+        token = auth_header.removeprefix("Bearer ").strip()
+        if self.session_token is not None:
+            return token == self.session_token
+        issued = self._issued_tokens.get(machine_id)
+        return issued is None or token == issued
+
+    def _check_admin(self, request) -> bool:  # noqa: ANN001 - aiohttp
+        if not self.admin_token:
+            return True
+        got = request.headers.get("Authorization", "")
+        return got.removeprefix("Bearer ").strip() == self.admin_token
+
+    # -- v1 HTTP app -------------------------------------------------------
+    async def _login(self, request):  # noqa: ANN001
+        from aiohttp import web
+
+        body = await request.json()
+        self.logins.append(body)
+        # fixed-token fleets must present the secret to enroll; otherwise
+        # login would hand the session token to any caller
+        if self.session_token is not None and body.get("token") != self.session_token:
+            return web.Response(status=401, text="bad join token")
+        machine_id = body.get("machine_id") or f"m-{uuid.uuid4().hex[:12]}"
+        token = self.session_token or f"tok-{uuid.uuid4().hex}"
+        self._issued_tokens[machine_id] = token
+        return web.json_response(
+            {
+                "machine_id": machine_id,
+                "token": token,
+                "machine_proof": f"proof-{machine_id}",
+            }
+        )
+
+    async def _session(self, request):  # noqa: ANN001
+        from aiohttp import web
+
+        stype = request.headers.get("X-TPUD-Session-Type", "")
+        machine = request.headers.get("X-TPUD-Machine-ID", "")
+        version = request.headers.get("X-TPUD-Version", "")
+        auth = request.headers.get("Authorization", "")
+        if not machine:
+            return web.Response(status=400, text="missing machine id")
+        if not self._check_session_auth(machine, auth):
+            return web.Response(status=401, text="unauthorized")
+
+        if stype == "read":
+            # manager → agent: stream requests as ndjson for as long as
+            # the agent stays connected
+            resp = web.StreamResponse()
+            resp.headers["Content-Type"] = "application/x-ndjson"
+            await resp.prepare(request)
+            handle = AgentHandle(machine, "v1", version)
+            self._register(handle)
+            try:
+                while not handle.gone:
+                    item = await asyncio.get_event_loop().run_in_executor(
+                        self._stream_pool, _q_get, handle.outbound
+                    )
+                    if item is None:
+                        if handle.gone:
+                            break
+                        continue
+                    line = json.dumps(item) + "\n"
+                    await resp.write(line.encode())
+            except (ConnectionResetError, asyncio.CancelledError):
+                pass
+            finally:
+                self._unregister(handle)
+            return resp
+
+        if stype == "write":
+            # agent → manager: chunked ndjson responses
+            while True:
+                line = await request.content.readline()
+                if not line:
+                    break
+                line = line.strip()
+                if not line:
+                    continue  # keep-alive blank
+                try:
+                    d = json.loads(line)
+                except ValueError:
+                    continue
+                with self._lock:
+                    handle = self.agents.get(machine)
+                if handle is not None:
+                    handle.resolve(str(d.get("req_id", "")), d.get("data") or {})
+            return web.Response(text="ok")
+
+        return web.Response(status=400, text=f"bad session type {stype!r}")
+
+    # -- operator API ------------------------------------------------------
+    async def _machines_route(self, request):  # noqa: ANN001
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        return web.json_response({"machines": self.machines()})
+
+    async def _request_route(self, request):  # noqa: ANN001
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        machine_id = request.match_info["machine_id"]
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.Response(status=400, text="body must be JSON")
+        if not isinstance(body, dict) or not body.get("method"):
+            return web.Response(status=400, text='body needs a "method"')
+        try:
+            timeout = float(
+                request.query.get("timeout", DEFAULT_REQUEST_TIMEOUT)
+            )
+        except ValueError:
+            return web.Response(status=400, text="timeout must be a number")
+        # each in-flight request pins a pool worker for its duration
+        timeout = min(max(timeout, 0.1), MAX_REQUEST_TIMEOUT)
+        try:
+            handle = self.agent(machine_id)
+        except AgentGone:
+            return web.Response(status=404, text=f"no agent {machine_id!r}")
+        try:
+            payload = await asyncio.get_event_loop().run_in_executor(
+                self._op_pool, lambda: handle.request(body, timeout=timeout)
+            )
+        except (TimeoutError, AgentGone) as e:
+            return web.Response(status=504, text=str(e))
+        return web.json_response({"machine_id": machine_id, "response": payload})
+
+    async def _drain_route(self, request):  # noqa: ANN001
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        self.drain("operator drain")
+        return web.json_response({"drained": True})
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_post("/api/v1/login", self._login)
+        app.router.add_post("/api/v1/session", self._session)
+        app.router.add_get("/v1/machines", self._machines_route)
+        app.router.add_post(
+            "/v1/machines/{machine_id}/request", self._request_route
+        )
+        app.router.add_post("/v1/drain", self._drain_route)
+
+        def run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            self._loop = loop
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", self.port)
+            loop.run_until_complete(site.start())
+            self.port = site._server.sockets[0].getsockname()[1]  # noqa: SLF001
+            self._started.set()
+            try:
+                loop.run_forever()
+            finally:
+                loop.run_until_complete(runner.cleanup())
+                loop.close()
+
+        self._thread = threading.Thread(
+            target=run, name="tpud-manager-http", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(10.0):
+            raise RuntimeError("manager HTTP server failed to start")
+        self._start_grpc()
+        logger.info(
+            "control plane up: http=127.0.0.1:%d grpc=127.0.0.1:%d",
+            self.port,
+            self.grpc_port,
+        )
+
+    def _start_grpc(self) -> None:
+        try:
+            import grpc
+        except ImportError:
+            logger.warning("grpc unavailable; v2 transport disabled")
+            self.grpc_port = -1
+            return
+        from concurrent import futures
+
+        from gpud_tpu.session.v2 import session_pb2 as pb
+
+        handler = grpc.stream_stream_rpc_method_handler(
+            self._connect_v2,
+            request_deserializer=pb.AgentPacket.FromString,
+            response_serializer=pb.ManagerPacket.SerializeToString,
+        )
+        service = grpc.method_handlers_generic_handler(
+            "tpud.session.v2.Session", {"Connect": handler}
+        )
+        # each v2 Connect stream pins one handler thread for its lifetime
+        # — this is the v2 fleet-size cap for the dev manager
+        self.max_v2_agents = 64
+        self._grpc_server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=self.max_v2_agents),
+            # without this, Linux SO_REUSEPORT lets a second manager bind
+            # the same port and silently split the agent fleet
+            options=[("grpc.so_reuseport", 0)],
+        )
+        self._grpc_server.add_generic_rpc_handlers((service,))
+        requested = self.grpc_port
+        self.grpc_port = self._grpc_server.add_insecure_port(
+            f"127.0.0.1:{self.grpc_port}"
+        )
+        if self.grpc_port == 0:
+            # grpc reports a failed bind as port 0 — surface it instead of
+            # silently serving v1-only
+            self._grpc_server = None
+            raise RuntimeError(
+                f"gRPC bind failed on 127.0.0.1:{requested} (port in use?)"
+            )
+        self._grpc_server.start()
+
+    def _connect_v2(self, request_iterator, context):  # noqa: ANN001
+        from gpud_tpu.session.v2 import session_pb2 as pb
+        from gpud_tpu.session.v2 import typed
+
+        try:
+            first = next(request_iterator)
+        except StopIteration:
+            # stream opened and closed without a Hello (probe/scanner) —
+            # returning here must not trip PEP 479 inside the generator
+            return
+        if first.WhichOneof("payload") != "hello":
+            return  # protocol violation: close the stream
+        hello = first.hello
+        ack = pb.ManagerPacket()
+        if not self._check_session_auth(hello.machine_id, f"Bearer {hello.token}"):
+            ack.hello_ack.accepted = False
+            ack.hello_ack.reason = "bad token"
+            yield ack
+            return
+        # negotiate: the highest revision both sides speak (agent range
+        # [min,max]; rev-1 agents leave max at 0 and set `revision`)
+        agent_max = hello.max_revision or hello.revision or 1
+        revision = min(agent_max, MAX_REVISION)
+        if hello.min_revision and revision < hello.min_revision:
+            # a future agent whose floor exceeds what this manager speaks
+            # must be rejected, not driven at a revision it disclaimed
+            ack.hello_ack.accepted = False
+            ack.hello_ack.reason = (
+                f"no common revision: agent [{hello.min_revision},"
+                f"{hello.max_revision}] vs manager max {MAX_REVISION}"
+            )
+            yield ack
+            return
+        ack.hello_ack.accepted = True
+        ack.hello_ack.revision = revision
+        ack.hello_ack.manager_instance_id = self.instance_id
+        yield ack
+
+        handle = AgentHandle(
+            hello.machine_id, f"v2-rev{revision}", hello.tpud_version
+        )
+        self._register(handle)
+        stop = threading.Event()
+
+        def drain_responses() -> None:
+            try:
+                for pkt in request_iterator:
+                    kind = pkt.WhichOneof("payload")
+                    if kind == "frame":
+                        try:
+                            data = json.loads(pkt.frame.data.decode())
+                        except ValueError:
+                            continue
+                        handle.resolve(pkt.frame.req_id, data)
+                    elif kind == "result":
+                        try:
+                            data = json.loads(pkt.result.payload_json.decode())
+                        except ValueError:
+                            continue
+                        handle.resolve(pkt.result.request_id, data)
+            except Exception:  # noqa: BLE001 - client cancel mid-read
+                pass
+            finally:
+                stop.set()
+
+        threading.Thread(
+            target=drain_responses,
+            name=f"tpud-manager-v2-{hello.machine_id}",
+            daemon=True,
+        ).start()
+
+        try:
+            while not stop.is_set() and context.is_active():
+                if handle.draining.is_set():
+                    d = pb.ManagerPacket()
+                    d.drain_notice.reason = "manager draining"
+                    yield d
+                    return
+                item = _q_get(handle.outbound, timeout=0.2)
+                if item is None:
+                    if handle.gone:
+                        return
+                    continue
+                req_id, data = item["req_id"], item["data"]
+                if revision >= 2:
+                    try:
+                        mpkt = typed.dict_to_request(data, req_id)
+                        yield mpkt
+                        continue
+                    except Exception:  # noqa: BLE001
+                        # method outside the typed set, or params the
+                        # encoder chokes on (e.g. since="abc") — fall back
+                        # to the Frame tunnel so one bad operator request
+                        # can't tear down a healthy agent's stream; the
+                        # agent dispatcher answers a structured error
+                        pass
+                m = pb.ManagerPacket()
+                m.frame.req_id = req_id
+                m.frame.data = json.dumps(data).encode()
+                yield m
+        finally:
+            self._unregister(handle)
+
+    def drain(self, reason: str = "shutdown") -> None:
+        """Notify currently-connected v2 agents (DrainNotice) and end v1
+        read streams. Drain is a point-in-time action: agents that
+        reconnect afterwards are served normally."""
+        with self._lock:
+            handles = list(self.agents.values())
+        for h in handles:
+            h.draining.set()
+            h.mark_gone()
+
+    def stop(self) -> None:
+        self.drain("manager stopping")
+        if self._grpc_server is not None:
+            self._grpc_server.stop(grace=1.0)
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._stream_pool.shutdown(wait=False, cancel_futures=True)
+        self._op_pool.shutdown(wait=False, cancel_futures=True)
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+
+def _q_get(q: "queue.Queue", timeout: float = 0.5):  # noqa: ANN001
+    try:
+        return q.get(timeout=timeout)
+    except queue.Empty:
+        return None

@@ -130,8 +130,20 @@ class Session:
         time_sleep_fn: Callable[[float], bool] = None,
         audit_logger=None,
         protocol: str = "auto",
+        v2_target: str = "",
     ) -> None:
         self.endpoint = endpoint.rstrip("/")
+        # split-port deployments (e.g. the standalone dev control plane
+        # serves HTTP and gRPC on different ports) advertise the gRPC
+        # target apart from the HTTP endpoint. Resolution: explicit param
+        # > TPUD_SESSION_V2_TARGET env > derived from endpoint. May carry
+        # a scheme ("http://host:p" pins plaintext, "https://" pins TLS);
+        # bare host:port inherits the endpoint's scheme.
+        import os as _os
+
+        self.v2_target = v2_target or _os.environ.get(
+            "TPUD_SESSION_V2_TARGET", ""
+        )
         self.machine_id = machine_id
         self.token = token
         self.machine_proof = machine_proof

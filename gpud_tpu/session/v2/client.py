@@ -46,6 +46,24 @@ def grpc_target_from_endpoint(endpoint: str) -> str:
     return f"{host}:{port}"
 
 
+def resolve_v2_target(endpoint: str, override: str) -> "tuple[str, bool]":
+    """(host:port, use_tls) for the gRPC dial.
+
+    Split-port deployments carry the gRPC target on the Session (param or
+    TPUD_SESSION_V2_TARGET env — resolved in Session.__init__); an
+    explicit scheme on the override pins its own TLS mode so a dev
+    plaintext target doesn't get wrapped in ssl credentials, while a bare
+    host:port inherits the endpoint's scheme."""
+    if override:
+        use_tls = (
+            override.startswith("https://")
+            if "//" in override
+            else endpoint.startswith("https")
+        )
+        return grpc_target_from_endpoint(override), use_tls
+    return grpc_target_from_endpoint(endpoint), endpoint.startswith("https")
+
+
 class HandshakeRejected(Exception):
     pass
 
@@ -54,8 +72,9 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
     """Transport function with the (start_reader_fn) contract of
     Session: starts pump threads, returns a stop(). Raises on connection
     or handshake failure so the keep-alive loop can fall back to v1."""
-    target = grpc_target_from_endpoint(session.endpoint)
-    use_tls = session.endpoint.startswith("https")
+    target, use_tls = resolve_v2_target(
+        session.endpoint, getattr(session, "v2_target", "")
+    )
     if use_tls:
         channel = grpc.secure_channel(target, grpc.ssl_channel_credentials())
     else:

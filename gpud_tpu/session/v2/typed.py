@@ -181,6 +181,111 @@ def _plugin_spec_to_dict(spec: pb.PluginSpec) -> Dict:
     return out
 
 
+METHOD_TO_FIELD = {m: f for f, m in FIELD_TO_METHOD.items()}
+
+
+def dict_to_request(req: Dict, request_id: str) -> pb.ManagerPacket:
+    """Manager-side encoder: dispatcher request dict → typed ManagerPacket.
+
+    The exact inverse of :func:`request_to_dict` (roundtrip-tested per
+    method); the standalone control plane uses it to speak rev 2 from the
+    same method-dict surface the v1 transport uses.
+    """
+    method = req.get("method")
+    field = METHOD_TO_FIELD.get(method or "")
+    if field is None:
+        raise UnsupportedRequest(f"no typed encoding for method {method!r}")
+    mpkt = pb.ManagerPacket()
+    mpkt.request_id = request_id
+    msg = getattr(mpkt, field)
+    msg.SetInParent()  # parameterless requests still select the oneof arm
+
+    if field == "get_states":
+        msg.components.extend(req.get("components") or [])
+    elif field in ("get_events", "get_metrics"):
+        if req.get("since"):
+            msg.since_unix = float(req["since"])
+    elif field == "diagnostic":
+        if req.get("script_base64"):
+            msg.script_base64 = req["script_base64"]
+        if req.get("since"):
+            msg.since_unix = float(req["since"])
+        if req.get("timeout_seconds"):
+            msg.timeout_seconds = float(req["timeout_seconds"])
+    elif field == "reboot":
+        if req.get("delay_seconds"):
+            msg.delay_seconds = float(req["delay_seconds"])
+    elif field in ("set_healthy", "deregister_component"):
+        msg.component = req.get("component", "")
+    elif field == "trigger_component":
+        msg.component = req.get("component", "")
+        msg.tag = req.get("tag", "")
+    elif field == "inject_fault":
+        if req.get("tpu_error_name"):
+            msg.tpu_error_name = req["tpu_error_name"]
+        elif req.get("kernel_message"):
+            msg.kernel_message.message = req["kernel_message"]
+            if req.get("priority") is not None:
+                msg.kernel_message.priority = int(req["priority"])
+        if req.get("chip_id"):
+            msg.chip_id = int(req["chip_id"])
+        if req.get("detail"):
+            msg.detail = req["detail"]
+    elif field == "bootstrap":
+        msg.script_base64 = req.get("script_base64", "")
+        if req.get("timeout_seconds"):
+            msg.timeout_seconds = float(req["timeout_seconds"])
+    elif field == "update_config":
+        for section, value in (req.get("configs") or {}).items():
+            msg.configs_json[section] = json.dumps(value)
+    elif field == "update_token":
+        msg.token = req.get("token", "")
+    elif field == "update":
+        msg.version = req.get("version", "")
+    elif field == "kap_mtls_update_credentials":
+        msg.version = req.get("version", "")
+        msg.cert_pem = req.get("cert_pem", "")
+        msg.key_pem = req.get("key_pem", "")
+        msg.activate = bool(req.get("activate"))
+    elif field == "kap_mtls_activate":
+        msg.version = req.get("version", "")
+    elif field == "set_plugin_specs":
+        for spec in req.get("specs") or []:
+            msg.specs.append(_plugin_spec_from_dict(spec))
+    return mpkt
+
+
+def _plugin_spec_from_dict(spec: Dict) -> pb.PluginSpec:
+    out = pb.PluginSpec()
+    out.name = spec.get("name", "")
+    out.plugin_type = spec.get("plugin_type", "")
+    out.run_mode = spec.get("run_mode", "")
+    out.interval_seconds = float(spec.get("interval_seconds") or 0)
+    out.timeout_seconds = float(spec.get("timeout_seconds") or 0)
+    out.tags.extend(spec.get("tags") or [])
+    out.component_list.extend(spec.get("component_list") or [])
+    for st in spec.get("steps") or []:
+        step = out.steps.add()
+        step.name = st.get("name", "")
+        if st.get("script_base64"):
+            step.script_base64 = st["script_base64"]
+        elif st.get("script"):
+            step.script = st["script"]
+    parser = spec.get("parser")
+    if parser is not None:
+        for k, v in (parser.get("json_paths") or {}).items():
+            out.parser.json_paths[k] = v
+        for r in parser.get("match_rules") or []:
+            rule = out.parser.match_rules.add()
+            rule.regex = r.get("regex", "")
+            rule.field = r.get("field", "")
+            rule.health = r.get("health", "Unhealthy")
+            rule.suggested_actions.extend(r.get("suggested_actions") or [])
+            rule.description = r.get("description", "")
+        out.parser.SetInParent()
+    return out
+
+
 def make_result(request_id: str, payload: Dict) -> pb.AgentPacket:
     pkt = pb.AgentPacket()
     pkt.result.request_id = request_id

@@ -1,0 +1,210 @@
+"""Lightweight line coverage built on ``sys.monitoring`` (PEP 669).
+
+The sandbox image ships no ``coverage`` package, so this module provides
+the minimal subset the test pyramid needs: which executable lines of
+``gpud_tpu`` ran during a test session. It mirrors the role of the
+reference's ``go test -cover`` CI step (reference: .github/workflows —
+coverage gates on pkg/), implemented the CPython-3.12 way: LINE events
+are disabled per-location after the first hit, so steady-state overhead
+is near zero.
+
+Usage (standalone)::
+
+    python -m gpud_tpu.tools.cov report cov.json         # summary table
+    python -m gpud_tpu.tools.cov report cov.json -m gpud_tpu/cli.py
+
+or via the pytest hook in tests/conftest.py: ``TPUD_COV=out.json pytest``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+
+_TOOL_ID = sys.monitoring.COVERAGE_ID
+
+
+class LineCollector:
+    """Records the first execution of each (file, line) under ``root``."""
+
+    def __init__(self, root: str) -> None:
+        self.root = os.path.abspath(root) + os.sep
+        self.hits: dict[str, set[int]] = {}
+        self._active = False
+
+    # -- sys.monitoring plumbing ------------------------------------------
+    def _on_line(self, code, lineno):  # noqa: ANN001 - monitoring signature
+        fname = code.co_filename
+        if fname.startswith(self.root) and not fname.endswith(
+            os.sep + "cov.py"
+        ):
+            self.hits.setdefault(fname, set()).add(lineno)
+        # one hit per location is all coverage needs; disabling keeps the
+        # interpreter at full speed afterwards
+        return sys.monitoring.DISABLE
+
+    def start(self) -> None:
+        if self._active:
+            return
+        owner = sys.monitoring.get_tool(_TOOL_ID)
+        if owner == "tpud-cov":
+            # another collector in this process already owns the id (e.g. a
+            # conftest imported twice under two module names) — defer to it
+            return
+        if owner is not None:
+            # a foreign profiler/debugger owns COVERAGE_ID: degrade to
+            # no-coverage rather than crashing the host process
+            sys.stderr.write(
+                f"tpud-cov: tool id owned by {owner!r}; coverage disabled\n"
+            )
+            return
+        sys.monitoring.use_tool_id(_TOOL_ID, "tpud-cov")
+        sys.monitoring.register_callback(
+            _TOOL_ID, sys.monitoring.events.LINE, self._on_line
+        )
+        sys.monitoring.set_events(_TOOL_ID, sys.monitoring.events.LINE)
+        self._active = True
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        sys.monitoring.set_events(_TOOL_ID, sys.monitoring.events.NO_EVENTS)
+        sys.monitoring.register_callback(
+            _TOOL_ID, sys.monitoring.events.LINE, None
+        )
+        sys.monitoring.free_tool_id(_TOOL_ID)
+        self._active = False
+
+    def dump(self, path: str) -> None:
+        data = {f: sorted(lines) for f, lines in sorted(self.hits.items())}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"root": self.root, "hits": data}, fh)
+
+
+# -- static side: which lines COULD run -----------------------------------
+
+def executable_lines(path: str) -> set[int]:
+    """All line numbers that carry bytecode in ``path`` (incl. nested
+    functions/classes), via recursive ``co_lines`` walk."""
+    with open(path, "rb") as fh:
+        src = fh.read()
+    try:
+        top = compile(src, path, "exec")
+    except SyntaxError:
+        return set()
+    lines: set[int] = set()
+    stack = [top]
+    while stack:
+        code = stack.pop()
+        for _start, _end, lineno in code.co_lines():
+            if lineno is not None:
+                lines.add(lineno)
+        for const in code.co_consts:
+            if isinstance(const, type(top)):
+                stack.append(const)
+    return lines
+
+
+def _is_noise_line(text: str) -> bool:
+    t = text.strip()
+    # co_lines marks def/class headers and bare string (docstring) lines as
+    # executable; a module whose functions never ran still "covers" them.
+    # Keep them — they are executable — but drop obvious non-statements.
+    return t == "" or t.startswith("#")
+
+
+@dataclass
+class FileReport:
+    path: str
+    total: int
+    hit: int
+    missing: list[int] = field(default_factory=list)
+
+    @property
+    def pct(self) -> float:
+        return 100.0 * self.hit / self.total if self.total else 100.0
+
+
+def build_report(cov_json: str) -> list[FileReport]:
+    with open(cov_json, encoding="utf-8") as fh:
+        data = json.load(fh)
+    root = data["root"]
+    hits = {f: set(v) for f, v in data["hits"].items()}
+
+    reports: list[FileReport] = []
+    for dirpath, _dirs, files in os.walk(root.rstrip(os.sep)):
+        if "__pycache__" in dirpath:
+            continue
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            exe = executable_lines(path)
+            if not exe:
+                continue
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    srclines = fh.readlines()
+            except OSError:
+                srclines = []
+            exe = {
+                n
+                for n in exe
+                if 1 <= n <= len(srclines)
+                and not _is_noise_line(srclines[n - 1])
+            }
+            got = hits.get(path, set()) & exe
+            miss = sorted(exe - got)
+            reports.append(FileReport(path, len(exe), len(got), miss))
+    reports.sort(key=lambda r: (r.pct, -(r.total - r.hit)))
+    return reports
+
+
+def _ranges(nums: list[int]) -> str:
+    if not nums:
+        return ""
+    out, start, prev = [], nums[0], nums[0]
+    for n in nums[1:]:
+        if n == prev + 1:
+            prev = n
+            continue
+        out.append(f"{start}-{prev}" if prev > start else str(start))
+        start = prev = n
+    out.append(f"{start}-{prev}" if prev > start else str(start))
+    return ",".join(out)
+
+
+def format_report(
+    reports: list[FileReport], *, show_missing_for: str | None = None
+) -> str:
+    buf = io.StringIO()
+    tot = hit = 0
+    for r in reports:
+        tot += r.total
+        hit += r.hit
+        rel = os.path.relpath(r.path)
+        buf.write(f"{r.pct:6.1f}%  {r.hit:5d}/{r.total:<5d} {rel}\n")
+        if show_missing_for and show_missing_for in rel:
+            buf.write(f"         missing: {_ranges(r.missing)}\n")
+    pct = 100.0 * hit / tot if tot else 100.0
+    buf.write(f"{pct:6.1f}%  {hit:5d}/{tot:<5d} TOTAL\n")
+    return buf.getvalue()
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) >= 2 and argv[0] == "report":
+        show = None
+        if "-m" in argv:
+            show = argv[argv.index("-m") + 1]
+        reports = build_report(argv[1])
+        sys.stdout.write(format_report(reports, show_missing_for=show))
+        return 0
+    sys.stderr.write(__doc__ or "")
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -35,6 +35,23 @@ os.environ.setdefault("TPUD_TPU_MOCK_ALL_SUCCESS", "1")
 
 import pytest  # noqa: E402
 
+# opt-in line coverage: TPUD_COV=/path/out.json pytest ...
+# (the image ships no coverage package; gpud_tpu.tools.cov is the
+# sys.monitoring-based stand-in for the reference's go-test -cover gate)
+_COV_OUT = os.environ.get("TPUD_COV")
+_COV = None
+if _COV_OUT:
+    from gpud_tpu.tools.cov import LineCollector
+
+    _COV = LineCollector(os.path.join(os.path.dirname(__file__), "..", "gpud_tpu"))
+    _COV.start()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _COV is not None:
+        _COV.stop()
+        _COV.dump(_COV_OUT)
+
 
 @pytest.fixture()
 def tmp_db(tmp_path):
@@ -79,12 +96,3 @@ def live_server(tmp_path_factory):
     s.stop()
 
 
-def write_pstore_dump(dir_path, name, content, mtime=None):
-    """Stage a pstore crash-dump fixture (shared by the pstore suites)."""
-    import os as _os
-
-    p = dir_path / name
-    p.write_text(content)
-    if mtime is not None:
-        _os.utime(str(p), (mtime, mtime))
-    return str(p)

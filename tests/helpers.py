@@ -1,0 +1,18 @@
+"""Shared plain-module test helpers.
+
+Import from here (``from tests.helpers import ...``), never from
+``tests.conftest`` — importing conftest under a second module name
+re-runs its module-level environment setup (and would double-start the
+TPUD_COV line collector but for cov.py's ownership guard).
+"""
+
+import os
+
+
+def write_pstore_dump(dir_path, name, content, mtime=None):
+    """Stage a pstore crash-dump fixture (shared by the pstore suites)."""
+    p = dir_path / name
+    p.write_text(content)
+    if mtime is not None:
+        os.utime(str(p), (mtime, mtime))
+    return str(p)

@@ -1,0 +1,606 @@
+"""Standalone control plane e2e: real daemons enrolled with the runnable
+manager (gpud_tpu/manager/control_plane.py) over BOTH transports, driven
+through the operator API — the server-side counterpart the reference
+never ships (its control plane is SaaS; reference: pkg/session/session.go
+speaks to it, nothing serves it)."""
+
+import json
+import time
+
+import pytest
+
+from gpud_tpu.config import default_config
+from gpud_tpu.manager.control_plane import AgentGone, AgentHandle, ControlPlane
+from gpud_tpu.server.server import Server
+from gpud_tpu.session.session import Session
+
+requests = pytest.importorskip("requests")
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    """ControlPlane + a live daemon enrolled over v1 (the aiohttp port is
+    not gRPC-capable, so protocol=auto falls back — the split-port v2
+    path is covered separately below)."""
+    tmp = tmp_path_factory.mktemp("cp-e2e")
+    cp = ControlPlane()
+    cp.start()
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp / "data"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        endpoint=cp.endpoint,
+        token="join-token",
+        machine_id="cp-agent-1",
+        components_disabled=["network-latency"],
+    )
+    srv = Server(config=cfg)
+    srv.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and "cp-agent-1" not in cp.agents:
+        time.sleep(0.05)
+    yield cp, srv
+    srv.stop()
+    cp.stop()
+
+
+def test_daemon_appears_in_machine_list(stack):
+    cp, _srv = stack
+    machines = cp.machines()
+    ids = {m["machine_id"] for m in machines}
+    assert "cp-agent-1" in ids
+    (m,) = [m for m in machines if m["machine_id"] == "cp-agent-1"]
+    assert m["transport"] == "v1"
+    assert m["version"]  # daemon advertises its version header
+
+
+def test_operator_request_states_roundtrip(stack):
+    cp, _srv = stack
+    resp = cp.agent("cp-agent-1").request({"method": "states"}, timeout=15)
+    comps = {s["component"] for s in resp["states"]}
+    assert "cpu" in comps and "accelerator-tpu-ici" in comps
+
+
+def test_operator_http_api_end_to_end(stack):
+    cp, _srv = stack
+    r = requests.get(f"{cp.endpoint}/v1/machines", timeout=10)
+    assert r.status_code == 200
+    assert "cp-agent-1" in {m["machine_id"] for m in r.json()["machines"]}
+
+    r = requests.post(
+        f"{cp.endpoint}/v1/machines/cp-agent-1/request",
+        json={"method": "gossip"},
+        timeout=20,
+    )
+    assert r.status_code == 200
+    body = r.json()
+    assert body["machine_id"] == "cp-agent-1"
+    assert body["response"]["status"] in ("started", "ok")
+
+
+def test_operator_request_unknown_machine_404(stack):
+    cp, _srv = stack
+    r = requests.post(
+        f"{cp.endpoint}/v1/machines/no-such/request",
+        json={"method": "states"},
+        timeout=10,
+    )
+    assert r.status_code == 404
+
+
+def test_operator_request_validates_body(stack):
+    cp, _srv = stack
+    base = f"{cp.endpoint}/v1/machines/cp-agent-1/request"
+    assert requests.post(base, data=b"not json", timeout=10).status_code == 400
+    assert requests.post(base, json={"no": "method"}, timeout=10).status_code == 400
+
+
+def test_inject_fault_detected_via_manager(stack):
+    cp, _srv = stack
+    h = cp.agent("cp-agent-1")
+    resp = h.request(
+        {
+            "method": "injectFault",
+            "tpu_error_name": "tpu_ici_cable_fault",
+            "chip_id": 1,
+        },
+        timeout=15,
+    )
+    assert resp["status"] == "ok"
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        states = h.request(
+            {"method": "states", "components": ["accelerator-tpu-error-kmsg"]},
+            timeout=15,
+        )["states"]
+        st = states[0]["states"][0]
+        if st["health"] == "Unhealthy":
+            assert "tpu_ici_cable_fault" in st["reason"]
+            return
+        time.sleep(0.3)
+    raise AssertionError("injected fault never surfaced via the manager")
+
+
+# -- admin auth ------------------------------------------------------------
+
+
+def test_admin_token_guards_operator_api(tmp_path):
+    cp = ControlPlane(admin_token="s3cret")
+    cp.start()
+    try:
+        r = requests.get(f"{cp.endpoint}/v1/machines", timeout=10)
+        assert r.status_code == 401
+        r = requests.get(
+            f"{cp.endpoint}/v1/machines",
+            headers={"Authorization": "Bearer s3cret"},
+            timeout=10,
+        )
+        assert r.status_code == 200
+        r = requests.post(
+            f"{cp.endpoint}/v1/machines/x/request",
+            json={"method": "states"},
+            timeout=10,
+        )
+        assert r.status_code == 401
+        r = requests.post(f"{cp.endpoint}/v1/drain", timeout=10)
+        assert r.status_code == 401
+    finally:
+        cp.stop()
+
+
+def test_login_issues_identity(tmp_path):
+    cp = ControlPlane()
+    cp.start()
+    try:
+        r = requests.post(
+            f"{cp.endpoint}/api/v1/login", json={"token": "join"}, timeout=10
+        )
+        body = r.json()
+        assert body["machine_id"].startswith("m-")
+        assert body["token"].startswith("tok-")
+        # a second login with an explicit machine_id keeps it
+        r = requests.post(
+            f"{cp.endpoint}/api/v1/login",
+            json={"token": "join", "machine_id": "keep-me"},
+            timeout=10,
+        )
+        assert r.json()["machine_id"] == "keep-me"
+        assert len(cp.logins) == 2
+    finally:
+        cp.stop()
+
+
+def test_fixed_session_token_gates_login(tmp_path):
+    """Enrollment must present the fleet secret — login must not hand the
+    session token to arbitrary callers."""
+    cp = ControlPlane(session_token="fleet-token")
+    cp.start()
+    try:
+        r = requests.post(
+            f"{cp.endpoint}/api/v1/login", json={"token": "wrong"}, timeout=10
+        )
+        assert r.status_code == 401
+        r = requests.post(
+            f"{cp.endpoint}/api/v1/login",
+            json={"token": "fleet-token"},
+            timeout=10,
+        )
+        assert r.status_code == 200
+        assert r.json()["token"] == "fleet-token"
+    finally:
+        cp.stop()
+
+
+def test_request_timeout_param_validated(stack):
+    cp, _srv = stack
+    r = requests.post(
+        f"{cp.endpoint}/v1/machines/cp-agent-1/request",
+        json={"method": "gossip"},
+        params={"timeout": "abc"},
+        timeout=10,
+    )
+    assert r.status_code == 400
+
+
+def test_fixed_session_token_rejects_bad_bearer(tmp_path):
+    cp = ControlPlane(session_token="fleet-token")
+    cp.start()
+    try:
+        r = requests.post(
+            f"{cp.endpoint}/api/v1/session",
+            headers={
+                "X-TPUD-Session-Type": "write",
+                "X-TPUD-Machine-ID": "m1",
+                "Authorization": "Bearer wrong",
+            },
+            data=b"",
+            timeout=10,
+        )
+        assert r.status_code == 401
+    finally:
+        cp.stop()
+
+
+# -- v2 (gRPC, split-port) -------------------------------------------------
+
+
+@pytest.fixture()
+def v2_stack(tmp_path, monkeypatch):
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    cp = ControlPlane()
+    cp.start()
+    assert cp.grpc_port > 0
+    monkeypatch.setenv("TPUD_SESSION_V2_TARGET", f"127.0.0.1:{cp.grpc_port}")
+    yield cp
+    cp.stop()
+
+
+def _mk_session(cp, machine_id, **kw):
+    responses = []
+    s = Session(
+        endpoint=cp.endpoint,
+        machine_id=machine_id,
+        token="t",
+        machine_proof="p",
+        dispatch_fn=lambda req: {"echo": req.get("method"), **kw},
+        protocol="auto",
+    )
+    s.start()
+    return s, responses
+
+
+def test_v2_agent_negotiates_rev2_and_answers_typed(v2_stack):
+    cp = v2_stack
+    s, _ = _mk_session(cp, "v2-agent")
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and "v2-agent" not in cp.agents:
+            time.sleep(0.05)
+        h = cp.agent("v2-agent")
+        assert h.transport == "v2-rev2"
+        # travels as a typed GetStatesRequest, comes back as a Result
+        resp = h.request({"method": "states"}, timeout=10)
+        assert resp == {"echo": "states"}
+        # parameterized method: typed TriggerComponentRequest
+        resp = h.request(
+            {"method": "triggerComponent", "component": "cpu", "tag": ""},
+            timeout=10,
+        )
+        assert resp == {"echo": "triggerComponent"}
+    finally:
+        s.stop()
+
+
+def test_v2_drain_notifies_agent(v2_stack):
+    cp = v2_stack
+    s, _ = _mk_session(cp, "v2-drainee")
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and "v2-drainee" not in cp.agents:
+            time.sleep(0.05)
+        cp.drain("test drain")
+        deadline = time.time() + 5
+        while time.time() < deadline and "v2-drainee" in cp.agents:
+            time.sleep(0.05)
+        assert "v2-drainee" not in cp.agents
+    finally:
+        s.stop()
+
+
+def test_v2_agent_can_reconnect_after_drain(v2_stack):
+    """Drain is point-in-time: an agent reconnecting afterwards is served
+    normally, not immediately re-drained."""
+    cp = v2_stack
+    s, _ = _mk_session(cp, "re-enroll")
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and "re-enroll" not in cp.agents:
+            time.sleep(0.05)
+        cp.drain("rolling restart")
+        # the session auto-reconnects; wait for a FRESH handle
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                h = cp.agent("re-enroll")
+                resp = h.request({"method": "states"}, timeout=10)
+                assert resp == {"echo": "states"}
+                return
+            except (AgentGone, TimeoutError):
+                time.sleep(0.2)
+        raise AssertionError("agent never usable again after drain")
+    finally:
+        s.stop()
+
+
+def test_v2_empty_stream_closes_cleanly(v2_stack):
+    """A probe that opens Connect and half-closes without Hello must not
+    crash the servicer (PEP 479)."""
+    grpc = pytest.importorskip("grpc")
+    from gpud_tpu.session.v2 import session_pb2 as pb
+
+    cp = v2_stack
+    channel = grpc.insecure_channel(f"127.0.0.1:{cp.grpc_port}")
+    stream = channel.stream_stream(
+        "/tpud.session.v2.Session/Connect",
+        request_serializer=pb.AgentPacket.SerializeToString,
+        response_deserializer=pb.ManagerPacket.FromString,
+    )
+    call = stream(iter(()))  # zero messages, immediate half-close
+    assert list(call) == []  # server closes without error status
+    channel.close()
+    # the manager is still fully operational afterwards
+    assert requests.get(f"{cp.endpoint}/v1/machines", timeout=10).status_code == 200
+
+
+def test_live_daemon_over_v2(v2_stack, tmp_path):
+    cp = v2_stack
+    kmsg = tmp_path / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp_path / "data"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        endpoint=cp.endpoint,
+        token="join-token",
+        machine_id="v2-daemon",
+        components_disabled=["network-latency"],
+    )
+    srv = Server(config=cfg)
+    srv.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and "v2-daemon" not in cp.agents:
+            time.sleep(0.05)
+        h = cp.agent("v2-daemon")
+        assert h.transport == "v2-rev2"
+        states = h.request({"method": "states"}, timeout=15)["states"]
+        assert {s["component"] for s in states} >= {"cpu", "memory"}
+    finally:
+        srv.stop()
+
+
+def test_bad_operator_params_do_not_kill_v2_stream(v2_stack):
+    """An operator request the typed encoder chokes on (since='abc') must
+    not tear down the agent's Connect stream — it falls back to the Frame
+    tunnel and the agent answers (a structured error or echo)."""
+    cp = v2_stack
+    s, _ = _mk_session(cp, "sturdy")
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and "sturdy" not in cp.agents:
+            time.sleep(0.05)
+        h = cp.agent("sturdy")
+        resp = h.request({"method": "events", "since": "abc"}, timeout=10)
+        assert resp == {"echo": "events"}  # delivered via Frame fallback
+        # the stream survived: a normal typed request still works
+        assert h.request({"method": "states"}, timeout=10) == {"echo": "states"}
+        assert not h.gone
+    finally:
+        s.stop()
+
+
+def test_agent_min_revision_above_manager_is_rejected(v2_stack):
+    """A future agent with min_revision > manager max gets accepted=false,
+    not a revision it disclaimed."""
+    grpc = pytest.importorskip("grpc")
+    from gpud_tpu.session.v2 import session_pb2 as pb
+
+    cp = v2_stack
+    channel = grpc.insecure_channel(f"127.0.0.1:{cp.grpc_port}")
+    stream = channel.stream_stream(
+        "/tpud.session.v2.Session/Connect",
+        request_serializer=pb.AgentPacket.SerializeToString,
+        response_deserializer=pb.ManagerPacket.FromString,
+    )
+    hello = pb.AgentPacket()
+    hello.hello.machine_id = "future-agent"
+    hello.hello.token = "t"
+    hello.hello.min_revision = 3
+    hello.hello.max_revision = 3
+    replies = list(stream(iter([hello])))
+    channel.close()
+    assert len(replies) == 1
+    ack = replies[0].hello_ack
+    assert not ack.accepted
+    assert "no common revision" in ack.reason
+    assert "future-agent" not in cp.agents
+
+
+def test_grpc_bind_conflict_fails_loudly():
+    pytest.importorskip("grpc")
+    cp1 = ControlPlane()
+    cp1.start()
+    try:
+        cp2 = ControlPlane(grpc_port=cp1.grpc_port)
+        with pytest.raises(RuntimeError, match="bind failed|Failed to bind"):
+            cp2.start()
+        cp2.stop()
+    finally:
+        cp1.stop()
+
+
+def test_v2_target_resolution_pins_tls_mode():
+    from gpud_tpu.session.v2.client import resolve_v2_target
+
+    # no override: derived from the endpoint
+    assert resolve_v2_target("https://cp.example", "") == ("cp.example:443", True)
+    assert resolve_v2_target("http://cp.example:8080", "") == (
+        "cp.example:8080",
+        False,
+    )
+    # scheme on the override pins its own TLS mode
+    assert resolve_v2_target("https://cp.example", "http://127.0.0.1:9") == (
+        "127.0.0.1:9",
+        False,
+    )
+    assert resolve_v2_target("http://cp.example", "https://sec:9") == (
+        "sec:9",
+        True,
+    )
+    # bare host:port inherits the endpoint's scheme
+    assert resolve_v2_target("https://cp.example", "127.0.0.1:9") == (
+        "127.0.0.1:9",
+        True,
+    )
+
+
+def test_session_v2_target_param_beats_env(monkeypatch):
+    monkeypatch.setenv("TPUD_SESSION_V2_TARGET", "env:1")
+    s = Session(
+        endpoint="http://cp",
+        machine_id="m",
+        dispatch_fn=lambda r: {},
+        v2_target="param:2",
+    )
+    assert s.v2_target == "param:2"
+    s2 = Session(endpoint="http://cp", machine_id="m", dispatch_fn=lambda r: {})
+    assert s2.v2_target == "env:1"
+
+
+def test_cli_manager_clean_errors_without_manager(capsys):
+    """Operator CLI failures print one-line errors, never tracebacks."""
+    from gpud_tpu.cli import main
+
+    rc = main(
+        ["manager", "machines", "--endpoint", "http://127.0.0.1:1"]  # closed
+    )
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert err.startswith("error:")
+    rc = main(
+        [
+            "manager",
+            "request",
+            "m1",
+            "states",
+            "--endpoint",
+            "http://127.0.0.1:1",
+            "--params",
+            "{bad json",
+        ]
+    )
+    assert rc == 1
+    assert "error:" in capsys.readouterr().err
+
+
+# -- handle semantics ------------------------------------------------------
+
+
+def test_agent_gone_fails_pending_requests():
+    h = AgentHandle("m", "v1")
+    import threading
+
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(h.request({"method": "states"}, timeout=5))
+    )
+    t.start()
+    time.sleep(0.1)
+    h.mark_gone()
+    t.join(timeout=5)
+    assert got == [{"error": "agent disconnected"}]
+    with pytest.raises(AgentGone):
+        h.request({"method": "states"})
+
+
+def test_unsolicited_responses_bounded():
+    h = AgentHandle("m", "v1")
+    for i in range(200):
+        h.resolve(f"unknown-{i}", {"i": i})
+    assert len(h.unsolicited) == 64
+    assert h.unsolicited[-1]["data"]["i"] == 199
+
+
+def test_reconnect_replaces_stale_handle(tmp_path):
+    cp = ControlPlane()
+    cp.start()
+    try:
+        h1 = AgentHandle("dup", "v1")
+        cp._register(h1)
+        h2 = AgentHandle("dup", "v1")
+        cp._register(h2)
+        assert h1.gone and not h2.gone
+        assert cp.agent("dup") is h2
+    finally:
+        cp.stop()
+
+
+# -- CLI surface -----------------------------------------------------------
+
+
+def test_cli_manager_machines_and_request(stack, capsys):
+    cp, _srv = stack
+    from gpud_tpu.cli import main
+
+    rc = main(["manager", "machines", "--endpoint", cp.endpoint])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "cp-agent-1" in {m["machine_id"] for m in out["machines"]}
+
+    rc = main(
+        [
+            "manager",
+            "request",
+            "cp-agent-1",
+            "states",
+            "--endpoint",
+            cp.endpoint,
+            "--params",
+            '{"components": ["cpu"]}',
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    states = out["response"]["states"]
+    assert [s["component"] for s in states] == ["cpu"]
+
+
+def test_cli_manager_request_unknown_machine_fails(stack, capsys):
+    cp, _srv = stack
+    from gpud_tpu.cli import main
+
+    rc = main(
+        ["manager", "request", "ghost", "states", "--endpoint", cp.endpoint]
+    )
+    assert rc == 1
+    assert "404" in capsys.readouterr().err
+
+
+def test_cli_manager_positional_method_wins_over_params(stack, capsys):
+    """--params must not smuggle a different method past the positional
+    argument (states stays states, no reboot)."""
+    cp, _srv = stack
+    from gpud_tpu.cli import main
+
+    rc = main(
+        [
+            "manager",
+            "request",
+            "cp-agent-1",
+            "gossip",
+            "--endpoint",
+            cp.endpoint,
+            "--params",
+            '{"method": "reboot"}',
+        ]
+    )
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["response"]["status"] in ("started", "ok")  # gossip ran
+
+
+def test_cli_manager_machines_clean_error_on_401(capsys):
+    from gpud_tpu.cli import main
+
+    cp = ControlPlane(admin_token="adm")
+    cp.start()
+    try:
+        rc = main(["manager", "machines", "--endpoint", cp.endpoint])
+        assert rc == 1
+        assert "401" in capsys.readouterr().err
+    finally:
+        cp.stop()

@@ -30,7 +30,7 @@ BENIGN_DUMP = """\
 """
 
 
-from tests.conftest import write_pstore_dump as _write
+from tests.helpers import write_pstore_dump as _write
 
 
 @pytest.mark.parametrize(
