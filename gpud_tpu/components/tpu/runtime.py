@@ -129,7 +129,10 @@ class TPUProcessesComponent(PollingComponent):
         try:
             path = os.path.join(self.proc_root, str(pid), "stat")
             with open(path, "r", encoding="ascii") as f:
-                return f.read().split(") ", 1)[1].split()[0]
+                # comm may itself contain ') ' (prctl PR_SET_NAME is
+                # arbitrary bytes) — the stat contract is: state is the
+                # first field after the LAST ')'
+                return f.read().rsplit(")", 1)[1].split()[0]
         except (OSError, IndexError):
             return "?"
 

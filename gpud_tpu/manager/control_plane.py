@@ -63,6 +63,7 @@ class AgentHandle:
         self._lock = threading.Lock()
         self._gone = threading.Event()
         self.draining = threading.Event()  # v2: send DrainNotice on teardown
+        self.drain_reason = "manager draining"
         self._seq = 0
 
     # -- operator side -----------------------------------------------------
@@ -218,6 +219,7 @@ class ControlPlane:
 
         body = await request.json()
         self.logins.append(body)
+        del self.logins[:-64]  # bounded like AgentHandle.unsolicited
         # fixed-token fleets must present the secret to enroll; otherwise
         # login would hand the session token to any caller
         if self.session_token is not None and body.get("token") != self.session_token:
@@ -255,8 +257,10 @@ class ControlPlane:
             self._register(handle)
             try:
                 while not handle.gone:
+                    # block with no timeout: mark_gone()'s None sentinel
+                    # guarantees wakeup, so idle agents cost zero churn
                     item = await asyncio.get_event_loop().run_in_executor(
-                        self._stream_pool, _q_get, handle.outbound
+                        self._stream_pool, handle.outbound.get
                     )
                     if item is None:
                         if handle.gone:
@@ -373,8 +377,15 @@ class ControlPlane:
         )
         self._thread.start()
         if not self._started.wait(10.0):
+            self.stop()
             raise RuntimeError("manager HTTP server failed to start")
-        self._start_grpc()
+        try:
+            self._start_grpc()
+        except Exception:
+            # start() is atomic: a gRPC bind failure must not leak the
+            # already-listening HTTP thread/socket
+            self.stop()
+            raise
         logger.info(
             "control plane up: http=127.0.0.1:%d grpc=127.0.0.1:%d",
             self.port,
@@ -498,11 +509,18 @@ class ControlPlane:
             while not stop.is_set() and context.is_active():
                 if handle.draining.is_set():
                     d = pb.ManagerPacket()
-                    d.drain_notice.reason = "manager draining"
+                    d.drain_notice.reason = handle.drain_reason
                     yield d
                     return
                 item = _q_get(handle.outbound, timeout=0.2)
                 if item is None:
+                    # drain's mark_gone() sentinel can land while we wait:
+                    # the notice must still go out before the stream ends
+                    if handle.draining.is_set():
+                        d = pb.ManagerPacket()
+                        d.drain_notice.reason = handle.drain_reason
+                        yield d
+                        return
                     if handle.gone:
                         return
                     continue
@@ -533,6 +551,7 @@ class ControlPlane:
         with self._lock:
             handles = list(self.agents.values())
         for h in handles:
+            h.drain_reason = reason
             h.draining.set()
             h.mark_gone()
 
@@ -540,10 +559,15 @@ class ControlPlane:
         self.drain("manager stopping")
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1.0)
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._grpc_server = None
+        if self._loop is not None and not self._loop.is_closed():
+            try:
+                self._loop.call_soon_threadsafe(self._loop.stop)
+            except RuntimeError:
+                pass  # loop closed between the check and the call
         if self._thread is not None:
             self._thread.join(timeout=5.0)
+            self._thread = None
         self._stream_pool.shutdown(wait=False, cancel_futures=True)
         self._op_pool.shutdown(wait=False, cancel_futures=True)
 

@@ -1,0 +1,320 @@
+"""CLI subcommand matrix — in-process `main([...])` drives of the paths
+the subprocess e2e can't trace (reference: cmd/gpud command surface,
+SURVEY §3.5). systemd effects are scripted; network targets are the
+shared live_server fixture or a real ControlPlane."""
+
+import json
+
+import pytest
+
+from gpud_tpu.cli import main
+
+
+# -- inject-fault ----------------------------------------------------------
+
+
+def test_inject_fault_by_name(tmp_path, capsys):
+    kmsg = tmp_path / "kmsg"
+    kmsg.write_text("")
+    rc = main(
+        [
+            "inject-fault",
+            "--kmsg-path",
+            str(kmsg),
+            "--data-dir",
+            str(tmp_path / "d"),
+            "--name",
+            "tpu_hbm_ecc_uncorrectable",
+            "--chip-id",
+            "2",
+        ]
+    )
+    assert rc == 0
+    assert "fault injected" in capsys.readouterr().out
+    line = kmsg.read_text()
+    assert "tpu_hbm_ecc_uncorrectable" in line and "chip=2" in line
+
+
+def test_inject_fault_raw_kernel_message(tmp_path, capsys):
+    kmsg = tmp_path / "kmsg"
+    kmsg.write_text("")
+    rc = main(
+        [
+            "inject-fault",
+            "--kmsg-path",
+            str(kmsg),
+            "--data-dir",
+            str(tmp_path / "d"),
+            "--kernel-message",
+            "custom oops line",
+        ]
+    )
+    assert rc == 0
+    assert "custom oops line" in kmsg.read_text()
+
+
+def test_inject_fault_unknown_name_fails(tmp_path, capsys):
+    kmsg = tmp_path / "kmsg"
+    kmsg.write_text("")
+    rc = main(
+        [
+            "inject-fault",
+            "--kmsg-path",
+            str(kmsg),
+            "--data-dir",
+            str(tmp_path / "d"),
+            "--name",
+            "not_a_catalog_entry",
+        ]
+    )
+    assert rc == 1
+    assert "error" in capsys.readouterr().err
+
+
+# -- status / set-healthy against a live daemon ----------------------------
+
+
+def test_status_human_and_json(live_server, capsys):
+    port = live_server.port
+    rc = main(["status", "--no-tls", "--port", str(port)])
+    out = capsys.readouterr().out
+    assert rc in (0, 1)  # health depends on shared-fixture state
+    assert "tpud" in out and "cpu" in out
+
+    rc = main(["status", "--no-tls", "--port", str(port), "--json"])
+    data = json.loads(capsys.readouterr().out)
+    assert "version" in data and isinstance(data["components"], list)
+    comps = {c["component"] for c in data["components"]}
+    assert "cpu" in comps
+
+
+def test_status_unreachable(capsys):
+    rc = main(["status", "--no-tls", "--port", "1"])
+    assert rc == 1
+    assert "unreachable" in capsys.readouterr().err
+
+
+def test_set_healthy_roundtrip(live_server, tmp_path, capsys):
+    rc = main(
+        [
+            "set-healthy",
+            "--no-tls",
+            "--port",
+            str(live_server.port),
+            "--component",
+            "accelerator-tpu-error-kmsg",
+            "--data-dir",
+            str(tmp_path / "d"),
+        ]
+    )
+    assert rc == 0
+    assert "set-healthy" in capsys.readouterr().out
+
+
+def test_set_healthy_unreachable(tmp_path, capsys):
+    rc = main(
+        [
+            "set-healthy",
+            "--no-tls",
+            "--port",
+            "1",
+            "--component",
+            "cpu",
+            "--data-dir",
+            str(tmp_path / "d"),
+        ]
+    )
+    assert rc == 1
+
+
+# -- compact / notify ------------------------------------------------------
+
+
+def test_compact_and_notify(tmp_path, capsys):
+    data = tmp_path / "data"
+    rc = main(["notify", "startup", "--data-dir", str(data)])
+    assert rc == 0
+    assert "recorded startup" in capsys.readouterr().out
+
+    rc = main(["compact", "--data-dir", str(data)])
+    assert rc == 0
+    assert "compacted" in capsys.readouterr().out
+
+    # the notify event landed in the os bucket
+    from gpud_tpu.eventstore import EventStore
+    from gpud_tpu.sqlite import DB
+    from gpud_tpu.config import default_config
+
+    cfg = default_config(data_dir=str(data))
+    es = EventStore(DB(cfg.state_file()))
+    events = es.bucket("os").get(0)
+    assert any(e.name == "daemon_startup" for e in events)
+
+
+# -- up / down -------------------------------------------------------------
+
+
+def test_up_no_systemd_with_login(tmp_path, capsys):
+    from gpud_tpu.manager.control_plane import ControlPlane
+
+    cp = ControlPlane()
+    cp.start()
+    try:
+        rc = main(
+            [
+                "up",
+                "--no-systemd",
+                "--data-dir",
+                str(tmp_path / "data"),
+                "--token",
+                "join-tok",
+                "--endpoint",
+                cp.endpoint,
+            ]
+        )
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "login ok" in out and "skipping systemd" in out
+        assert len(cp.logins) == 1
+        # identity persisted for the daemon to pick up
+        from gpud_tpu.config import default_config
+        from gpud_tpu.metadata import Metadata
+        from gpud_tpu.sqlite import DB
+
+        md = Metadata(DB(default_config(data_dir=str(tmp_path / "data")).state_file()))
+        assert md.machine_id()
+    finally:
+        cp.stop()
+
+
+def test_up_login_failure(tmp_path, capsys):
+    rc = main(
+        [
+            "up",
+            "--no-systemd",
+            "--data-dir",
+            str(tmp_path / "data"),
+            "--token",
+            "t",
+            "--endpoint",
+            "http://127.0.0.1:1",
+        ]
+    )
+    assert rc == 1
+    assert "login failed" in capsys.readouterr().err
+
+
+def test_up_systemd_path_scripted(tmp_path, capsys, monkeypatch):
+    """Root + systemd install path with install_unit scripted (the sandbox
+    must not touch /etc) — includes the token FIFO hand-off retry."""
+    import gpud_tpu.cli as cli
+
+    installed = {}
+
+    def fake_install(flags=""):
+        installed["flags"] = flags
+        return None
+
+    import gpud_tpu.manager.systemd as systemd_mod
+
+    monkeypatch.setattr(systemd_mod, "install_unit", fake_install)
+    # daemon not running → FIFO never appears → warning + rc 1; shrink the
+    # 10×1s hand-off retry (sleep is imported inside cmd_up at call time)
+    import time as time_mod
+
+    real_sleep = time_mod.sleep
+    monkeypatch.setattr(time_mod, "sleep", lambda s: real_sleep(min(s, 0.01)))
+    data = tmp_path / "data"
+    rc = main(["up", "--data-dir", str(data), "--token", "tok"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "token hand-off failed" in err
+    assert installed["flags"] == f"--data-dir {data}"
+
+
+def test_up_systemd_install_error(tmp_path, capsys, monkeypatch):
+    import gpud_tpu.manager.systemd as systemd_mod
+
+    monkeypatch.setattr(
+        systemd_mod, "install_unit", lambda flags="": "daemon-reload failed"
+    )
+    rc = main(["up", "--data-dir", str(tmp_path / "data")])
+    assert rc == 1
+    assert "daemon-reload failed" in capsys.readouterr().err
+
+
+def test_down_scripted(capsys, monkeypatch):
+    import gpud_tpu.manager.systemd as systemd_mod
+
+    monkeypatch.setattr(systemd_mod, "uninstall_unit", lambda: None)
+    rc = main(["down"])
+    assert rc == 0
+    assert "tpud stopped" in capsys.readouterr().out
+
+    monkeypatch.setattr(systemd_mod, "uninstall_unit", lambda: "stop: unit not loaded")
+    rc = main(["down"])
+    assert rc == 0  # best-effort: warning, not failure
+    assert "unit not loaded" in capsys.readouterr().err
+
+
+# -- plugins ---------------------------------------------------------------
+
+
+PLUGIN_YAML = """\
+- name: hello
+  plugin_type: component
+  run_mode: manual
+  steps:
+    - name: s1
+      script: "echo ok"
+"""
+
+
+def test_list_plugins_paths(tmp_path, capsys):
+    data = tmp_path / "data"
+    rc = main(["list-plugins", "--data-dir", str(data)])
+    assert rc == 0
+    assert "no plugin specs" in capsys.readouterr().out
+
+    specs = data / "plugins.yaml"
+    specs.parent.mkdir(parents=True, exist_ok=True)
+    specs.write_text(PLUGIN_YAML)
+    rc = main(["list-plugins", "--data-dir", str(data)])
+    assert rc == 0
+    assert "hello" in capsys.readouterr().out
+
+    specs.write_text("- name: [broken")
+    rc = main(["list-plugins", "--data-dir", str(data)])
+    assert rc == 1
+    assert "INVALID" in capsys.readouterr().err
+
+
+def test_custom_plugins_validate(tmp_path, capsys):
+    f = tmp_path / "p.yaml"
+    f.write_text(PLUGIN_YAML)
+    rc = main(["custom-plugins", str(f)])
+    assert rc == 0
+
+    f.write_text("- name: [broken")
+    rc = main(["custom-plugins", str(f)])
+    assert rc == 1
+
+
+def test_run_plugin_group(tmp_path, capsys):
+    f = tmp_path / "p.yaml"
+    f.write_text(
+        PLUGIN_YAML
+        + """\
+- name: tagged
+  plugin_type: component
+  run_mode: manual
+  tags: [smoke]
+  steps:
+    - name: s1
+      script: "echo tagged-ran"
+"""
+    )
+    rc = main(["run-plugin-group", str(f), "--tag", "smoke"])
+    out = capsys.readouterr().out
+    assert "tagged" in out
+    assert rc in (0, 1)

@@ -418,9 +418,67 @@ def test_grpc_bind_conflict_fails_loudly():
         cp2 = ControlPlane(grpc_port=cp1.grpc_port)
         with pytest.raises(RuntimeError, match="bind failed|Failed to bind"):
             cp2.start()
+        # start() failed atomically: the HTTP side was torn down too, and
+        # a redundant stop() is a safe no-op
+        assert requests_connect_refused(cp2.port)
         cp2.stop()
     finally:
         cp1.stop()
+
+
+def requests_connect_refused(port):
+    import socket
+
+    s = socket.socket()
+    s.settimeout(2)
+    try:
+        return s.connect_ex(("127.0.0.1", port)) != 0
+    finally:
+        s.close()
+
+
+def test_drain_reason_reaches_v2_agents(v2_stack):
+    """The operator's drain reason must arrive in the DrainNotice, not a
+    hard-coded string."""
+    grpc = pytest.importorskip("grpc")
+    from gpud_tpu.session.v2 import session_pb2 as pb
+
+    cp = v2_stack
+    channel = grpc.insecure_channel(f"127.0.0.1:{cp.grpc_port}")
+    stream = channel.stream_stream(
+        "/tpud.session.v2.Session/Connect",
+        request_serializer=pb.AgentPacket.SerializeToString,
+        response_deserializer=pb.ManagerPacket.FromString,
+    )
+    import queue as q_mod
+
+    feed = q_mod.Queue()
+    hello = pb.AgentPacket()
+    hello.hello.machine_id = "drain-watch"
+    hello.hello.token = "t"
+    hello.hello.max_revision = 2
+
+    def gen():
+        yield hello
+        while True:
+            item = feed.get()
+            if item is None:
+                return
+            yield item
+
+    call = stream(gen())
+    replies = iter(call)
+    ack = next(replies)
+    assert ack.hello_ack.accepted
+    deadline = time.time() + 5
+    while time.time() < deadline and "drain-watch" not in cp.agents:
+        time.sleep(0.05)
+    cp.drain("rolling restart xyz")
+    notice = next(replies)
+    assert notice.WhichOneof("payload") == "drain_notice"
+    assert notice.drain_notice.reason == "rolling restart xyz"
+    feed.put(None)
+    channel.close()
 
 
 def test_v2_target_resolution_pins_tls_mode():

@@ -1,0 +1,234 @@
+"""Lock-order deadlock detection (tools/lockcheck.py) — the -race analog
+(reference runs its suite under the Go race detector,
+scripts/tests-unit.sh:26-33). Unit-tests the detector, then sweeps the
+live daemon's hot paths under instrumentation and asserts the global
+lock-order graph is acyclic with zero self-deadlocks."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from gpud_tpu.tools.lockcheck import DeadlockError, LockOrderDetector
+
+
+def test_order_edges_recorded():
+    det = LockOrderDetector()
+    a, b = det.make_lock(), det.make_lock()
+    with a:
+        with b:
+            pass
+    assert [(x.split("@")[0], y.split("@")[0]) for x, y in det.edges] == [
+        ("Lock", "Lock")
+    ]
+    assert det.cycles() == []
+
+
+def test_inverted_order_is_a_cycle():
+    det = LockOrderDetector()
+    a, b = det.make_lock(), det.make_lock()
+    a.name, b.name = "A", "B"
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th1, th2 = threading.Thread(target=t1), threading.Thread(target=t2)
+    th1.start(); th1.join()
+    th2.start(); th2.join()
+    (cycle,) = det.cycles()
+    assert set(cycle) == {"A", "B"}
+    assert "CYCLE" in det.report()
+
+
+def test_three_lock_cycle_detected():
+    det = LockOrderDetector()
+    locks = [det.make_lock() for _ in range(3)]
+    for i, lk in enumerate(locks):
+        lk.name = f"L{i}"
+    # L0→L1, L1→L2, L2→L0 (each pair taken in order by its own thread)
+    for first, second in [(0, 1), (1, 2), (2, 0)]:
+        def work(f=first, s=second):
+            with locks[f]:
+                with locks[s]:
+                    pass
+        t = threading.Thread(target=work)
+        t.start(); t.join()
+    (cycle,) = det.cycles()
+    assert set(cycle) == {"L0", "L1", "L2"}
+
+
+def test_self_deadlock_raises_instead_of_hanging():
+    det = LockOrderDetector()
+    a = det.make_lock()
+    a.name = "A"
+    a.acquire()
+    with pytest.raises(DeadlockError, match="self-deadlock: A"):
+        a.acquire()
+    a.release()
+    assert det.self_deadlocks
+
+
+def test_nonblocking_reacquire_is_not_a_deadlock():
+    det = LockOrderDetector()
+    a = det.make_lock()
+    a.acquire()
+    assert a.acquire(blocking=False) is False  # try-lock pattern is legal
+    a.release()
+    assert det.self_deadlocks == []
+
+
+def test_rlock_reentrance_allowed_no_self_edge():
+    det = LockOrderDetector()
+    r = det.make_rlock()
+    with r:
+        with r:
+            pass
+    assert det.edges == {} and det.self_deadlocks == []
+
+
+def test_release_out_of_order_keeps_stack_sane():
+    det = LockOrderDetector()
+    a, b = det.make_lock(), det.make_lock()
+    a.name, b.name = "A", "B"
+    a.acquire(); b.acquire()
+    a.release()  # release A first (legal)
+    c = det.make_lock()
+    c.name = "C"
+    with c:  # only B is held now → edge B→C, NOT A→C
+        pass
+    b.release()
+    assert ("B", "C") in det.edges and ("A", "C") not in det.edges
+
+
+def test_condition_and_queue_under_instrumentation():
+    """queue.Queue (Condition over a plain Lock) must work wrapped, and a
+    blocked get() must not fabricate order edges while waiting."""
+    det = LockOrderDetector()
+    with det.installed():
+        q = queue.Queue()
+        got = []
+
+        def consumer():
+            got.append(q.get(timeout=5))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        time.sleep(0.1)  # consumer is parked in Condition.wait
+        other = threading.Lock()  # proxy
+        with other:
+            pass
+        q.put("x")
+        t.join(timeout=5)
+    assert got == ["x"]
+    # the parked consumer held q's mutex conceptually, but wait() released
+    # it — no edge from the queue mutex to `other` may exist
+    assert all("queue" not in a.lower() or "queue" in b.lower()
+               for a, b in det.edges), det.edges
+    assert det.cycles() == []
+
+
+def test_event_wait_under_instrumentation():
+    det = LockOrderDetector()
+    with det.installed():
+        ev = threading.Event()
+        seen = []
+
+        def waiter():
+            seen.append(ev.wait(timeout=5))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        ev.set()
+        t.join(timeout=5)
+    assert seen == [True]
+    assert det.self_deadlocks == []
+
+
+def test_install_uninstall_restores_factories():
+    det = LockOrderDetector()
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    with det.installed():
+        from gpud_tpu.tools.lockcheck import _LockProxy
+
+        assert isinstance(threading.Lock(), _LockProxy)
+        assert isinstance(threading.RLock(), _LockProxy)
+    assert threading.Lock is orig_lock and threading.RLock is orig_rlock
+
+
+# -- daemon-wide sweep -----------------------------------------------------
+
+
+def test_daemon_hot_paths_have_acyclic_lock_order(tmp_path):
+    """Boot a full daemon under lock instrumentation, drive its hot paths
+    (component checks, kmsg flood, dispatch methods, metrics scrape,
+    stop), and assert the observed global lock-order graph is acyclic."""
+    det = LockOrderDetector()
+    det.raise_on_self_deadlock = True  # fail fast inside daemon threads
+
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    kmsg = tmp_path / "kmsg.fixture"
+    kmsg.write_text("")
+    # module-global locks predate install(); wrap them explicitly so their
+    # nestings show up in the graph
+    import gpud_tpu.log as logmod
+    import gpud_tpu.sqlite as sqlmod
+    from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
+
+    det.wrap_attr(sqlmod, "_stats_mu", "sqlite._stats_mu")
+    det.wrap_attr(logmod, "_mu", "log._mu")
+    det.wrap_attr(DEFAULT_REGISTRY, "_mu", "metrics.Registry._mu")
+    for metric in list(DEFAULT_REGISTRY._metrics.values()):
+        det.wrap_attr(metric, "_mu", f"metric[{metric.name}]._mu")
+    with det.installed():
+        cfg = default_config(
+            data_dir=str(tmp_path / "data"),
+            port=0,
+            tls=False,
+            kmsg_path=str(kmsg),
+            components_disabled=["network-latency"],
+        )
+        srv = Server(config=cfg)
+        srv.start()
+        try:
+            # trigger every component once (the checks hold component +
+            # store + metrics locks in sequence)
+            for comp in list(srv.registry.all()):
+                try:
+                    comp.check_once()
+                except Exception:  # noqa: BLE001 - health result, not test
+                    pass
+            # kmsg flood through watcher → parser → deduper → syncer
+            with open(kmsg, "a", encoding="utf-8") as f:
+                for i in range(50):
+                    f.write(f"6,{i},{i}000,-;benign line {i}\n")
+            time.sleep(0.5)
+            # dispatch surface (the session serve path without a manager —
+            # the server only builds one when enrolled, so build it here)
+            from gpud_tpu.session.dispatch import Dispatcher
+
+            dispatcher = Dispatcher(srv)
+            for method in ("states", "events", "metrics", "gossip"):
+                dispatcher({"method": method})
+        finally:
+            srv.stop()
+            det.unwrap_all()
+
+    assert det.self_deadlocks == [], det.report()
+    cycles = det.cycles()
+    assert cycles == [], det.report()
+    # sanity that instrumentation observed real nesting: the daemon's lock
+    # graph is deliberately nearly flat (single-lock critical sections
+    # everywhere), so the sweep sees only a couple of nesting edges — the
+    # low count plus zero cycles IS the property this test pins
+    assert 2 <= len(det.edges) <= 40, det.report()

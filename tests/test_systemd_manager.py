@@ -1,0 +1,116 @@
+"""systemd unit install/uninstall (manager/systemd.py) — the `tpud up`
+service path (reference: pkg/gpud-manager/systemd). systemctl is scripted
+via run_command monkeypatching; file writes go to tmp paths."""
+
+import pytest
+
+import gpud_tpu.manager.systemd as systemd
+
+
+class R:
+    def __init__(self, exit_code=0, output="", error=""):
+        self.exit_code = exit_code
+        self.output = output
+        self.error = error
+
+
+@pytest.fixture()
+def systemctl_log(monkeypatch):
+    """Record every systemctl invocation; scripted answers by subcommand."""
+    calls = []
+    answers = {}
+
+    def fake_run(argv, timeout=0):
+        calls.append(argv)
+        return answers.get(argv[1], R())
+
+    monkeypatch.setattr(systemd, "run_command", fake_run)
+    return calls, answers
+
+
+def test_render_unit_contract():
+    text = systemd.render_unit(python="/opt/py", env_file="/tmp/envf")
+    assert "Type=notify" in text
+    assert "ExecStart=/opt/py -m gpud_tpu run $TPUD_FLAGS" in text
+    assert "EnvironmentFile=-/tmp/envf" in text
+    assert "Restart=always" in text
+    # self-update (244) and plugin-change (245) exit codes must not count
+    # as failures or Restart=always would loop the old binary forever
+    assert "SuccessExitStatus=244 245" in text
+
+
+def test_render_unit_defaults_to_current_python():
+    import sys
+
+    assert f"ExecStart={sys.executable} -m gpud_tpu run" in systemd.render_unit()
+
+
+def test_install_unit_writes_files_and_enables(tmp_path, systemctl_log):
+    calls, _ = systemctl_log
+    unit = tmp_path / "units" / "tpud.service"
+    envf = tmp_path / "default-tpud"
+    err = systemd.install_unit(
+        flags="--port 1234", unit_path=str(unit), env_file=str(envf)
+    )
+    assert err is None
+    assert "Type=notify" in unit.read_text()
+    assert envf.read_text() == 'TPUD_FLAGS="--port 1234"\n'
+    assert [c[1] for c in calls] == ["daemon-reload", "enable", "restart"]
+
+
+def test_install_unit_reports_unwritable_path(tmp_path, systemctl_log):
+    calls, _ = systemctl_log
+    # a regular file where a directory is needed fails even as root
+    # (chmod-based denial doesn't apply to uid 0)
+    blocked = tmp_path / "blocked"
+    blocked.write_text("")
+    err = systemd.install_unit(
+        unit_path=str(blocked / "sub" / "tpud.service"),
+        env_file=str(tmp_path / "envf"),
+    )
+    assert err is not None and "cannot write unit files" in err
+    assert calls == []  # no systemctl calls after a failed write
+
+
+def test_install_unit_surfaces_systemctl_failure(tmp_path, systemctl_log):
+    _, answers = systemctl_log
+    answers["enable"] = R(exit_code=1, output="Failed to enable unit\n")
+    err = systemd.install_unit(
+        unit_path=str(tmp_path / "tpud.service"),
+        env_file=str(tmp_path / "envf"),
+    )
+    assert err is not None
+    assert "systemctl enable" in err and "Failed to enable" in err
+
+
+def test_uninstall_unit_happy_path(tmp_path, systemctl_log):
+    calls, _ = systemctl_log
+    unit = tmp_path / "tpud.service"
+    unit.write_text("[Unit]\n")
+    assert systemd.uninstall_unit(unit_path=str(unit)) is None
+    assert not unit.exists()
+    assert [c[1] for c in calls] == ["stop", "disable", "daemon-reload"]
+
+
+def test_uninstall_unit_collects_errors_but_continues(tmp_path, systemctl_log):
+    """stop failing must not prevent disable/unlink/daemon-reload — best
+    effort teardown with all errors reported."""
+    calls, answers = systemctl_log
+    answers["stop"] = R(exit_code=5, output="", error="unit not loaded")
+    unit = tmp_path / "tpud.service"
+    unit.write_text("[Unit]\n")
+    err = systemd.uninstall_unit(unit_path=str(unit))
+    assert err is not None and "stop" in err
+    assert not unit.exists()  # unlink still happened
+    assert [c[1] for c in calls] == ["stop", "disable", "daemon-reload"]
+
+
+def test_uninstall_unit_missing_file_is_fine(tmp_path, systemctl_log):
+    assert systemd.uninstall_unit(unit_path=str(tmp_path / "nope.service")) is None
+
+
+def test_is_active(systemctl_log):
+    _, answers = systemctl_log
+    assert systemd.is_active() is True
+    answers["is-active"] = R(exit_code=3, output="inactive\n")
+    assert systemd.is_active() is False

@@ -1,0 +1,216 @@
+"""TPU runtime + device-holder components (components/tpu/runtime.py) —
+the fabric-manager / processes analogs (reference:
+components/accelerator/nvidia/fabric-manager, .../processes).
+
+Both components expose injectable seams (is_active_fn, proc_root) so the
+scenarios run against a staged /proc tree and scripted systemd answers,
+per the repo's function-valued-injectable test strategy (SURVEY §4.1).
+"""
+
+import os
+
+import pytest
+
+from gpud_tpu.api.v1.types import HealthStateType, RepairActionType
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.components.tpu.runtime import (
+    TPUProcessesComponent,
+    TPURuntimeComponent,
+)
+from gpud_tpu.tpu.instance import new_instance
+
+
+@pytest.fixture()
+def instance():
+    # conftest's TPUD_TPU_MOCK_ALL_SUCCESS env selects the MockBackend
+    return TpudInstance(tpu_instance=new_instance())
+
+
+def _runtime(instance, answers):
+    c = TPURuntimeComponent(instance)
+    c.is_active_fn = lambda unit: answers.get(unit, "absent")
+    # the mock backend short-circuits check_once; these scenarios model a
+    # real TPU VM, so drop the mock flag
+    c.tpu.is_mock = lambda: False
+    return c
+
+
+# -- runtime units ---------------------------------------------------------
+
+
+def test_runtime_all_units_active(instance):
+    c = _runtime(
+        instance,
+        {"tpu-runtime.service": "active", "tpu-device-daemon.service": "active"},
+    )
+    cr = c.check_once()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert "runtime units healthy" in cr.reason
+    assert cr.extra_info["tpu-runtime.service"] == "active"
+
+
+def test_runtime_failed_unit_unhealthy_with_reboot_action(instance):
+    c = _runtime(instance, {"tpu-runtime.service": "failed"})
+    cr = c.check_once()
+    assert cr.health_state_type() == HealthStateType.UNHEALTHY
+    assert "tpu-runtime.service" in cr.reason
+    assert RepairActionType.REBOOT_SYSTEM in cr.suggested_actions.repair_actions
+
+
+def test_runtime_no_units_present_is_direct_libtpu_mode(instance):
+    c = _runtime(instance, {})
+    cr = c.check_once()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert "direct libtpu mode" in cr.reason
+
+
+def test_runtime_inactive_but_present_is_not_failure(instance):
+    # inactive ≠ failed: a stopped optional daemon doesn't raise alarms,
+    # matching the reference's treatment of absent fabric-manager on
+    # non-NVSwitch parts
+    c = _runtime(instance, {"tpu-device-daemon.service": "inactive"})
+    cr = c.check_once()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert cr.extra_info["tpu-device-daemon.service"] == "inactive"
+
+
+def test_runtime_mock_backend_short_circuits(instance):
+    c = TPURuntimeComponent(instance)
+    called = []
+    c.is_active_fn = lambda unit: called.append(unit) or "failed"
+    cr = c.check_once()  # mock backend (conftest env) skips systemd entirely
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert called == []
+
+
+def test_systemd_is_active_classification(monkeypatch):
+    """'active' | 'inactive' | 'failed' | 'absent' from systemctl output."""
+    import gpud_tpu.components.tpu.runtime as rt
+
+    class R:
+        def __init__(self, exit_code, output="", error=""):
+            self.exit_code = exit_code
+            self.output = output
+            self.error = error
+
+    cases = [
+        (R(0, "active\n"), "active"),
+        (R(3, "inactive\n"), "inactive"),
+        (R(3, "failed\n"), "failed"),
+        (R(4, "Unit x.service could not be found.\n"), "absent"),
+        (R(1, "", error="systemctl: not found"), "absent"),
+        (R(3, ""), "inactive"),  # empty output falls back to inactive
+    ]
+    for result, expected in cases:
+        monkeypatch.setattr(rt, "run_command", lambda *a, r=result, **k: r)
+        assert TPURuntimeComponent._systemd_is_active("x.service") == expected
+
+
+# -- device holders (/proc fd scan) ---------------------------------------
+
+
+def _stage_proc(tmp_path, pid, fd_targets, state="S", comm="python"):
+    """Stage /proc/<pid>/{fd/*,stat} with symlinked fd targets."""
+    pid_dir = tmp_path / str(pid)
+    fd_dir = pid_dir / "fd"
+    fd_dir.mkdir(parents=True)
+    for i, target in enumerate(fd_targets):
+        os.symlink(target, fd_dir / str(i))
+    (pid_dir / "stat").write_text(f"{pid} ({comm}) {state} 1 {pid} ...\n")
+    return pid_dir
+
+
+def _processes(instance, tmp_path):
+    c = TPUProcessesComponent(instance)
+    c.tpu.is_mock = lambda: False
+    c.proc_root = str(tmp_path)
+    return c
+
+
+def test_holders_found_from_fd_symlinks(instance, tmp_path):
+    _stage_proc(tmp_path, 100, ["/dev/accel0", "/dev/null", "/dev/accel1"])
+    _stage_proc(tmp_path, 200, ["/dev/vfio/10"])
+    _stage_proc(tmp_path, 300, ["/dev/null", "/tmp/x"])  # not a holder
+    c = _processes(instance, tmp_path)
+    cr = c.check_once()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert "2 process(es) holding TPU devices" in cr.reason
+    assert cr.extra_info["100"] == "/dev/accel0,/dev/accel1"
+    assert cr.extra_info["200"] == "/dev/vfio/10"
+    assert "300" not in cr.extra_info
+
+
+def test_stuck_holder_degrades_then_escalates(instance, tmp_path):
+    """First D-state sighting → Degraded; still stuck on the next check →
+    Unhealthy with reboot guidance (runtime.py escalation contract)."""
+    _stage_proc(tmp_path, 42, ["/dev/accel0"], state="D")
+    c = _processes(instance, tmp_path)
+    first = c.check_once()
+    assert first.health_state_type() == HealthStateType.DEGRADED
+    assert "[42]" in first.reason
+    second = c.check_once()
+    assert second.health_state_type() == HealthStateType.UNHEALTHY
+    assert "across checks" in second.reason
+    actions = second.suggested_actions.repair_actions
+    assert RepairActionType.REBOOT_SYSTEM in actions
+    assert RepairActionType.CHECK_USER_APP_AND_TPU in actions
+
+
+def test_stuck_holder_recovering_clears(instance, tmp_path):
+    pid_dir = _stage_proc(tmp_path, 42, ["/dev/accel0"], state="D")
+    c = _processes(instance, tmp_path)
+    assert c.check_once().health_state_type() == HealthStateType.DEGRADED
+    # process wakes up (D → S): next check is healthy, no escalation
+    (pid_dir / "stat").write_text("42 (python) S 1 42 ...\n")
+    cr = c.check_once()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+
+
+def test_different_pid_stuck_does_not_inherit_escalation(instance, tmp_path):
+    """Escalation is per-pid: a NEW stuck pid starts at Degraded even if
+    another pid was stuck on the previous check."""
+    _stage_proc(tmp_path, 42, ["/dev/accel0"], state="D")
+    c = _processes(instance, tmp_path)
+    assert c.check_once().health_state_type() == HealthStateType.DEGRADED
+    import shutil
+
+    shutil.rmtree(tmp_path / "42")
+    _stage_proc(tmp_path, 43, ["/dev/accel1"], state="D")
+    cr = c.check_once()
+    assert cr.health_state_type() == HealthStateType.DEGRADED
+    assert "[43]" in cr.reason
+
+
+def test_comm_with_parens_and_spaces_parsed(instance, tmp_path):
+    """/proc stat comm may contain ') ' lookalikes — the parser splits on
+    the LAST sensible boundary via ') ' after the comm field."""
+    pid_dir = _stage_proc(tmp_path, 77, ["/dev/accel0"])
+    (pid_dir / "stat").write_text("77 (tpu) worker) D 1 77 ...\n")
+    c = _processes(instance, tmp_path)
+    # state must parse as D (from the final field), not crash
+    cr = c.check_once()
+    assert cr.health_state_type() == HealthStateType.DEGRADED
+
+
+def test_broken_fd_symlinks_and_garbage_dirs_ignored(instance, tmp_path):
+    pid_dir = tmp_path / "55"
+    (pid_dir / "fd").mkdir(parents=True)
+    os.symlink("/dev/accel0", pid_dir / "fd" / "0")
+    # stat missing entirely → state "?" (not stuck, not crash)
+    garbage = tmp_path / "not-a-pid"
+    (garbage / "fd").mkdir(parents=True)
+    os.symlink("/dev/accel9", garbage / "fd" / "0")
+    c = _processes(instance, tmp_path)
+    cr = c.check_once()
+    assert cr.health_state_type() == HealthStateType.HEALTHY
+    assert cr.extra_info == {"55": "/dev/accel0"}
+
+
+def test_holder_gauge_tracks_count(instance, tmp_path):
+    from gpud_tpu.components.tpu.runtime import _g_holders
+
+    _stage_proc(tmp_path, 101, ["/dev/accel0"])
+    c = _processes(instance, tmp_path)
+    c.check_once()
+    values = dict(_g_holders.labels_values())
+    assert any(v == 1.0 for v in values.values())
