@@ -128,12 +128,12 @@ class TPUProcessesComponent(PollingComponent):
     def _proc_state(self, pid: int) -> str:
         try:
             path = os.path.join(self.proc_root, str(pid), "stat")
-            with open(path, "r", encoding="ascii") as f:
-                # comm may itself contain ') ' (prctl PR_SET_NAME is
-                # arbitrary bytes) — the stat contract is: state is the
-                # first field after the LAST ')'
-                return f.read().rsplit(")", 1)[1].split()[0]
-        except (OSError, IndexError):
+            # comm may contain ') ' AND non-ASCII (prctl PR_SET_NAME is
+            # arbitrary bytes) — read raw and split at the LAST ')' per
+            # the stat contract: state is the first field after it
+            with open(path, "rb") as f:
+                return f.read().rsplit(b")", 1)[1].split()[0].decode("ascii")
+        except (OSError, IndexError, UnicodeDecodeError):
             return "?"
 
     def check_once(self) -> CheckResult:

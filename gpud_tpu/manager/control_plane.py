@@ -155,6 +155,7 @@ class ControlPlane:
         self._started = threading.Event()
         self._grpc_server = None
         self.logins: List[dict] = []
+        self._stopped = False
         # separate pools for the two blocking workloads so they can't
         # starve each other (and the aiohttp loop's small default
         # executor stays free): every v1 read stream pins one stream
@@ -345,6 +346,15 @@ class ControlPlane:
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        """One-shot: after stop() (including the internal cleanup stop on
+        a failed start) the pools are shut down — build a new ControlPlane
+        instead of restarting this one."""
+        if self._stopped:
+            raise RuntimeError(
+                "ControlPlane cannot be restarted; create a new instance"
+            )
+        if self._started.is_set():
+            raise RuntimeError("ControlPlane already started")
         from aiohttp import web
 
         app = web.Application()
@@ -556,6 +566,7 @@ class ControlPlane:
             h.mark_gone()
 
     def stop(self) -> None:
+        self._stopped = True
         self.drain("manager stopping")
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1.0)

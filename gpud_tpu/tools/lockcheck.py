@@ -241,31 +241,66 @@ class LockOrderDetector:
 
     # -- analysis ----------------------------------------------------------
     def cycles(self) -> List[List[str]]:
-        """Cycles in the lock-order graph (each a potential deadlock),
-        shortest first, deduped by node set."""
+        """Strongly-connected components of the lock-order graph with more
+        than one lock — each is a potential deadlock. Tarjan (iterative,
+        linear) — no size cap, so the acyclicity guarantee is total.
+        Smallest first."""
         graph: Dict[str, Set[str]] = {}
+        nodes: Set[str] = set()
         for a, b in self.edges:
             graph.setdefault(a, set()).add(b)
+            nodes.add(a)
+            nodes.add(b)
 
-        found: List[List[str]] = []
-        seen_sets: Set[frozenset] = set()
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        counter = [0]
+        out: List[List[str]] = []
 
-        def dfs(start: str, node: str, path: List[str], visited: Set[str]) -> None:
-            for nxt in graph.get(node, ()):  # noqa: B905
-                if nxt == start and len(path) > 1:
-                    key = frozenset(path)
-                    if key not in seen_sets:
-                        seen_sets.add(key)
-                        found.append(path + [start])
-                elif nxt not in visited and len(path) < 8:
-                    visited.add(nxt)
-                    dfs(start, nxt, path + [nxt], visited)
-                    visited.discard(nxt)
+        def strongconnect(root: str) -> None:
+            work: List[tuple] = [(root, iter(sorted(graph.get(root, ()))))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(sorted(graph.get(w, ())))))
+                        advanced = True
+                        break
+                    if w in on_stack:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp: List[str] = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        out.append(sorted(comp))
 
-        for start in list(graph):
-            dfs(start, start, [start], {start})
-        found.sort(key=len)
-        return found
+        for v in sorted(nodes):
+            if v not in index:
+                strongconnect(v)
+        out.sort(key=len)
+        return out
 
     def report(self) -> str:
         lines = [f"{len(self.edges)} lock-order edges observed"]

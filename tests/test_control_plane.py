@@ -548,6 +548,18 @@ def test_cli_manager_clean_errors_without_manager(capsys):
 # -- handle semantics ------------------------------------------------------
 
 
+def test_control_plane_is_one_shot():
+    """start() after stop() must refuse loudly (pools are shut down), and
+    double-start is an error — not a silent half-working restart."""
+    cp = ControlPlane()
+    cp.start()
+    with pytest.raises(RuntimeError, match="already started"):
+        cp.start()
+    cp.stop()
+    with pytest.raises(RuntimeError, match="cannot be restarted"):
+        cp.start()
+
+
 def test_agent_gone_fails_pending_requests():
     h = AgentHandle("m", "v1")
     import threading

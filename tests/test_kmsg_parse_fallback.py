@@ -1,0 +1,181 @@
+"""kmsg pure-Python parse fallback + file-follow edges (kmsg/watcher.py).
+
+The native C++ parser normally short-circuits parse_line; these tests pin
+the Python reference implementation the native path is checked against,
+plus the no-inotify tail fallback with truncation/rotation."""
+
+import os
+import threading
+import time
+
+import pytest
+
+import gpud_tpu.kmsg.watcher as watcher_mod
+from gpud_tpu.kmsg.watcher import Watcher, parse_line, read_all
+
+
+@pytest.fixture()
+def python_parser(monkeypatch):
+    """Force the pure-Python parse path."""
+    monkeypatch.setattr(watcher_mod, "_native_parse", None)
+
+
+def test_parse_line_python_fallback_full_record(python_parser):
+    m = parse_line("6,1234,5000000,-;hello world", boot_unix=1_700_000_000.0)
+    assert m is not None
+    assert (m.priority, m.facility, m.sequence) == (6, 0, 1234)
+    assert m.timestamp_us == 5000000
+    assert m.message == "hello world"
+    assert m.time == pytest.approx(1_700_000_005.0)
+
+
+def test_parse_line_python_facility_split(python_parser):
+    # prefix 30 = facility 3, priority 6
+    m = parse_line("30,1,0,-;daemon line", boot_unix=0)
+    assert (m.priority, m.facility) == (6, 3)
+    # no boot time → wall clock now
+    assert abs(m.time - time.time()) < 5
+
+
+@pytest.mark.parametrize(
+    "line",
+    [
+        "",                      # empty
+        "no semicolon here",     # no ';' separator
+        "6,1;short head",        # <3 header fields
+        "x,1,2,-;bad prefix",    # non-int prefix
+        "6,y,2,-;bad seq",       # non-int seq
+        "6,1,z,-;bad ts",        # non-int timestamp
+    ],
+)
+def test_parse_line_python_rejects_malformed(python_parser, line):
+    assert parse_line(line, boot_unix=0) is None
+
+
+def test_parse_line_extra_header_fields_tolerated(python_parser):
+    # real records carry flags/extra fields after the timestamp
+    m = parse_line("6,2,3000,-,caller=T100;msg", boot_unix=0)
+    assert m is not None and m.sequence == 2 and m.message == "msg"
+
+
+def test_parse_line_semicolons_in_message(python_parser):
+    m = parse_line("6,1,0,-;a;b;c", boot_unix=0)
+    assert m.message == "a;b;c"
+
+
+def test_python_and_native_parsers_agree():
+    if watcher_mod._native_parse is None:
+        pytest.skip("native parser not built")
+    lines = [
+        "6,1234,5000000,-;hello world",
+        "30,1,0,-;daemon line",
+        "2,99,123456,-,caller=T1;TPU-ERR: x chip=0",
+        "no semicolon",
+        "x,1,2,-;bad",
+    ]
+    for ln in lines:
+        native = parse_line(ln, boot_unix=1000.0)
+        orig = watcher_mod._native_parse
+        watcher_mod._native_parse = None
+        try:
+            py = parse_line(ln, boot_unix=1000.0)
+        finally:
+            watcher_mod._native_parse = orig
+        if native is None or py is None:
+            assert native is None and py is None
+        else:
+            assert (native.priority, native.facility, native.sequence,
+                    native.timestamp_us, native.message) == (
+                py.priority, py.facility, py.sequence,
+                py.timestamp_us, py.message)
+
+
+def test_read_all_missing_path_returns_empty(tmp_path):
+    assert read_all(str(tmp_path / "nope")) == []
+
+
+def test_read_all_fixture_limit(tmp_path):
+    f = tmp_path / "kmsg"
+    f.write_text("".join(f"6,{i},{i},-;line {i}\n" for i in range(20)))
+    msgs = read_all(str(f), limit=7)
+    assert len(msgs) == 7
+
+
+def test_follow_file_without_inotify_truncation(tmp_path, monkeypatch):
+    """The sleep-poll fallback (inotify unavailable) must survive file
+    truncation/rotation and keep delivering."""
+    monkeypatch.setattr(
+        watcher_mod._InotifyWatch, "create", staticmethod(lambda path: None)
+    )
+    f = tmp_path / "kmsg"
+    f.write_text("")
+    seen = []
+    cv = threading.Condition()
+
+    def cb(m):
+        with cv:
+            seen.append(m.message)
+            cv.notify_all()
+
+    w = Watcher(path=str(f), callback=cb, from_now=False, poll_timeout_ms=20)
+    w.start()
+    try:
+        with open(f, "a") as fh:
+            fh.write("6,1,0,-;first\n")
+        with cv:
+            assert cv.wait_for(lambda: "first" in seen, timeout=5)
+        # rotate: truncate to zero, then append — the follower must rewind
+        os.truncate(f, 0)
+        time.sleep(0.1)
+        with open(f, "a") as fh:
+            fh.write("6,2,0,-;after-rotate\n")
+        with cv:
+            assert cv.wait_for(lambda: "after-rotate" in seen, timeout=5)
+    finally:
+        w.close()
+
+
+def test_watcher_callback_exception_does_not_kill_follow(tmp_path):
+    f = tmp_path / "kmsg"
+    f.write_text("")
+    seen = []
+    cv = threading.Condition()
+
+    def cb(m):
+        if "poison" in m.message:
+            raise RuntimeError("callback bug")
+        with cv:
+            seen.append(m.message)
+            cv.notify_all()
+
+    w = Watcher(path=str(f), callback=cb, from_now=False, poll_timeout_ms=20)
+    w.start()
+    try:
+        with open(f, "a") as fh:
+            fh.write("6,1,0,-;poison\n")
+            fh.write("6,2,0,-;survivor\n")
+        with cv:
+            assert cv.wait_for(lambda: "survivor" in seen, timeout=5)
+    finally:
+        w.close()
+
+
+def test_watcher_start_idempotent_close_twice(tmp_path):
+    f = tmp_path / "kmsg"
+    f.write_text("")
+    w = Watcher(path=str(f), callback=lambda m: None)
+    w.start()
+    t1 = w._thread
+    w.start()
+    assert w._thread is t1  # second start is a no-op
+    w.close()
+    w.close()  # idempotent
+    assert w._thread is None
+
+
+def test_watcher_open_failure_retries_not_crash(tmp_path):
+    w = Watcher(path=str(tmp_path / "missing"), callback=lambda m: None)
+    w.start()
+    time.sleep(0.2)  # the open-failure path logs and waits; thread alive
+    assert w._thread.is_alive()
+    w.close()

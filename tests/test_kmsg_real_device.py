@@ -1,0 +1,143 @@
+"""kmsg path against the REAL /dev/kmsg character device.
+
+Everything else in the suite runs fixture files (env override); this
+suite closes the loop on the char-device code paths the fixtures can't
+reach — one-record-per-read semantics, EAGAIN end-of-ring, poll()
+wakeups — by reading the live kernel ring and injecting one benign,
+clearly-labelled record through the product writer (the reference
+injects via /dev/kmsg the same way, pkg/kmsg/writer/kmsg.go:35).
+Skips cleanly where the sandbox denies the device.
+"""
+
+import os
+import threading
+import time
+import uuid
+
+import pytest
+
+from gpud_tpu.kmsg.watcher import Watcher, read_all
+from gpud_tpu.kmsg.writer import KmsgWriter
+
+KMSG = "/dev/kmsg"
+
+
+def _kmsg_readable() -> bool:
+    try:
+        fd = os.open(KMSG, os.O_RDONLY | os.O_NONBLOCK)
+        os.close(fd)
+        return True
+    except OSError:
+        return False
+
+
+def _kmsg_writable() -> bool:
+    try:
+        fd = os.open(KMSG, os.O_WRONLY)
+        os.close(fd)
+        return True
+    except OSError:
+        return False
+
+
+readable = pytest.mark.skipif(not _kmsg_readable(), reason="/dev/kmsg unreadable")
+writable = pytest.mark.skipif(
+    not (_kmsg_readable() and _kmsg_writable()), reason="/dev/kmsg not writable"
+)
+
+
+@readable
+def test_read_all_real_ring():
+    msgs = read_all(KMSG)
+    assert msgs, "kernel ring buffer is never empty after boot"
+    # char-device reads return one well-formed record each
+    seqs = [m.sequence for m in msgs]
+    assert seqs == sorted(seqs)
+    assert all(m.raw and m.message is not None for m in msgs)
+    # boot-relative timestamps were converted to wall clock
+    assert all(m.time > 1_000_000_000 for m in msgs)
+
+
+@readable
+def test_read_all_limit_stops_early():
+    limited = read_all(KMSG, limit=5)
+    assert len(limited) == 5
+
+
+@writable
+def test_writer_record_roundtrips_through_real_ring():
+    """Product writer → kernel ring → product reader, verbatim."""
+    marker = f"tpud-test {uuid.uuid4().hex}: benign writer roundtrip"
+    err = KmsgWriter(path=KMSG).write(marker, priority=6)
+    assert err is None
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        hits = [m for m in read_all(KMSG) if marker in m.message]
+        if hits:
+            assert hits[0].priority == 6
+            return
+        time.sleep(0.2)
+    raise AssertionError("record never appeared in the ring")
+
+
+@writable
+def test_watcher_follows_real_device():
+    """Watcher in from_now mode sees only records injected after start —
+    the poll()+EAGAIN device loop, not the fixture tail."""
+    marker = f"tpud-test {uuid.uuid4().hex}: benign watcher follow"
+    got = threading.Event()
+    seen = []
+
+    def cb(m):
+        if marker in m.message:
+            seen.append(m)
+            got.set()
+
+    w = Watcher(path=KMSG, callback=cb, from_now=True)
+    w.start()
+    try:
+        time.sleep(0.3)  # let the follow loop reach the ring tail
+        assert KmsgWriter(path=KMSG).write(marker, priority=5) is None
+        assert got.wait(5.0), "watcher missed the injected record"
+        assert seen[0].priority == 5
+    finally:
+        w.close()
+
+
+@writable
+def test_device_detection_latency_subsecond():
+    """The headline property: a fault line hitting the real kernel ring is
+    delivered to the callback in well under a second (BENCH kmsg p50 is
+    ~1ms against fixtures; the device path must be the same order)."""
+    marker = f"tpud-test {uuid.uuid4().hex}: benign latency probe"
+    t_seen = {}
+    got = threading.Event()
+
+    def cb(m):
+        if marker in m.message and "t" not in t_seen:
+            t_seen["t"] = time.monotonic()
+            got.set()
+
+    w = Watcher(path=KMSG, callback=cb, from_now=True)
+    w.start()
+    try:
+        time.sleep(0.3)
+        t0 = time.monotonic()
+        KmsgWriter(path=KMSG).write(marker, priority=6)
+        assert got.wait(5.0)
+        latency = t_seen["t"] - t0
+        assert latency < 1.0, f"device-path delivery took {latency:.3f}s"
+    finally:
+        w.close()
+
+
+@readable
+def test_scan_error_component_reads_real_ring():
+    """Scan mode's kmsg source works against the live ring (the scan CLI
+    on a real host takes exactly this path)."""
+    from gpud_tpu.kmsg.watcher import kmsg_path
+
+    # env override points at fixtures during tests; bypass it explicitly
+    msgs = read_all(KMSG, limit=50)
+    assert len(msgs) == 50
+    assert kmsg_path("") != ""  # env override still wins for the daemon

@@ -65,6 +65,42 @@ def test_three_lock_cycle_detected():
     assert set(cycle) == {"L0", "L1", "L2"}
 
 
+def test_large_cycle_not_truncated():
+    """A 12-lock ordering cycle must be found — no silent DFS depth cap
+    (the acyclicity guarantee has to be total)."""
+    det = LockOrderDetector()
+    n = 12
+    locks = [det.make_lock() for _ in range(n)]
+    for i, lk in enumerate(locks):
+        lk.name = f"N{i:02d}"
+    for i in range(n):
+        def work(a=i, b=(i + 1) % n):
+            with locks[a]:
+                with locks[b]:
+                    pass
+        t = threading.Thread(target=work)
+        t.start(); t.join()
+    (cycle,) = det.cycles()
+    assert set(cycle) == {f"N{i:02d}" for i in range(n)}
+
+
+def test_two_disjoint_cycles_both_reported():
+    det = LockOrderDetector()
+    names = ["A", "B", "C", "D"]
+    locks = {nm: det.make_lock() for nm in names}
+    for nm in names:
+        locks[nm].name = nm
+    for a, b in [("A", "B"), ("B", "A"), ("C", "D"), ("D", "C")]:
+        def work(x=a, y=b):
+            with locks[x]:
+                with locks[y]:
+                    pass
+        t = threading.Thread(target=work)
+        t.start(); t.join()
+    found = det.cycles()
+    assert sorted(map(tuple, found)) == [("A", "B"), ("C", "D")]
+
+
 def test_self_deadlock_raises_instead_of_hanging():
     det = LockOrderDetector()
     a = det.make_lock()

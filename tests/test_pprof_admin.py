@@ -1,0 +1,88 @@
+"""--pprof admin debug endpoints (server/app.py pprof handlers —
+reference: pkg/server /admin/pprof/{profile,heap,trace}, server.go:425)."""
+
+import pytest
+
+requests = pytest.importorskip("requests")
+
+from gpud_tpu.config import default_config
+from gpud_tpu.server.server import Server
+
+
+@pytest.fixture(scope="module")
+def pprof_server(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("pprof")
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp / "data"), port=0, tls=False, kmsg_path=str(kmsg)
+    )
+    cfg.components_disabled = ["network-latency"]
+    cfg.pprof = True
+    s = Server(config=cfg)
+    s.start()
+    yield s
+    s.stop()
+
+
+def test_pprof_profile_samples_all_threads(pprof_server):
+    r = requests.get(
+        f"{pprof_server.base_url()}/admin/pprof/profile",
+        params={"seconds": "0.3"},
+        timeout=30,
+    )
+    assert r.status_code == 200
+    text = r.text
+    assert "samples over" in text
+    # daemon threads (watcher/syncer/...) appear, not just the handler
+    assert ".py:" in text
+
+
+def test_pprof_profile_malformed_seconds_is_400(pprof_server):
+    r = requests.get(
+        f"{pprof_server.base_url()}/admin/pprof/profile",
+        params={"seconds": "not-a-number"},
+        timeout=30,
+    )
+    assert r.status_code == 400
+    assert "invalid seconds" in r.json()["error"]
+
+
+def test_pprof_heap_two_phase(pprof_server):
+    base = pprof_server.base_url()
+    r1 = requests.get(f"{base}/admin/pprof/heap", timeout=30)
+    assert r1.status_code == 200
+    assert "tracemalloc started" in r1.text
+    r2 = requests.get(f"{base}/admin/pprof/heap", timeout=30)
+    assert r2.status_code == 200
+    assert "size=" in r2.text  # snapshot statistics lines
+    # tracing stopped after the snapshot (no steady-state tax)
+    import tracemalloc
+
+    assert not tracemalloc.is_tracing()
+
+
+def test_pprof_threads_dump(pprof_server):
+    r = requests.get(
+        f"{pprof_server.base_url()}/admin/pprof/threads", timeout=30
+    )
+    assert r.status_code == 200
+    assert "--- thread" in r.text
+    assert "tpud" in r.text  # named daemon threads visible
+
+
+def test_pprof_routes_absent_without_flag(live_server):
+    r = requests.get(
+        f"{live_server.base_url()}/admin/pprof/threads", timeout=10
+    )
+    assert r.status_code == 404
+
+
+def test_admin_packages_and_plugins_routes(pprof_server):
+    base = pprof_server.base_url()
+    r = requests.get(f"{base}/admin/packages", timeout=30)
+    assert r.status_code == 200
+    assert isinstance(r.json(), list)
+    r = requests.get(f"{base}/v1/plugins", timeout=30)
+    assert r.status_code == 200
+    assert isinstance(r.json(), list)

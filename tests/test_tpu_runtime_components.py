@@ -192,6 +192,22 @@ def test_comm_with_parens_and_spaces_parsed(instance, tmp_path):
     assert cr.health_state_type() == HealthStateType.DEGRADED
 
 
+def test_non_ascii_comm_does_not_crash_sweep(instance, tmp_path):
+    """PR_SET_NAME is arbitrary bytes: a non-UTF8/non-ASCII comm must fall
+    into the '?' contract, not blow up the whole poll cycle."""
+    pid_dir = _stage_proc(tmp_path, 88, ["/dev/accel0"])
+    (pid_dir / "stat").write_bytes(b"88 (tpu\xff\xfeworker) D 1 88 ...\n")
+    c = _processes(instance, tmp_path)
+    assert c._proc_state(88) == "D"  # binary read: state still parses
+    (pid_dir / "stat").write_bytes(b"88 (x) \xff 1 88 ...\n")  # state byte bad
+    assert c._proc_state(88) == "?"
+    cr = c.check_once()  # sweep survives either way
+    assert cr.health_state_type() in (
+        HealthStateType.HEALTHY,
+        HealthStateType.DEGRADED,
+    )
+
+
 def test_broken_fd_symlinks_and_garbage_dirs_ignored(instance, tmp_path):
     pid_dir = tmp_path / "55"
     (pid_dir / "fd").mkdir(parents=True)
