@@ -432,6 +432,36 @@ CATALOG: List[CatalogEntry] = [
        EventType.WARNING,
        "APEI/GHES host memory error (DIMM path, not HBM)",
        _NONE, reboot_threshold=0, critical=False),
+    # Kernel format: drivers/pci/pci.c pci_dev_wait ("not ready %dms after
+    # %s; giving up") — the device never returned after an FLR/bus/resume
+    # reset. Printed with the bound driver's prefix, so TPU attribution
+    # comes from the vfio/accel/apex prefix; an NVMe failing the same way
+    # keeps its own prefix and stays excluded.
+    _e(65, "tpu_pcie_not_ready",
+       r"((vfio-pci|accel|apex|google_tpu) [0-9a-f:.]+:.*not ready \d+ms after (FLR|bus reset|resume|PM D3hot->D0); giving up|TPU-ERR: tpu_pcie_not_ready)",
+       EventType.FATAL,
+       "TPU did not come back after reset/resume — device lost until reboot",
+       _REBOOT_HW, reboot_threshold=1, exclude=_NON_TPU_DRIVERS),
+    # Kernel format: drivers/pci/pci.c pcie_flr ("timed out waiting for
+    # pending transaction; performing function level reset anyway") —
+    # in-flight DMA did not drain before the runtime's FLR; the reset
+    # proceeds but the device may come back wedged (watch for not_ready /
+    # reset_recovery next)
+    _e(66, "tpu_pcie_flr_timeout",
+       r"((vfio-pci|accel|apex|google_tpu) [0-9a-f:.]+:.*timed out waiting for pending transaction|TPU-ERR: tpu_pcie_flr_timeout)",
+       EventType.WARNING,
+       "pending DMA did not drain before TPU function-level reset",
+       _NONE, reboot_threshold=0, critical=False,
+       exclude=_NON_TPU_DRIVERS),
+    # Kernel format: drivers/thermal/thermal_core.c
+    # thermal_zone_device_critical ("%s: critical temperature reached,
+    # shutting down") — the host is about to thermally shut down, taking
+    # the TPUs with it; host-scope correlation trail like GHES.
+    _e(67, "tpu_host_thermal_critical",
+       r"(thermal thermal_zone\d+: .*critical temperature reached.*shutting down|critical temperature reached \(\d+ C\), shutting down|TPU-ERR: tpu_host_thermal_critical)",
+       EventType.CRITICAL,
+       "host thermal-critical shutdown imminent (takes the TPUs down)",
+       _HW, reboot_threshold=0, critical=False),
 ]
 
 _BY_NAME = {c.name: c for c in CATALOG}
@@ -470,7 +500,7 @@ class MatchedError:
 
 # Hot-loop prefilter: the matcher runs on EVERY kernel log line (reference
 # hot loop #2, SURVEY §3.1), and a healthy host's lines match nothing — a
-# single coarse-token scan rejects them without walking all 56 patterns.
+# single coarse-token scan rejects them without walking every pattern.
 # Every catalog pattern's alternatives are anchored by at least one of
 # these tokens; tests assert the invariant over the full organic-line
 # corpus. The scan itself runs in the native library when present
@@ -482,6 +512,9 @@ PREFILTER_TOKENS = [
     "edac", "mce", "machine", "pcie", "aer", "dmar", "amd-vi", "iommu",
     "megascale", "dcn", "slice", "vrm", "voltage", "power", "sram",
     "scalar", "tensor", "correctable", "memory", "row remap", "vfio",
+    # anchors thermal_zone_device_critical only — routine trip-point
+    # lines carry no "critical temperature" and stay prefilter-rejected
+    "critical temperature",
 ]
 _PREFILTER = re.compile(
     "|".join(re.escape(t) for t in PREFILTER_TOKENS), re.IGNORECASE

@@ -156,6 +156,7 @@ class ControlPlane:
         self._grpc_server = None
         self.logins: List[dict] = []
         self._stopped = False
+        self._start_called = False
         # separate pools for the two blocking workloads so they can't
         # starve each other (and the aiohttp loop's small default
         # executor stays free): every v1 read stream pins one stream
@@ -349,12 +350,16 @@ class ControlPlane:
         """One-shot: after stop() (including the internal cleanup stop on
         a failed start) the pools are shut down — build a new ControlPlane
         instead of restarting this one."""
-        if self._stopped:
-            raise RuntimeError(
-                "ControlPlane cannot be restarted; create a new instance"
-            )
-        if self._started.is_set():
-            raise RuntimeError("ControlPlane already started")
+        with self._lock:
+            if self._stopped:
+                raise RuntimeError(
+                    "ControlPlane cannot be restarted; create a new instance"
+                )
+            if self._start_called:
+                raise RuntimeError("ControlPlane already started")
+            # set synchronously under the lock — _started is only set by
+            # the HTTP thread later, so it can't guard concurrent start()
+            self._start_called = True
         from aiohttp import web
 
         app = web.Application()

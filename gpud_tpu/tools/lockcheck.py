@@ -305,6 +305,12 @@ class LockOrderDetector:
     def report(self) -> str:
         lines = [f"{len(self.edges)} lock-order edges observed"]
         for cyc in self.cycles():
-            lines.append("CYCLE: " + " -> ".join(cyc))
+            # an SCC is a set, not a path — listing it with arrows would
+            # imply acquisition edges that may not exist
+            lines.append("CYCLE among locks: {" + ", ".join(cyc) + "}")
+            members = set(cyc)
+            for (a, b), site in sorted(self.edges.items()):
+                if a in members and b in members:
+                    lines.append(f"  edge {a} -> {b} (first seen at {site})")
         lines.extend(self.self_deadlocks)
         return "\n".join(lines)

@@ -158,6 +158,24 @@ ORGANIC = {
         # verbatim: CPER decode via drivers/acpi/apei (ghes)
         "{1}[Hardware Error]: section_type: memory error",
     ],
+    "tpu_pcie_not_ready": [
+        # verbatim: drivers/pci/pci.c pci_dev_wait "not ready %dms after
+        # %s; giving up" with the TPU's bound-driver prefix
+        "vfio-pci 0000:00:05.0: not ready 65535ms after FLR; giving up",
+        "accel 0000:00:04.0: not ready 1023ms after bus reset; giving up",
+        "apex 0000:00:06.0: not ready 60000ms after resume; giving up",
+    ],
+    "tpu_pcie_flr_timeout": [
+        # verbatim: drivers/pci/pci.c pcie_flr
+        "vfio-pci 0000:00:05.0: timed out waiting for pending transaction; "
+        "performing function level reset anyway",
+    ],
+    "tpu_host_thermal_critical": [
+        # verbatim: drivers/thermal/thermal_core.c
+        # thermal_zone_device_critical (new + legacy formats)
+        "thermal thermal_zone0: acpitz: critical temperature reached, shutting down",
+        "critical temperature reached (128 C), shutting down",
+    ],
     "tpu_msix_init_failed": [
         "accel 0000:00:04.0: MSI-X vector allocation failed (-28)",
         "gasket: interrupt vector init failed for apex device",
@@ -197,6 +215,9 @@ KERNEL_GROUNDED = {
     "tpu_runtime_oom_killed",     # mm/oom_kill.c
     "tpu_host_mem_ghes",          # drivers/acpi/apei (CPER decode)
     "tpu_hbm_mce",                # arch/x86 mce + edac decode vocabulary
+    "tpu_pcie_not_ready",         # drivers/pci/pci.c pci_dev_wait
+    "tpu_pcie_flr_timeout",       # drivers/pci/pci.c pcie_flr
+    "tpu_host_thermal_critical",  # drivers/thermal/thermal_core.c
 }
 
 
@@ -263,6 +284,15 @@ BENIGN = [
     "8.0 GT/s PCIe x4 link at 0000:00:03.0",
     "pci 0000:01:00.0: 31.504 Gb/s available PCIe bandwidth, limited by "
     "8.0 GT/s PCIe x4 link at 0000:00:03.0",
+    # reset-failure / FLR-drain lines from non-TPU devices keep their own
+    # driver prefix and must not classify as TPU loss
+    "nvme 0000:01:00.0: not ready 65535ms after FLR; giving up",
+    "mlx5_core 0000:02:00.0: timed out waiting for pending transaction; "
+    "performing function level reset anyway",
+    # hotplug insertion (the healthy direction)
+    "pciehp 0000:00:1c.0:pcie004: Slot(5): Card present",
+    # non-critical thermal trip survives the new thermal-critical entry
+    "thermal thermal_zone0: trip point 0 crossed with 45000 milli celsius",
 ]
 
 

@@ -1,0 +1,186 @@
+"""Backend accessor surfaces (tpu/instance.py): the TPUInstance contract
+methods (product/driver/type/devices/telemetry flags) per backend, plus
+the JaxBackend enumeration path with scripted jax devices (libtpu open is
+exclusive, so CI drives it with fakes — reference: mock-NVML strategy)."""
+
+import os
+
+import pytest
+
+from gpud_tpu.tpu import instance as instance_mod
+from gpud_tpu.tpu.instance import (
+    JaxBackend,
+    MockBackend,
+    SysfsBackend,
+    TPUInstance,
+)
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures", "tpuvm")
+
+
+@pytest.fixture(autouse=True)
+def _no_gce_metadata(monkeypatch):
+    monkeypatch.setattr(
+        instance_mod, "_gce_metadata_accel_type", lambda *a, **k: ""
+    )
+    monkeypatch.delenv("TPUD_ICI_SYSFS_ROOT", raising=False)
+
+
+def _sysfs(name="v5p-8"):
+    base = os.path.join(FIXTURES, name)
+    return SysfsBackend(
+        sysfs_root=os.path.join(base, "sys"), dev_root=os.path.join(base, "dev")
+    )
+
+
+# -- abstract contract -----------------------------------------------------
+
+
+def test_abstract_interface_raises():
+    t = TPUInstance()
+    for call in (
+        t.tpu_lib_exists,
+        t.devices,
+    ):
+        with pytest.raises(NotImplementedError):
+            call()
+
+
+# -- SysfsBackend accessors ------------------------------------------------
+
+
+def test_sysfs_accessors_on_fixture():
+    b = _sysfs("v5p-8")
+    assert b.tpu_lib_exists()
+    assert b.init_error() == ""
+    assert b.product_name().startswith("TPU")
+    assert b.accelerator_type().startswith("v5p")
+    assert isinstance(b.driver_version(), str)
+    assert b.worker_id() == 0
+    devs = b.devices()
+    assert devs and all(hasattr(c, "generation") for c in devs.values())
+    assert b.telemetry_supported() is False  # sysfs exposes no telemetry
+    assert isinstance(b._unbound_chip_ids(), set)
+
+
+def test_sysfs_accel_type_suffix_semantics():
+    """v4/v5p count cores in the suffix (2 per chip), v5e counts chips —
+    the public tpu-info convention the type string must follow."""
+    assert _sysfs("v5p-8").accelerator_type() == "v5p-8"   # 4 chips × 2 cores
+    assert _sysfs("v5e-8").accelerator_type() == "v5e-8"  # 8 chips
+    assert _sysfs("v4-8").accelerator_type() == "v4-8"
+
+
+# -- MockBackend contract --------------------------------------------------
+
+
+def test_mock_backend_full_surface():
+    b = MockBackend()
+    assert b.is_mock() and b.tpu_lib_exists()
+    assert b.telemetry_supported()
+    tel = b.telemetry()
+    assert set(tel) == set(b.devices())
+    sample = next(iter(tel.values()))
+    assert sample.hbm_total_bytes > 0
+    links = b.ici_links()
+    assert links and all(l.state for l in links)
+    assert b.topology() is not None
+    assert b.shutdown() is None
+
+
+# -- JaxBackend with scripted devices --------------------------------------
+
+
+class _FakeJaxDevice:
+    def __init__(self, i, kind="TPU v5e", platform="tpu", stats=None):
+        self.id = i
+        self.device_kind = kind
+        self.platform = platform
+        self._stats = stats
+
+    def memory_stats(self):
+        if isinstance(self._stats, Exception):
+            raise self._stats
+        return self._stats
+
+
+def _with_fake_jax(monkeypatch, devices):
+    import jax
+
+    monkeypatch.setattr(jax, "devices", lambda: devices)
+
+
+def test_jax_backend_enumerates_fake_tpus(monkeypatch):
+    devs = [
+        _FakeJaxDevice(0, stats={"bytes_in_use": 100, "bytes_limit": 16_000}),
+        _FakeJaxDevice(1, stats={"bytes_in_use": 200, "bytes_limit": 16_000}),
+        _FakeJaxDevice(7, kind="cpu", platform="cpu"),  # filtered out
+    ]
+    _with_fake_jax(monkeypatch, devs)
+    b = JaxBackend()
+    assert b.tpu_lib_exists() and b.init_error() == ""
+    assert set(b.devices()) == {0, 1}
+    # accel-type derived from generation + count (v5e counts chips)
+    assert b.accelerator_type() == "v5e-2"
+    assert b.product_name() == "TPU v5e"
+    assert b.telemetry_supported()
+    tel = b.telemetry()
+    assert tel[0].hbm_used_bytes == 100
+    assert tel[1].hbm_total_bytes == 16_000
+
+
+def test_jax_backend_telemetry_survives_stats_failure(monkeypatch):
+    devs = [_FakeJaxDevice(0, stats=RuntimeError("device busy"))]
+    _with_fake_jax(monkeypatch, devs)
+    b = JaxBackend()
+    tel = b.telemetry()
+    assert tel[0].hbm_used_bytes == 0  # failure → zeroed sample, no raise
+
+
+def test_jax_backend_no_tpus_on_cpu_host(monkeypatch):
+    _with_fake_jax(monkeypatch, [_FakeJaxDevice(0, kind="cpu", platform="cpu")])
+    b = JaxBackend()
+    assert not b.tpu_lib_exists()
+    assert b.product_name() == "TPU"
+    assert b.telemetry_supported() is False
+
+
+def test_jax_backend_import_failure_is_init_error(monkeypatch):
+    import jax
+
+    def boom():
+        raise RuntimeError("libtpu held by another process")
+
+    monkeypatch.setattr(jax, "devices", boom)
+    b = JaxBackend()
+    assert not b.tpu_lib_exists()
+    assert "libtpu held" in b.init_error()
+
+
+def test_jax_backend_explicit_accel_type_wins(monkeypatch):
+    _with_fake_jax(monkeypatch, [_FakeJaxDevice(0)])
+    b = JaxBackend(accelerator_type="v5litepod-16")
+    assert b.accelerator_type() == "v5litepod-16"
+
+
+# -- factory env routing ---------------------------------------------------
+
+
+def test_new_instance_env_routing(monkeypatch):
+    from gpud_tpu.tpu.instance import new_instance
+
+    monkeypatch.setenv("TPUD_TPU_MOCK_ALL_SUCCESS", "1")
+    assert new_instance().is_mock()
+
+    monkeypatch.setenv("TPUD_TPU_MOCK_ALL_SUCCESS", "0")
+    monkeypatch.setenv("TPUD_TPU_USE_JAX", "1")
+    _with_fake_jax(monkeypatch, [_FakeJaxDevice(3)])
+    b = new_instance()
+    assert isinstance(b, JaxBackend) and 3 in b.devices()
+
+    monkeypatch.setenv("TPUD_TPU_USE_JAX", "0")
+    base = os.path.join(FIXTURES, "v4-8")
+    monkeypatch.setenv("TPUD_SYSFS_ROOT", os.path.join(base, "sys"))
+    monkeypatch.setenv("TPUD_DEV_ROOT", os.path.join(base, "dev"))
+    b = new_instance()
+    assert isinstance(b, SysfsBackend)

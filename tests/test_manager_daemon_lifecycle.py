@@ -1,0 +1,242 @@
+"""Management lifecycle driven TYPED end-to-end: a real daemon enrolled
+in the real control plane over v2-rev2, every management action issued
+through the manager's operator surface and thus through the typed
+encoder → gRPC → agent decoder → dispatcher chain (the reference's
+manager↔agent method surface, pkg/session/session.proto:16-60)."""
+
+import time
+
+import pytest
+
+from gpud_tpu.config import default_config
+from gpud_tpu.manager.control_plane import ControlPlane
+from gpud_tpu.server.server import Server
+
+pytest.importorskip("grpc")
+requests = pytest.importorskip("requests")
+
+
+@pytest.fixture(scope="module")
+def fleet(tmp_path_factory):
+    """ControlPlane + one real daemon connected over v2-rev2."""
+    import os
+
+    tmp = tmp_path_factory.mktemp("lifecycle")
+    cp = ControlPlane()
+    cp.start()
+    os.environ["TPUD_SESSION_V2_TARGET"] = f"127.0.0.1:{cp.grpc_port}"
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp / "data"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        endpoint=cp.endpoint,
+        token="join-token",
+        machine_id="lifecycle-box",
+        components_disabled=["network-latency"],
+    )
+    srv = Server(config=cfg)
+    srv.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and "lifecycle-box" not in cp.agents:
+        time.sleep(0.05)
+    h = cp.agent("lifecycle-box")
+    assert h.transport == "v2-rev2"
+    yield cp, srv, h
+    srv.stop()
+    cp.stop()
+    os.environ.pop("TPUD_SESSION_V2_TARGET", None)
+
+
+def test_update_config_typed_roundtrip_and_persistence(fleet):
+    """Typed UpdateConfigRequest (map<string,string> of JSON sections) →
+    applied + persisted to metadata for boot replay."""
+    cp, srv, h = fleet
+    resp = h.request(
+        {
+            "method": "updateConfig",
+            "configs": {
+                "ici": {"expected_links": 7},
+                "expected_chip_count": 3,
+            },
+        },
+        timeout=15,
+    )
+    assert resp["status"] == "ok"
+    assert set(resp["updated"]) >= {"ici.expected_links", "expected_chip_count"}
+    from gpud_tpu.metadata import KEY_CONFIG_OVERRIDES
+
+    raw = srv.metadata.get(KEY_CONFIG_OVERRIDES)
+    assert raw and "expected_links" in raw
+
+
+def test_update_config_bad_section_reports_error(fleet):
+    _cp, _srv, h = fleet
+    resp = h.request(
+        {"method": "updateConfig", "configs": {"no_such_section": {"x": 1}}},
+        timeout=15,
+    )
+    # unknown sections are ignored (never applied, never persisted)
+    assert resp["status"] == "ok" and resp["updated"] == []
+    resp = h.request(
+        {"method": "updateConfig", "configs": {"expected_chip_count": "NaN-ish"}},
+        timeout=15,
+    )
+    assert resp.get("errors")
+
+
+def test_get_plugin_specs_empty_then_reject_clash(fleet):
+    _cp, _srv, h = fleet
+    resp = h.request({"method": "getPluginSpecs"}, timeout=15)
+    assert resp == {"specs": []}
+    # a plugin named like a built-in must be rejected before persisting
+    resp = h.request(
+        {
+            "method": "setPluginSpecs",
+            "specs": [
+                {
+                    "name": "cpu",
+                    "plugin_type": "component",
+                    "steps": [{"name": "s", "script": "echo hi"}],
+                }
+            ],
+        },
+        timeout=15,
+    )
+    assert "clash" in resp["error"]
+
+
+def test_trigger_component_typed(fleet):
+    _cp, _srv, h = fleet
+    resp = h.request(
+        {"method": "triggerComponent", "component": "cpu", "tag": ""},
+        timeout=15,
+    )
+    assert resp["status"] == "triggered"
+    assert resp["components"] == ["cpu"]
+
+
+def test_trigger_unknown_component(fleet):
+    _cp, _srv, h = fleet
+    resp = h.request(
+        {"method": "triggerComponent", "component": "ghost", "tag": ""},
+        timeout=15,
+    )
+    assert "error" in resp or resp.get("components") == []
+
+
+def test_token_rotation_typed(fleet):
+    _cp, srv, h = fleet
+    resp = h.request({"method": "getToken"}, timeout=15)
+    assert "token" in resp
+    resp = h.request({"method": "updateToken", "token": "rotated-tok"}, timeout=15)
+    assert resp["status"] == "ok"
+    resp = h.request({"method": "getToken"}, timeout=15)
+    assert resp["token"] == "rotated-tok"
+
+
+def test_package_status_typed(fleet):
+    _cp, _srv, h = fleet
+    resp = h.request({"method": "packageStatus"}, timeout=15)
+    assert "packages" in resp
+
+
+def test_kap_mtls_status_typed(fleet):
+    _cp, _srv, h = fleet
+    resp = h.request({"method": "kapMTLSStatus"}, timeout=15)
+    assert "active_version" in resp or "status" in resp or "error" not in resp
+
+
+def test_diagnostic_bundle_typed(fleet):
+    """DiagnosticRequest: async bundle collection through the typed path."""
+    _cp, _srv, h = fleet
+    resp = h.request({"method": "diagnostic"}, timeout=15)
+    assert resp["status"] in ("started", "ok")
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        resp = h.request({"method": "diagnostic"}, timeout=15)
+        if resp.get("diagnostic"):
+            bundle = resp["diagnostic"]
+            assert "states" in bundle and "events" in bundle
+            return
+        time.sleep(0.5)
+    raise AssertionError("diagnostic bundle never completed")
+
+
+def test_deregister_component_typed(fleet):
+    """Deregisterable contract over the wire: only components that opt in
+    can be deregistered."""
+    _cp, srv, h = fleet
+    resp = h.request(
+        {"method": "deregisterComponent", "component": "cpu"}, timeout=15
+    )
+    assert "error" in resp  # cpu is not deregisterable
+    names = [c.name() for c in srv.registry.all()]
+    assert "cpu" in names
+
+
+def test_unknown_method_is_structured_error(fleet):
+    """A method outside the typed set travels the Frame fallback and the
+    dispatcher answers a structured error — stream stays up."""
+    _cp, _srv, h = fleet
+    resp = h.request({"method": "definitelyNotAMethod"}, timeout=15)
+    assert "error" in resp
+    assert h.request({"method": "states"}, timeout=15)["states"]
+
+
+def test_concurrent_operator_requests(fleet):
+    """Parallel operator requests through one agent stream: request_ids
+    keep responses paired."""
+    import threading
+
+    _cp, _srv, h = fleet
+    results = {}
+
+    def worker(i):
+        if i % 2:
+            results[i] = h.request({"method": "states", "components": ["cpu"]}, timeout=20)
+        else:
+            results[i] = h.request({"method": "gossip"}, timeout=20)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(results) == 8
+    for i, resp in results.items():
+        if i % 2:
+            assert [s["component"] for s in resp["states"]] == ["cpu"]
+        else:
+            assert resp["status"] in ("started", "ok")
+
+
+def test_second_daemon_joins_fleet(fleet, tmp_path):
+    cp, _srv, _h = fleet
+    kmsg = tmp_path / "kmsg2"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp_path / "data2"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        endpoint=cp.endpoint,
+        token="join-token",
+        machine_id="second-box",
+        components_disabled=["network-latency"],
+    )
+    srv2 = Server(config=cfg)
+    srv2.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and "second-box" not in cp.agents:
+            time.sleep(0.05)
+        ids = {m["machine_id"] for m in cp.machines()}
+        assert {"lifecycle-box", "second-box"} <= ids
+        # requests route to the right box
+        g = cp.agent("second-box").request({"method": "gossip"}, timeout=15)
+        assert g["status"] in ("started", "ok")
+    finally:
+        srv2.stop()
