@@ -157,6 +157,8 @@ class ControlPlane:
         self.logins: List[dict] = []
         self._stopped = False
         self._start_called = False
+        # reentrant: start()'s failure paths call stop() while holding it
+        self._lifecycle = threading.RLock()
         # separate pools for the two blocking workloads so they can't
         # starve each other (and the aiohttp loop's small default
         # executor stays free): every v1 read stream pins one stream
@@ -350,16 +352,19 @@ class ControlPlane:
         """One-shot: after stop() (including the internal cleanup stop on
         a failed start) the pools are shut down — build a new ControlPlane
         instead of restarting this one."""
-        with self._lock:
-            if self._stopped:
-                raise RuntimeError(
-                    "ControlPlane cannot be restarted; create a new instance"
-                )
-            if self._start_called:
-                raise RuntimeError("ControlPlane already started")
-            # set synchronously under the lock — _started is only set by
-            # the HTTP thread later, so it can't guard concurrent start()
-            self._start_called = True
+        with self._lifecycle:  # serializes whole-start vs whole-stop
+            self._start_locked()
+
+    def _start_locked(self) -> None:
+        if self._stopped:
+            raise RuntimeError(
+                "ControlPlane cannot be restarted; create a new instance"
+            )
+        if self._start_called:
+            raise RuntimeError("ControlPlane already started")
+        # set synchronously under the lifecycle lock — _started is only
+        # set by the HTTP thread later, so it can't guard concurrency
+        self._start_called = True
         from aiohttp import web
 
         app = web.Application()
@@ -571,6 +576,10 @@ class ControlPlane:
             h.mark_gone()
 
     def stop(self) -> None:
+        with self._lifecycle:  # a stop racing an in-flight start waits
+            self._stop_locked()
+
+    def _stop_locked(self) -> None:
         self._stopped = True
         self.drain("manager stopping")
         if self._grpc_server is not None:

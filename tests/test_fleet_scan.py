@@ -215,3 +215,44 @@ def test_fleet_scan_truncation_reported_not_silent(tmp_path):
     assert states.shape == (1, 20)
     res = fleet_scan([str(tmp_path / "h.db")], window_seconds=3600, now=NOW)
     assert res["truncated_links"] == []  # default bound not hit
+
+
+def test_fleet_scan_single_device_jnp_path(tmp_path, monkeypatch):
+    """n_devices == 1 skips the mesh and runs the plain jnp scan."""
+    import jax
+
+    import gpud_tpu.fleet_scan as fleet_mod
+
+    db = str(tmp_path / "h1.db")
+    _mk_host_db(db, down=["chip0/ici0"])
+    one = jax.devices()[:1]
+    monkeypatch.setattr(jax, "devices", lambda *a: one)
+    res = fleet_mod.fleet_scan([db], window_seconds=3600, now=NOW)
+    assert res["devices"] == 1
+    assert res["summary"]["unhealthy"] >= 1
+
+
+def test_fleet_scan_tpu_kind_tries_pallas_then_falls_back(tmp_path, monkeypatch):
+    """A single device reporting a TPU device_kind routes to the packed
+    Pallas kernel; when lowering fails off-TPU the jnp scan still
+    answers (the logged fallback path)."""
+    import jax
+
+    import gpud_tpu.fleet_scan as fleet_mod
+
+    class _TpuLook:
+        def __init__(self, real):
+            self._real = real
+            self.device_kind = "TPU v5e (fake)"
+
+        def __getattr__(self, item):
+            return getattr(self._real, item)
+
+    db = str(tmp_path / "h1.db")
+    _mk_host_db(db, down=["chip0/ici0"])
+    fake = [_TpuLook(jax.devices()[0])]
+    monkeypatch.setattr(jax, "devices", lambda *a: fake)
+    res = fleet_mod.fleet_scan([db], window_seconds=3600, now=NOW)
+    # whichever branch won (pallas interpret or jnp fallback), the
+    # classification contract holds
+    assert res["summary"]["unhealthy"] >= 1

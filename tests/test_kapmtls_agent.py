@@ -12,7 +12,6 @@ Reference: pkg/kapmtls/manager.go:29-50 (atomic release dirs + current
 symlink + readiness + rollback).
 """
 
-import datetime
 import os
 import ssl
 import threading
@@ -25,33 +24,11 @@ from gpud_tpu.kapmtls import CertManager
 cryptography = pytest.importorskip("cryptography")
 
 from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives import serialization
 from cryptography.x509.oid import NameOID
 
 
-def _keypair(common_name: str):
-    """Self-signed EC cert (fast) with the version burned into the CN."""
-    key = ec.generate_private_key(ec.SECP256R1())
-    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, common_name)])
-    now = datetime.datetime.now(datetime.timezone.utc)
-    cert = (
-        x509.CertificateBuilder()
-        .subject_name(name)
-        .issuer_name(name)
-        .public_key(key.public_key())
-        .serial_number(x509.random_serial_number())
-        .not_valid_before(now - datetime.timedelta(minutes=1))
-        .not_valid_after(now + datetime.timedelta(hours=1))
-        .sign(key, hashes.SHA256())
-    )
-    cert_pem = cert.public_bytes(serialization.Encoding.PEM).decode()
-    key_pem = key.private_bytes(
-        serialization.Encoding.PEM,
-        serialization.PrivateFormat.PKCS8,
-        serialization.NoEncryption(),
-    ).decode()
-    return cert_pem, key_pem
+from tests.helpers import keypair as _keypair  # shared with the fallback suite
 
 
 class FakeAgent:

@@ -1,0 +1,160 @@
+"""kapmtls re-push fallback path: filesystems WITHOUT renameat2
+RENAME_EXCHANGE (pre-3.15 kernels, some network filesystems) take the
+move-aside + pivot path (kapmtls.py install fallback). The exchange
+helper is scripted to fail so every fallback branch runs, including the
+crash-recovery restores."""
+
+import os
+
+import pytest
+
+import gpud_tpu.kapmtls as kapmtls_mod
+from gpud_tpu.kapmtls import CertManager
+
+pytest.importorskip("cryptography")
+from tests.helpers import keypair
+
+# distinct real keypairs (the readiness probe parses the cert); CERTS
+# maps marker -> PEM so content assertions stay readable
+CERTS = {}
+KEYS = {}
+for marker in ("CERT1", "CERT1b", "CERT1-new", "CERT2", "C", "C2"):
+    CERTS[marker], KEYS[marker] = keypair(marker)
+
+
+def _install(store, version, marker):
+    return store.install(version, CERTS[marker], KEYS[marker])
+
+
+@pytest.fixture()
+def no_exchange(monkeypatch):
+    monkeypatch.setattr(kapmtls_mod, "_exchange_dirs", lambda a, b: False)
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return CertManager(root=str(tmp_path / "kap"))
+
+
+def _read_current(store):
+    cur = os.path.join(store.root, "current")
+    with open(os.path.join(cur, "client.crt")) as f:
+        return f.read()
+
+
+def test_repush_fallback_inactive_version(store, no_exchange):
+    """Re-push of a NON-active version: old dir parked, new content in
+    place, no `current` involvement."""
+    assert _install(store, "v1", "CERT1") is None
+    assert _install(store, "v2", "CERT2") is None
+    assert store.activate("v2") is None
+    # re-push v1 (inactive) with new content via the fallback
+    assert _install(store, "v1", "CERT1b") is None
+    with open(os.path.join(store.releases_dir, "v1", "client.crt")) as f:
+        assert f.read() == CERTS["CERT1b"]
+    assert _read_current(store) == CERTS["CERT2"]  # untouched
+    # the old content is parked for deferred GC, not deleted
+    parked = [e for e in os.listdir(store.releases_dir) if ".old-" in e]
+    assert parked
+
+
+def test_repush_fallback_active_version_pivots_current(store, no_exchange):
+    """Re-push of the ACTIVE version: `current` pivots to the staged dir
+    first, then back to the version path — it must resolve to complete
+    credentials at every step, and end on the new content."""
+    assert _install(store, "v1", "CERT1") is None
+    assert store.activate("v1") is None
+    assert _install(store, "v1", "CERT1-new") is None
+    assert _read_current(store) == CERTS["CERT1-new"]
+    # current points at the canonical version path again (not a tmp dir)
+    target = os.readlink(os.path.join(store.root, "current"))
+    assert target == os.path.join("releases", "v1")
+
+
+def test_repush_fallback_vacate_failure_restores_current(
+    store, no_exchange, monkeypatch
+):
+    """If moving the old dir aside fails, the pivot is rolled back and the
+    active release keeps serving the OLD content."""
+    assert _install(store, "v1", "CERT1") is None
+    assert store.activate("v1") is None
+
+    real_rename = os.rename
+
+    def failing_rename(src, dst):
+        if ".old-" in dst:
+            raise OSError(16, "Device or resource busy")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", failing_rename)
+    err = _install(store, "v1", "CERT1-new")
+    assert err is not None and "busy" in err
+    monkeypatch.undo()
+    assert _read_current(store) == CERTS["CERT1"]
+    target = os.readlink(os.path.join(store.root, "current"))
+    assert target == os.path.join("releases", "v1")
+
+
+def test_repush_fallback_final_rename_failure_restores_old(
+    store, no_exchange, monkeypatch
+):
+    """If the final tmp→version rename fails, the previous release dir is
+    restored and `current` still serves the old credentials."""
+    assert _install(store, "v1", "CERT1") is None
+    assert store.activate("v1") is None
+
+    real_rename = os.rename
+    state = {"vacated": False}
+
+    def failing_rename(src, dst):
+        if ".old-" in dst:
+            state["vacated"] = True
+            return real_rename(src, dst)
+        if state["vacated"] and ".tmp-" in src:
+            raise OSError(5, "I/O error")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(os, "rename", failing_rename)
+    err = _install(store, "v1", "CERT1-new")
+    assert err is not None
+    monkeypatch.undo()
+    # old release restored at the version path; current serves it
+    with open(os.path.join(store.releases_dir, "v1", "client.crt")) as f:
+        assert f.read() == CERTS["CERT1"]
+    assert _read_current(store) == CERTS["CERT1"]
+
+
+def test_retarget_current_cleans_staging_link_on_failure(store, monkeypatch):
+    assert _install(store, "v1", "C") is None
+    assert store.activate("v1") is None
+
+    real_replace = os.replace
+
+    def failing_replace(src, dst):
+        raise OSError(30, "Read-only file system")
+
+    monkeypatch.setattr(os, "replace", failing_replace)
+    with pytest.raises(OSError):
+        store._retarget_current(os.path.join("releases", "v1"))
+    monkeypatch.undo()
+    # no dangling current.tmp-* staging links left behind
+    stale = [e for e in os.listdir(store.root) if e.startswith("current.tmp-")]
+    assert stale == []
+
+
+def test_invalid_versions_rejected(store):
+    for bad in ("", "a/b", ".hidden", "v1.tmp-1", "v1.old-2"):
+        err = store.install(bad, CERTS["C"], KEYS["C"])
+        assert err is not None, bad
+
+
+def test_gc_collects_parked_dirs_after_grace(store, no_exchange):
+    assert _install(store, "v1", "C") is None
+    assert _install(store, "v1", "C2") is None  # parks the old dir
+    parked = [e for e in os.listdir(store.releases_dir) if ".old-" in e]
+    assert parked
+    store._gc_stale_dirs(grace=0.0)
+    left = [e for e in os.listdir(store.releases_dir) if ".old-" in e]
+    assert left == []
+    # the real release is never GC-eligible
+    assert os.path.isdir(os.path.join(store.releases_dir, "v1"))

@@ -38,16 +38,21 @@ def fleet(tmp_path_factory):
         components_disabled=["network-latency"],
     )
     srv = Server(config=cfg)
-    srv.start()
-    deadline = time.time() + 15
-    while time.time() < deadline and "lifecycle-box" not in cp.agents:
-        time.sleep(0.05)
-    h = cp.agent("lifecycle-box")
-    assert h.transport == "v2-rev2"
-    yield cp, srv, h
-    srv.stop()
-    cp.stop()
-    os.environ.pop("TPUD_SESSION_V2_TARGET", None)
+    try:
+        srv.start()
+        deadline = time.time() + 15
+        while time.time() < deadline and "lifecycle-box" not in cp.agents:
+            time.sleep(0.05)
+        h = cp.agent("lifecycle-box")
+        assert h.transport == "v2-rev2"
+        yield cp, srv, h
+    finally:
+        # setup failures must not leak the env override (it would
+        # silently redirect every later module's v2 transport) or the
+        # running daemon/manager
+        srv.stop()
+        cp.stop()
+        os.environ.pop("TPUD_SESSION_V2_TARGET", None)
 
 
 def test_update_config_typed_roundtrip_and_persistence(fleet):

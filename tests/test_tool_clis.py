@@ -1,0 +1,108 @@
+"""CLI surfaces of the dev tools: helm_render main (render + --set +
+failure modes) and gen_catalog_doc --check (the CI sync gate)."""
+
+import subprocess
+import sys
+
+import pytest
+
+yaml = pytest.importorskip("yaml")
+
+from gpud_tpu.tools import helm_render
+
+CHART = "deployments/helm/tpud"
+
+
+def _run(mod, *args):
+    return subprocess.run(
+        [sys.executable, "-m", mod, *args],
+        capture_output=True,
+        text=True,
+        cwd="/root/repo",
+        timeout=120,
+    )
+
+
+def test_helm_render_cli_renders_real_chart():
+    res = _run("gpud_tpu.tools.helm_render", CHART)
+    assert res.returncode == 0, res.stderr
+    assert "# Source:" in res.stdout
+    docs = [d for d in yaml.safe_load_all(
+        "\n".join(l for l in res.stdout.splitlines() if not l.startswith("# Source:"))
+    ) if d]
+    kinds = {d.get("kind") for d in docs}
+    assert "DaemonSet" in kinds
+
+
+def test_helm_render_cli_set_override():
+    res = _run(
+        "gpud_tpu.tools.helm_render", CHART, "--set", "image.tag=v9.9.9"
+    )
+    assert res.returncode == 0
+    assert "v9.9.9" in res.stdout
+
+
+def test_helm_render_cli_missing_chart_fails_cleanly(tmp_path):
+    res = _run("gpud_tpu.tools.helm_render", str(tmp_path / "nochart"))
+    assert res.returncode == 1
+    assert "render failed" in res.stderr
+    assert "Traceback" not in res.stderr
+
+
+def test_helm_render_cli_unsupported_construct_fails_before_output(tmp_path):
+    """Constructs the subset renderer can't honor (e.g. `lookup`) fail
+    loudly, and validation happens before any output is printed. (A
+    missing .Values path rendering empty is FAITHFUL helm behavior and
+    deliberately not an error.)"""
+    chart = tmp_path / "chart"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: x\nversion: 0.1.0\n")
+    (chart / "values.yaml").write_text("a: 1\n")
+    (chart / "templates" / "bad.yaml").write_text(
+        'kind: ConfigMap\nmeta: {{ lookup "v1" "Pod" "ns" "x" }}\n'
+    )
+    res = _run("gpud_tpu.tools.helm_render", str(chart))
+    assert res.returncode == 1
+    assert "render failed" in res.stderr and "unsupported" in res.stderr
+    assert res.stdout == ""  # validate-before-print contract
+
+
+def test_helm_render_missing_values_path_is_empty_like_helm(tmp_path):
+    chart = tmp_path / "chart"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: x\nversion: 0.1.0\n")
+    (chart / "values.yaml").write_text("a: 1\n")
+    (chart / "templates" / "c.yaml").write_text(
+        "kind: ConfigMap\nmeta: {{ .Values.missing.deep.path }}\n"
+    )
+    res = _run("gpud_tpu.tools.helm_render", str(chart))
+    assert res.returncode == 0
+    assert "meta:" in res.stdout
+
+
+def test_gen_catalog_doc_check_in_sync():
+    res = _run("gpud_tpu.tools.gen_catalog_doc", "--check")
+    assert res.returncode == 0
+    assert "in sync" in res.stdout
+
+
+def test_gen_catalog_doc_check_detects_drift(tmp_path):
+    """--check against a stale copy exits 1 (the CI gate actually gates)."""
+    import os
+    import shutil
+
+    work = tmp_path / "repo"
+    work.mkdir()
+    (work / "docs").mkdir()
+    (work / "docs" / "CATALOG.md").write_text("stale\n")
+    env = dict(os.environ, PYTHONPATH="/root/repo")
+    res = subprocess.run(
+        [sys.executable, "-m", "gpud_tpu.tools.gen_catalog_doc", "--check"],
+        capture_output=True,
+        text=True,
+        cwd=str(work),
+        env=env,
+        timeout=120,
+    )
+    assert res.returncode == 1
+    assert "out of date" in res.stderr
