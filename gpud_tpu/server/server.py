@@ -350,8 +350,20 @@ class Server:
         with self._session_mu:
             if self._closed:
                 return
-            endpoint = self.config.endpoint or self.metadata.get(md.KEY_ENDPOINT)
-            token = self.config.token or self.metadata.get(md.KEY_TOKEN)
+            # credentials must stay PAIRED with the endpoint they were
+            # issued for: a login persists endpoint+token together, so a
+            # complete metadata pair wins as a unit. Otherwise fall back
+            # piecewise — config endpoint with a rotated metadata token is
+            # the FIFO/updateToken hand-off case (the rotation targets the
+            # endpoint the daemon is already talking to), and the --token
+            # boot flag is only the initial bootstrap credential.
+            md_endpoint = self.metadata.get(md.KEY_ENDPOINT)
+            md_token = self.metadata.get(md.KEY_TOKEN)
+            if md_endpoint and md_token:
+                endpoint, token = md_endpoint, md_token
+            else:
+                endpoint = self.config.endpoint or md_endpoint
+                token = md_token or self.config.token
             if not endpoint or not token:
                 return
             from gpud_tpu.session.dispatch import Dispatcher

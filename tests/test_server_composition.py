@@ -129,3 +129,81 @@ def test_invalid_config_refuses_boot(tmp_path):
     cfg.metrics_retention_seconds = 1  # below validate() floor
     with pytest.raises(ValueError, match="metrics retention"):
         Server(config=cfg)
+
+
+def test_fifo_token_handoff_restarts_session(tmp_path):
+    """`tpud up --token` hand-off path: a token written into the FIFO is
+    persisted to metadata and the control-plane session restarts with it
+    (server.py watch loop)."""
+    import time
+
+    from gpud_tpu import metadata as md
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp = FakeControlPlane()
+    cp.start()
+    cfg = _cfg(tmp_path)
+    cfg.endpoint = f"http://127.0.0.1:{cp.port}"
+    cfg.token = "boot-token"
+    cfg.machine_id = "fifo-box"
+    s = Server(config=cfg)
+    try:
+        s.start()
+        assert cp.connected.wait(10)
+        first_session = s.session
+        deadline = time.time() + 10
+        err = "never tried"
+        while time.time() < deadline:  # ENXIO until the watcher opens
+            err = Server.write_token("rotated-token", cfg.fifo_file())
+            if err is None:
+                break
+            time.sleep(0.05)
+        assert err is None
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if (
+                s.metadata.get(md.KEY_TOKEN) == "rotated-token"
+                and s.session is not None
+                and s.session is not first_session
+            ):
+                break
+            time.sleep(0.05)
+        assert s.metadata.get(md.KEY_TOKEN) == "rotated-token"
+        assert s.session is not first_session  # restarted with the new token
+        assert s.session.token == "rotated-token"
+    finally:
+        s.stop()
+        cp.stop()
+
+
+def test_write_token_no_fifo_errors(tmp_path):
+    err = Server.write_token("tok", str(tmp_path / "missing.fifo"))
+    assert err is not None
+
+
+def test_fifo_empty_write_is_ignored(tmp_path):
+    """An empty write (the daemon's own shutdown nudge) must not wipe the
+    stored token."""
+    import time
+
+    from gpud_tpu import metadata as md
+
+    cfg = _cfg(tmp_path)
+    s = Server(config=cfg)
+    try:
+        s.start()
+        s.metadata.set(md.KEY_TOKEN, "keep-me")
+        # the watcher thread may not have reached its blocking open yet;
+        # ENXIO until a reader exists, so retry briefly
+        deadline = time.time() + 10
+        err = "never tried"
+        while time.time() < deadline:
+            err = Server.write_token("", cfg.fifo_file())
+            if err is None:
+                break
+            time.sleep(0.05)
+        assert err is None
+        time.sleep(0.3)
+        assert s.metadata.get(md.KEY_TOKEN) == "keep-me"
+    finally:
+        s.stop()

@@ -255,3 +255,39 @@ def test_self_update_exit_code_lifecycle(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_manager_serve_subprocess_lifecycle(tmp_path):
+    """`tpud manager serve` as a real process: boots, prints its endpoint
+    JSON, answers the operator API, exits cleanly on SIGTERM."""
+    import json
+    import signal
+    import urllib.request
+
+    import select
+
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gpud_tpu.cli", "manager", "serve",
+         "--port", "0", "--grpc-port", "0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        cwd=REPO,
+    )
+    try:
+        # bounded read: a wedged child must fail this test, not hang pytest
+        ready, _, _ = select.select([proc.stdout], [], [], 30)
+        assert ready, "manager never printed its endpoint JSON"
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        assert info["endpoint"].startswith("http://127.0.0.1:")
+        assert info["grpc_port"] > 0
+        assert info["instance_id"].startswith("tpud-manager-")
+        with urllib.request.urlopen(f"{info['endpoint']}/v1/machines", timeout=10) as r:
+            assert json.loads(r.read()) == {"machines": []}
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)

@@ -1,6 +1,7 @@
 """CLI surfaces of the dev tools: helm_render main (render + --set +
 failure modes) and gen_catalog_doc --check (the CI sync gate)."""
 
+import os
 import subprocess
 import sys
 
@@ -8,8 +9,9 @@ import pytest
 
 yaml = pytest.importorskip("yaml")
 
-from gpud_tpu.tools import helm_render
+from gpud_tpu.tools import helm_render  # noqa: F401 - import sanity
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHART = "deployments/helm/tpud"
 
 
@@ -18,7 +20,7 @@ def _run(mod, *args):
         [sys.executable, "-m", mod, *args],
         capture_output=True,
         text=True,
-        cwd="/root/repo",
+        cwd=REPO,
         timeout=120,
     )
 
@@ -88,14 +90,12 @@ def test_gen_catalog_doc_check_in_sync():
 
 def test_gen_catalog_doc_check_detects_drift(tmp_path):
     """--check against a stale copy exits 1 (the CI gate actually gates)."""
-    import os
-    import shutil
 
     work = tmp_path / "repo"
     work.mkdir()
     (work / "docs").mkdir()
     (work / "docs" / "CATALOG.md").write_text("stale\n")
-    env = dict(os.environ, PYTHONPATH="/root/repo")
+    env = dict(os.environ, PYTHONPATH=REPO)
     res = subprocess.run(
         [sys.executable, "-m", "gpud_tpu.tools.gen_catalog_doc", "--check"],
         capture_output=True,
