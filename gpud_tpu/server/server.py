@@ -351,16 +351,32 @@ class Server:
             if self._closed:
                 return
             # credentials must stay PAIRED with the endpoint they were
-            # issued for: a login persists endpoint+token together, so a
-            # complete metadata pair wins as a unit. Otherwise fall back
-            # piecewise — config endpoint with a rotated metadata token is
-            # the FIFO/updateToken hand-off case (the rotation targets the
-            # endpoint the daemon is already talking to), and the --token
-            # boot flag is only the initial bootstrap credential.
+            # issued for. Precedence:
+            #   1. a complete --endpoint/--token flag pair is explicit
+            #      operator intent THIS boot (re-pointing a previously
+            #      enrolled daemon must work without wiping metadata) —
+            #      but a token rotation (FIFO/updateToken) CONSUMES the
+            #      bootstrap token flag, so after rotation the runtime
+            #      credential lives in metadata;
+            #   2. a complete metadata pair (persisted together by login);
+            #   3. piecewise fallback (rotated metadata token + config
+            #      endpoint is the hand-off case).
             md_endpoint = self.metadata.get(md.KEY_ENDPOINT)
             md_token = self.metadata.get(md.KEY_TOKEN)
-            if md_endpoint and md_token:
+            if self.config.endpoint and self.config.token:
+                endpoint, token = self.config.endpoint, self.config.token
+                if md_endpoint and md_endpoint != endpoint:
+                    logger.warning(
+                        "boot flags override enrolled endpoint %s -> %s",
+                        md_endpoint, endpoint,
+                    )
+            elif md_endpoint and md_token:
                 endpoint, token = md_endpoint, md_token
+                if self.config.endpoint and self.config.endpoint != endpoint:
+                    logger.warning(
+                        "enrolled metadata endpoint %s overrides --endpoint %s "
+                        "(no --token given)", endpoint, self.config.endpoint,
+                    )
             else:
                 endpoint = self.config.endpoint or md_endpoint
                 token = md_token or self.config.token
@@ -420,6 +436,9 @@ class Server:
                         return
                     if token:
                         self.metadata.set(md.KEY_TOKEN, token)
+                        # the rotation consumes the bootstrap flag: the
+                        # restarted session must use the NEW credential
+                        self.config.token = ""
                         logger.info("received new token via fifo; (re)starting session")
                         with self._session_mu:
                             if self.session is not None:

@@ -488,6 +488,10 @@ class Dispatcher:
         if not token:
             return {"error": "token required"}
         self.server.metadata.set(KEY_TOKEN, token)
+        # rotation consumes the bootstrap --token flag (server.py
+        # _maybe_start_session precedence): any later session restart must
+        # use the rotated credential, not the stale boot flag
+        self.server.config.token = ""
         if self.server.session is not None:
             self.server.session.token = token
         return {"status": "ok"}

@@ -51,6 +51,7 @@ _AUTH_ERROR_RE = _re.compile(
     r"|unauthorized"
     r"|permission_denied"     # grpc enum spelling only, not OS errors
     r"|invalid token"
+    r"|bad token"             # v2 HelloAck rejection vocabulary
     r"|invalid machine proof)",
     _re.IGNORECASE,
 )

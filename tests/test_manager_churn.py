@@ -1,0 +1,134 @@
+"""Control-plane churn: agents joining/dropping/reconnecting while
+operators spam requests and drains — the fleet-lifecycle stress the
+single-flow e2e tests can't produce. Invariants: no crashed manager, no
+cross-paired responses, registry converges to the live set."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from gpud_tpu.manager.control_plane import AgentGone, ControlPlane
+from gpud_tpu.session.session import Session
+
+pytest.importorskip("grpc")
+requests = pytest.importorskip("requests")
+
+N_AGENTS = 6
+CHURN_SECONDS = 8.0
+
+
+def _mk_agent(cp, i, monkeypatch_env):
+    """A v2 agent whose dispatcher tags responses with its identity."""
+    ident = f"churn-{i}"
+
+    def dispatch(req):
+        return {"who": ident, "method": req.get("method")}
+
+    s = Session(
+        endpoint=cp.endpoint,
+        machine_id=ident,
+        token="t",
+        machine_proof="p",
+        dispatch_fn=dispatch,
+        protocol="v2",
+        jitter_fn=lambda b: 0.05,
+    )
+    s.start()
+    return ident, s
+
+
+def test_fleet_churn_under_operator_load(monkeypatch):
+    monkeypatch.setenv("TPUD_SESSION_V2_TARGET", "")
+    cp = ControlPlane()
+    cp.start()
+    monkeypatch.setenv("TPUD_SESSION_V2_TARGET", f"127.0.0.1:{cp.grpc_port}")
+    sessions = {}
+    errors: "queue.Queue[str]" = queue.Queue()
+    stop = threading.Event()
+
+    try:
+        for i in range(N_AGENTS):
+            ident, s = _mk_agent(cp, i, monkeypatch)
+            sessions[ident] = s
+        deadline = time.time() + 15
+        while time.time() < deadline and len(cp.agents) < N_AGENTS:
+            time.sleep(0.05)
+        assert len(cp.agents) == N_AGENTS
+
+        def operator(tid):
+            """Spam requests at random-ish agents; verify response pairing."""
+            n = 0
+            while not stop.is_set():
+                ident = f"churn-{(tid + n) % N_AGENTS}"
+                n += 1
+                try:
+                    resp = cp.agent(ident).request(
+                        {"method": "states"}, timeout=5
+                    )
+                    # the CORE invariant: responses never cross agents
+                    if resp.get("who") not in (ident, None) and "error" not in resp:
+                        errors.put(f"cross-pairing: asked {ident} got {resp}")
+                except (AgentGone, TimeoutError):
+                    pass  # churn makes these legitimate
+                except Exception as e:  # noqa: BLE001
+                    errors.put(f"operator crash: {e!r}")
+                time.sleep(0.01)
+
+        def churner():
+            """Kill and resurrect agents continuously."""
+            n = 0
+            while not stop.is_set():
+                ident = f"churn-{n % N_AGENTS}"
+                n += 1
+                s = sessions.get(ident)
+                if s is not None:
+                    s.stop()
+                    time.sleep(0.05)
+                    _, s2 = _mk_agent(cp, n % N_AGENTS, monkeypatch)
+                    sessions[ident] = s2
+                time.sleep(0.15)
+
+        threads = [
+            threading.Thread(target=operator, args=(i,), daemon=True)
+            for i in range(3)
+        ] + [threading.Thread(target=churner, daemon=True)]
+        for t in threads:
+            t.start()
+        time.sleep(CHURN_SECONDS)
+        # one drain mid-churn: must not wedge anything
+        cp.drain("chaos drain")
+        time.sleep(2.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        assert errors.empty(), errors.get()
+        # after churn ends, the fleet reconverges: every agent usable
+        deadline = time.time() + 20
+        alive = set()
+        while time.time() < deadline and len(alive) < N_AGENTS:
+            for i in range(N_AGENTS):
+                ident = f"churn-{i}"
+                if ident in alive:
+                    continue
+                try:
+                    resp = cp.agent(ident).request({"method": "states"}, timeout=5)
+                    if resp.get("who") == ident:
+                        alive.add(ident)
+                except (AgentGone, TimeoutError):
+                    pass
+            time.sleep(0.1)
+        assert len(alive) == N_AGENTS, f"only reconverged: {sorted(alive)}"
+        # operator surface consistent with the live set
+        machines = {m["machine_id"] for m in cp.machines()}
+        assert machines == {f"churn-{i}" for i in range(N_AGENTS)}
+    finally:
+        stop.set()
+        for s in sessions.values():
+            try:
+                s.stop()
+            except Exception:  # noqa: BLE001
+                pass
+        cp.stop()

@@ -207,3 +207,30 @@ def test_fifo_empty_write_is_ignored(tmp_path):
         assert s.metadata.get(md.KEY_TOKEN) == "keep-me"
     finally:
         s.stop()
+
+
+def test_boot_flag_pair_repoints_enrolled_daemon(tmp_path):
+    """Explicit --endpoint AND --token re-point a previously-enrolled
+    daemon (metadata pair exists) — the flags are this boot's operator
+    intent. A rotation still consumes the token flag (covered above)."""
+    from gpud_tpu import metadata as md
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp = FakeControlPlane()
+    cp.start()
+    cfg = _cfg(tmp_path)
+    cfg.endpoint = f"http://127.0.0.1:{cp.port}"
+    cfg.token = "flag-token"
+    cfg.machine_id = "repoint-box"
+    s = Server(config=cfg)
+    # stale enrollment pointing somewhere unreachable
+    s.metadata.set(md.KEY_ENDPOINT, "http://127.0.0.1:1")
+    s.metadata.set(md.KEY_TOKEN, "old-enrolled-token")
+    try:
+        s.start()
+        assert cp.connected.wait(10), "flags did not re-point the session"
+        assert s.session.endpoint == cfg.endpoint.rstrip("/")
+        assert s.session.token == "flag-token"
+    finally:
+        s.stop()
+        cp.stop()

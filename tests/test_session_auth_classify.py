@@ -1,0 +1,121 @@
+"""Auth-failure classification (session.py is_auth_error) — the signal
+that parks reconnects instead of hammering a control plane that revoked
+us (reference: session_reconnect.go classify + session_v2.go:359)."""
+
+import pytest
+
+from gpud_tpu.session.session import is_auth_error
+
+
+class _HttpError(Exception):
+    def __init__(self, status_code):
+        class R:
+            pass
+
+        self.response = R()
+        self.response.status_code = status_code
+        super().__init__(f"HTTP {status_code}")
+
+
+class _GrpcCode:
+    def __init__(self, name):
+        self.name = name
+
+
+class _GrpcError(Exception):
+    def __init__(self, code_name):
+        self._code = _GrpcCode(code_name)
+        super().__init__(code_name)
+
+    def code(self):
+        return self._code
+
+
+class _BrokenGrpcError(Exception):
+    def code(self):
+        raise RuntimeError("no status")
+
+
+@pytest.mark.parametrize(
+    "exc,expected",
+    [
+        (_HttpError(401), True),
+        (_HttpError(403), True),
+        (_HttpError(500), False),   # definite non-auth HTTP status
+        (_HttpError(429), False),
+        (_GrpcError("UNAUTHENTICATED"), True),
+        (_GrpcError("PERMISSION_DENIED"), True),
+        (_GrpcError("UNAVAILABLE"), False),  # definite non-auth grpc code
+        (_GrpcError("DEADLINE_EXCEEDED"), False),
+    ],
+)
+def test_structured_classification(exc, expected):
+    assert is_auth_error(exc) is expected
+
+
+def test_broken_code_falls_back_to_text():
+    # code() raising must not crash classification; text match decides
+    assert is_auth_error(_BrokenGrpcError()) is False
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("401 Client Error: Unauthorized for url", True),
+        ("handshake rejected: bad token", True),
+        ("v2 stream: StatusCode.UNAUTHENTICATED", True),
+        ("connection refused", False),
+        ("read timeout", False),
+        # anchored matching: an URL merely CONTAINING '401' digits must
+        # not classify as auth failure
+        ("GET http://cp/route4012 failed: connection reset", False),
+    ],
+)
+def test_text_classification_anchored(text, expected):
+    assert is_auth_error(text) is expected
+
+
+def test_http_status_beats_text():
+    """A 503 whose body text mentions 'unauthorized' is still a network
+    problem — the structured status wins."""
+    e = _HttpError(503)
+    e.args = ("503 unauthorized proxy blurb",)
+    assert is_auth_error(e) is False
+
+
+def test_v2_hello_rejection_parks_reconnect():
+    """A manager rejecting the Hello with 'bad token' must PARK the
+    session (auth classification), not hammer reconnects forever."""
+    grpc = pytest.importorskip("grpc")
+    import time
+
+    from gpud_tpu.session.session import Session
+    from tests.test_session_v2 import FakeManagerV2
+
+    m = FakeManagerV2(reject=True)
+    m.start()
+    try:
+        sleeps = []
+        s = Session(
+            endpoint=f"http://127.0.0.1:{m.port}",
+            machine_id="parked",
+            token="revoked",
+            machine_proof="p",
+            dispatch_fn=lambda r: {},
+            protocol="v2",
+            jitter_fn=lambda b: 0.01,
+            time_sleep_fn=lambda t: (sleeps.append(t), False)[1]
+            or time.sleep(min(t, 0.01)),
+        )
+        s.start()
+        deadline = time.time() + 10
+        while time.time() < deadline and not s.auth_failed:
+            time.sleep(0.02)
+        assert s.auth_failed, "rejection never classified as auth failure"
+        hellos_at_park = len(m.hellos)
+        time.sleep(0.5)
+        # parked: no further reconnect attempts while the token is unchanged
+        assert len(m.hellos) <= hellos_at_park + 1
+        s.stop()
+    finally:
+        m.stop()

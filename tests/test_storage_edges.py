@@ -12,6 +12,24 @@ from gpud_tpu.eventstore import EventStore
 from gpud_tpu.sqlite import DB
 
 
+class OneShotStop:
+    """Drives a purge loop deterministically: first wait() runs one
+    cycle, the second stops it."""
+
+    def __init__(self):
+        self.waits = []
+
+    def wait(self, interval):
+        self.waits.append(interval)
+        return len(self.waits) > 1
+
+    def set(self):
+        pass
+
+    def is_set(self):
+        return len(self.waits) > 1
+
+
 # -- eventstore purger -----------------------------------------------------
 
 
@@ -23,26 +41,12 @@ def test_purger_deletes_beyond_retention(tmp_path):
     b.insert(Event(component="c", time=now - 5000, name="ancient"))
     b.insert(Event(component="c", time=now - 10, name="fresh"))
 
-    # drive the purge loop deterministically: first wait → run one purge
-    # cycle, second wait → stop
-    waits = []
-
-    class OneShotStop:
-        def wait(self, interval):
-            waits.append(interval)
-            return len(waits) > 1
-
-        def set(self):
-            pass
-
-        def is_set(self):
-            return len(waits) > 1
-
-    store._stop = OneShotStop()
+    stopper = OneShotStop()
+    store._stop = stopper
     store.time_now_fn = lambda: now
     store._purge_loop()
     # interval honors the retention/5 contract with the 60s floor
-    assert waits[0] == max(60.0, 1000.0 / 5.0)
+    assert stopper.waits[0] == max(60.0, 1000.0 / 5.0)
     names = [e.name for e in b.get(0)]
     assert names == ["fresh"]
     db.close()
@@ -62,14 +66,8 @@ def test_purger_start_idempotent(tmp_path):
 def test_purge_loop_survives_db_failure(tmp_path):
     db = DB(str(tmp_path / "s.db"))
     store = EventStore(db, retention_seconds=1000.0)
-    waits = []
-
-    class OneShotStop:
-        def wait(self, interval):
-            waits.append(interval)
-            return len(waits) > 1
-
-    store._stop = OneShotStop()
+    stopper = OneShotStop()
+    store._stop = stopper
 
     class BoomDB:
         def execute(self, *a, **k):
@@ -77,7 +75,7 @@ def test_purge_loop_survives_db_failure(tmp_path):
 
     store.db = BoomDB()
     store._purge_loop()  # logs, does not raise
-    assert len(waits) == 2
+    assert len(stopper.waits) == 2
     db.close()
 
 
