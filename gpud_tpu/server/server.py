@@ -351,34 +351,36 @@ class Server:
             if self._closed:
                 return
             # credentials must stay PAIRED with the endpoint they were
-            # issued for. Precedence:
-            #   1. a complete --endpoint/--token flag pair is explicit
-            #      operator intent THIS boot (re-pointing a previously
-            #      enrolled daemon must work without wiping metadata) —
-            #      but a token rotation (FIFO/updateToken) CONSUMES the
-            #      bootstrap token flag, so after rotation the runtime
-            #      credential lives in metadata;
-            #   2. a complete metadata pair (persisted together by login);
-            #   3. piecewise fallback (rotated metadata token + config
-            #      endpoint is the hand-off case).
-            md_endpoint = self.metadata.get(md.KEY_ENDPOINT)
+            # issued for. Rotations (login/FIFO/updateToken) persist the
+            # endpoint+token pair to metadata together, so:
+            #   1. a complete --endpoint/--token flag pair wins ONLY when
+            #      it points at a DIFFERENT control plane than the
+            #      enrollment — that's an operator re-point. Flags aimed
+            #      at the SAME endpoint (the systemd unit re-supplying
+            #      bootstrap args every restart) defer to the metadata
+            #      pair, whose token is the freshest credential for that
+            #      endpoint — otherwise every restart would resurrect the
+            #      revoked bootstrap token;
+            #   2. else a complete metadata pair wins as a unit;
+            #   3. else piecewise fallback.
+            md_endpoint = (self.metadata.get(md.KEY_ENDPOINT) or "").rstrip("/")
             md_token = self.metadata.get(md.KEY_TOKEN)
-            if self.config.endpoint and self.config.token:
-                endpoint, token = self.config.endpoint, self.config.token
-                if md_endpoint and md_endpoint != endpoint:
+            cfg_endpoint = (self.config.endpoint or "").rstrip("/")
+            if (
+                cfg_endpoint
+                and self.config.token
+                and (not (md_endpoint and md_token) or cfg_endpoint != md_endpoint)
+            ):
+                endpoint, token = cfg_endpoint, self.config.token
+                if md_endpoint and md_endpoint != cfg_endpoint:
                     logger.warning(
-                        "boot flags override enrolled endpoint %s -> %s",
-                        md_endpoint, endpoint,
+                        "boot flags re-point the daemon: enrolled %s -> %s",
+                        md_endpoint, cfg_endpoint,
                     )
             elif md_endpoint and md_token:
                 endpoint, token = md_endpoint, md_token
-                if self.config.endpoint and self.config.endpoint != endpoint:
-                    logger.warning(
-                        "enrolled metadata endpoint %s overrides --endpoint %s "
-                        "(no --token given)", endpoint, self.config.endpoint,
-                    )
             else:
-                endpoint = self.config.endpoint or md_endpoint
+                endpoint = cfg_endpoint or md_endpoint
                 token = md_token or self.config.token
             if not endpoint or not token:
                 return
@@ -435,10 +437,20 @@ class Server:
                     if self._fifo_stop.is_set():
                         return
                     if token:
+                        # persist the PAIR: the rotated token belongs to
+                        # the endpoint the session is (about to be)
+                        # talking to, and the pair must survive a process
+                        # restart that re-supplies stale boot flags
+                        with self._session_mu:
+                            active = (
+                                self.session.endpoint
+                                if self.session is not None
+                                else (self.config.endpoint or "").rstrip("/")
+                                or self.metadata.get(md.KEY_ENDPOINT)
+                            )
+                        if active:
+                            self.metadata.set(md.KEY_ENDPOINT, active)
                         self.metadata.set(md.KEY_TOKEN, token)
-                        # the rotation consumes the bootstrap flag: the
-                        # restarted session must use the NEW credential
-                        self.config.token = ""
                         logger.info("received new token via fifo; (re)starting session")
                         with self._session_mu:
                             if self.session is not None:

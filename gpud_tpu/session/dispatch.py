@@ -23,7 +23,7 @@ from gpud_tpu import host as pkghost
 from gpud_tpu import machine_info as machineinfo
 from gpud_tpu.fault_injector import Request as InjectRequest
 from gpud_tpu.log import audit, get_logger
-from gpud_tpu.metadata import KEY_TOKEN
+from gpud_tpu.metadata import KEY_ENDPOINT, KEY_TOKEN
 from gpud_tpu.process import run_bash_script
 
 if TYPE_CHECKING:
@@ -487,11 +487,12 @@ class Dispatcher:
         token = req.get("token", "")
         if not token:
             return {"error": "token required"}
+        # persist the PAIR: the rotation came from the control plane the
+        # session is talking to, and must survive a process restart that
+        # re-supplies stale boot flags (server.py precedence rule)
+        if self.server.session is not None:
+            self.server.metadata.set(KEY_ENDPOINT, self.server.session.endpoint)
         self.server.metadata.set(KEY_TOKEN, token)
-        # rotation consumes the bootstrap --token flag (server.py
-        # _maybe_start_session precedence): any later session restart must
-        # use the rotated credential, not the stale boot flag
-        self.server.config.token = ""
         if self.server.session is not None:
             self.server.session.token = token
         return {"status": "ok"}

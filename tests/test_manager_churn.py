@@ -80,13 +80,15 @@ def test_fleet_churn_under_operator_load(monkeypatch):
             """Kill and resurrect agents continuously."""
             n = 0
             while not stop.is_set():
-                ident = f"churn-{n % N_AGENTS}"
+                idx = n % N_AGENTS
+                ident = f"churn-{idx}"
                 n += 1
                 s = sessions.get(ident)
                 if s is not None:
                     s.stop()
                     time.sleep(0.05)
-                    _, s2 = _mk_agent(cp, n % N_AGENTS, monkeypatch)
+                    # resurrect the SAME identity that was killed
+                    _, s2 = _mk_agent(cp, idx, monkeypatch)
                     sessions[ident] = s2
                 time.sleep(0.15)
 

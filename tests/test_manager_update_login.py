@@ -392,3 +392,37 @@ def test_package_informer_reacts_within_poll_interval(tmp_path):
         assert not d.exists()
     finally:
         pm.close()
+
+
+def test_package_informer_polling_fallback_without_inotify(tmp_path, monkeypatch):
+    """Non-Linux / restricted sandboxes get the plain interval-poll loop;
+    it must reconcile and survive reconcile exceptions."""
+    import threading
+    import time
+
+    import gpud_tpu.manager.packages as pk
+    from gpud_tpu.inotify import InotifyWatch
+
+    monkeypatch.setattr(
+        InotifyWatch, "create", staticmethod(lambda *a, **k: None)
+    )
+    monkeypatch.setattr(pk, "RECONCILE_INTERVAL", 0.05)
+    mgr = pk.PackageManager(str(tmp_path / "pkgs"))
+    calls = []
+    real = mgr.reconcile_once
+
+    def counting():
+        calls.append(1)
+        if len(calls) == 2:
+            raise RuntimeError("transient")  # loop must survive
+        return real()
+
+    mgr.reconcile_once = counting
+    mgr.start()
+    try:
+        deadline = time.time() + 5
+        while time.time() < deadline and len(calls) < 4:
+            time.sleep(0.02)
+        assert len(calls) >= 4  # kept polling after the exception
+    finally:
+        mgr.close()

@@ -234,3 +234,94 @@ def test_boot_flag_pair_repoints_enrolled_daemon(tmp_path):
     finally:
         s.stop()
         cp.stop()
+
+
+def test_rotation_survives_process_restart_with_stale_flags(tmp_path):
+    """systemd restarts re-supply the unit file's --endpoint/--token. A
+    rotated credential persisted to metadata (as a PAIR with its
+    endpoint) must beat the stale bootstrap token on the NEXT boot —
+    flags only win when they point at a DIFFERENT control plane."""
+    from gpud_tpu import metadata as md
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp = FakeControlPlane()
+    cp.start()
+    try:
+        cfg = _cfg(tmp_path)
+        cfg.endpoint = f"http://127.0.0.1:{cp.port}"
+        cfg.token = "unit-file-token"
+        cfg.machine_id = "restart-box"
+        s1 = Server(config=cfg)
+        s1.start()
+        assert cp.connected.wait(10)
+        # rotation arrives via updateToken (persists the endpoint+token pair)
+        from gpud_tpu.session.dispatch import Dispatcher
+
+        resp = Dispatcher(s1)({"method": "updateToken", "token": "rotated-T"})
+        assert resp["status"] == "ok"
+        s1.stop()
+
+        # process restart: same data dir, same stale unit-file flags
+        cfg2 = _cfg(tmp_path)
+        cfg2.endpoint = f"http://127.0.0.1:{cp.port}"
+        cfg2.token = "unit-file-token"
+        cfg2.machine_id = "restart-box"
+        s2 = Server(config=cfg2)
+        try:
+            s2.start()
+            assert s2.session is not None
+            assert s2.session.token == "rotated-T"  # not the stale flag
+        finally:
+            s2.stop()
+    finally:
+        cp.stop()
+
+
+def test_fifo_rotation_pairs_with_active_endpoint(tmp_path):
+    """After a flag re-point, a FIFO rotation must pair the new token
+    with the endpoint the session is ACTUALLY talking to — not a stale
+    metadata endpoint from an old enrollment."""
+    import time
+
+    from gpud_tpu import metadata as md
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp = FakeControlPlane()
+    cp.start()
+    try:
+        cfg = _cfg(tmp_path)
+        cfg.endpoint = f"http://127.0.0.1:{cp.port}"
+        cfg.token = "flag-token"
+        cfg.machine_id = "pair-box"
+        s = Server(config=cfg)
+        # stale enrollment from a previous life, different endpoint
+        s.metadata.set(md.KEY_ENDPOINT, "http://10.0.0.9:1")
+        s.metadata.set(md.KEY_TOKEN, "old-T")
+        try:
+            s.start()
+            assert cp.connected.wait(10)  # flags re-pointed (different CP)
+            deadline = time.time() + 10
+            err = "never tried"
+            while time.time() < deadline:
+                err = Server.write_token("fresh-T", cfg.fifo_file())
+                if err is None:
+                    break
+                time.sleep(0.05)
+            assert err is None
+            deadline = time.time() + 10
+            while time.time() < deadline and s.metadata.get(md.KEY_TOKEN) != "fresh-T":
+                time.sleep(0.05)
+            # the pair now names the ACTIVE control plane, not 10.0.0.9
+            assert s.metadata.get(md.KEY_ENDPOINT) == cfg.endpoint.rstrip("/")
+            assert s.metadata.get(md.KEY_TOKEN) == "fresh-T"
+            deadline = time.time() + 10
+            while time.time() < deadline and (
+                s.session is None or s.session.token != "fresh-T"
+            ):
+                time.sleep(0.05)
+            assert s.session.endpoint == cfg.endpoint.rstrip("/")
+            assert s.session.token == "fresh-T"
+        finally:
+            s.stop()
+    finally:
+        cp.stop()

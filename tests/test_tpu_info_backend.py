@@ -151,3 +151,42 @@ def test_tpu_info_backend_no_topology_no_derived_ici(monkeypatch):
     b._accel_type = ""
     assert not b.ici_supported()
     assert b.ici_links() == []
+
+
+def test_telemetry_row_order_fallback_when_no_device_index():
+    """Rows whose head carries no parseable device index fall back to
+    enumeration order; rows with no percent columns keep zeros."""
+    from gpud_tpu.tpu.tpu_info_backend import TpuInfoBackend
+
+    fixture = """\
+TPU Chips
+/dev/accel0  TPU v4 chip  0
+/dev/accel1  TPU v4 chip  1
+
+HBM Usage
+x: 1.00 GiB / 30.75 GiB
+y: 2.00 GiB / 30.75 GiB
+"""
+    b = TpuInfoBackend(run_fn=_runner(fixture))
+    tel = b.telemetry()
+    assert abs(tel[0].hbm_used_bytes / (1 << 30) - 1.00) < 0.01
+    assert abs(tel[1].hbm_used_bytes / (1 << 30) - 2.00) < 0.01
+    assert tel[0].duty_cycle_pct == 0.0  # no percent column on the row
+
+
+def test_telemetry_extra_rows_beyond_chip_count_ignored():
+    from gpud_tpu.tpu.tpu_info_backend import TpuInfoBackend
+
+    fixture = """\
+TPU Chips
+/dev/accel0  TPU v4 chip  0
+
+HBM Usage
+a: 1.00 GiB / 30.75 GiB
+b: 2.00 GiB / 30.75 GiB
+c: 3.00 GiB / 30.75 GiB
+"""
+    b = TpuInfoBackend(run_fn=_runner(fixture))
+    tel = b.telemetry()
+    assert list(tel) == [0]
+    assert abs(tel[0].hbm_used_bytes / (1 << 30) - 1.00) < 0.01
