@@ -481,6 +481,13 @@ def cmd_manager(args) -> int:
             session_token=args.session_token or None,
             admin_token=args.admin_token or None,
         )
+        # handlers go in before the endpoint line: the printed JSON is the
+        # readiness contract, and a supervisor may SIGTERM immediately after
+        # reading it — the default disposition in that window would kill us
+        # with a nonzero status
+        stop = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
         cp.start()
         print(
             _json.dumps(
@@ -492,9 +499,6 @@ def cmd_manager(args) -> int:
             ),
             flush=True,
         )
-        stop = threading.Event()
-        for sig in (signal.SIGINT, signal.SIGTERM):
-            signal.signal(sig, lambda *_: stop.set())
         stop.wait()
         cp.stop()
         return 0

@@ -28,6 +28,7 @@ from gpud_tpu.metadata import (
     KEY_PRIVATE_IP,
     KEY_PUBLIC_IP,
     KEY_TOKEN,
+    normalize_endpoint,
     Metadata,
 )
 
@@ -87,26 +88,29 @@ def login(
             r.raise_for_status()
             return r.json()
 
-    url = endpoint.rstrip("/") + "/api/v1/login"
+    endpoint = normalize_endpoint(endpoint)
+    url = endpoint + "/api/v1/login"
     body = post_fn(url, req.to_dict())
     resp = LoginResponse.from_dict(body)
     if resp.error:
         raise RuntimeError(f"login rejected: {resp.error}")
 
-    # persist identity (reference: login.go:28-71 overwrite semantics)
+    # persist identity (reference: login.go:28-71 overwrite semantics) in
+    # ONE transaction — a crash mid-login must not leave a token paired
+    # with a stale endpoint
+    identity = {KEY_TOKEN: resp.token or token, KEY_ENDPOINT: endpoint}
     if resp.machine_id:
-        metadata.set(KEY_MACHINE_ID, resp.machine_id)
-    metadata.set(KEY_TOKEN, resp.token or token)
+        identity[KEY_MACHINE_ID] = resp.machine_id
     if resp.machine_proof:
-        metadata.set(KEY_MACHINE_PROOF, resp.machine_proof)
-    metadata.set(KEY_ENDPOINT, endpoint)
+        identity[KEY_MACHINE_PROOF] = resp.machine_proof
     if node_labels:
-        metadata.set(KEY_NODE_LABELS, json.dumps(normalize_node_labels(node_labels)))
+        identity[KEY_NODE_LABELS] = json.dumps(normalize_node_labels(node_labels))
     if public_ip:
-        metadata.set(KEY_PUBLIC_IP, public_ip)
+        identity[KEY_PUBLIC_IP] = public_ip
     if private_ip:
-        metadata.set(KEY_PRIVATE_IP, private_ip)
-    metadata.set(KEY_LOGIN_SUCCESS_TS, str(time.time()))
+        identity[KEY_PRIVATE_IP] = private_ip
+    identity[KEY_LOGIN_SUCCESS_TS] = str(time.time())
+    metadata.set_many(identity)
     audit("login", endpoint=endpoint, machine_id=resp.machine_id or machine_id)
     logger.info("logged in to %s as %s", endpoint, resp.machine_id or machine_id)
     return resp

@@ -35,6 +35,14 @@ KEY_LAST_AUTH_FAILURE = "last_auth_failure"
 KEY_ICI_MAX_LINKS_SEEN = "ici_max_links_seen"
 
 
+def normalize_endpoint(value) -> str:
+    """Canonical control-plane endpoint form (no trailing slash).
+
+    Applied at every WRITE site (login, FIFO rotation, updateToken) so
+    readers can compare persisted values without re-normalizing."""
+    return (value or "").rstrip("/")
+
+
 class Metadata:
     def __init__(self, db: DB) -> None:
         self.db = db
@@ -51,6 +59,21 @@ class Metadata:
             f"INSERT INTO {TABLE} (key, value) VALUES (?, ?) "
             "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
             (key, value),
+        )
+
+    def set_many(self, items: Dict[str, str]) -> None:
+        """All-or-nothing upsert. Credential pairs (endpoint+token) must
+        never be torn by a crash between two writes — a half-written pair
+        would be trusted over fresh boot flags on the next start."""
+        self.db.executemany(
+            f"INSERT INTO {TABLE} (key, value) VALUES (?, ?) "
+            "ON CONFLICT(key) DO UPDATE SET value=excluded.value",
+            list(items.items()),
+        )
+
+    def set_credential_pair(self, endpoint: str, token: str) -> None:
+        self.set_many(
+            {KEY_ENDPOINT: normalize_endpoint(endpoint), KEY_TOKEN: token}
         )
 
     def delete(self, key: str) -> None:

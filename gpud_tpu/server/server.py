@@ -164,6 +164,11 @@ class Server:
         self.dispatcher = None
         self.last_gossip = None
         self._session_mu = threading.Lock()
+        # serializes credential-pair metadata writes (rotations vs the
+        # success-gated on_connected persist) WITHOUT touching
+        # _session_mu — on_connected runs on the session's keepalive
+        # thread, which session.stop() joins while _session_mu is held
+        self._cred_mu = threading.Lock()
         self._closed = False
 
         # supportedness is evaluated once off the event loop: probes like
@@ -363,9 +368,26 @@ class Server:
             #      revoked bootstrap token;
             #   2. else a complete metadata pair wins as a unit;
             #   3. else piecewise fallback.
-            md_endpoint = (self.metadata.get(md.KEY_ENDPOINT) or "").rstrip("/")
+            # raw reads captured ONCE: they drive both the credential
+            # decision and the rotation-staleness snapshot below — a
+            # second read for the snapshot would open a window where a
+            # concurrent rotation lands between the two and the snapshot
+            # wrongly matches it
+            raw_md_endpoint = self.metadata.get(md.KEY_ENDPOINT)
             md_token = self.metadata.get(md.KEY_TOKEN)
-            cfg_endpoint = (self.config.endpoint or "").rstrip("/")
+            md_endpoint = md.normalize_endpoint(raw_md_endpoint)
+            cfg_endpoint = md.normalize_endpoint(self.config.endpoint)
+            if md_token and not md_endpoint and cfg_endpoint:
+                # migration: older rotation code persisted only KEY_TOKEN,
+                # so which endpoint that token belongs to is unrecorded.
+                # Assume the flag endpoint (the control plane the daemon
+                # was enrolled with) — otherwise the first restart after
+                # upgrade would resurrect the revoked bootstrap flag
+                # token. The guess is NOT persisted here: pairs are only
+                # recorded on a successful connect (on_connected), and if
+                # the guess is wrong auth fails and the flag-credential
+                # fallback below recovers.
+                md_endpoint = cfg_endpoint
             if (
                 cfg_endpoint
                 and self.config.token
@@ -378,6 +400,24 @@ class Server:
                         md_endpoint, cfg_endpoint,
                     )
             elif md_endpoint and md_token:
+                if self.config.token and self.config.token != md_token:
+                    # same-endpoint flag token loses to the rotated
+                    # credential; say so, or an operator pushing a fresh
+                    # token via the unit file has no trail to follow. (If
+                    # the rotated credential is the dead one, the auth
+                    # fallback below promotes the flag token.)
+                    logger.warning(
+                        "--token flag for %s deferred to the rotated "
+                        "metadata credential (auth-failure fallback will "
+                        "promote the flag token if the rotation is stale)",
+                        md_endpoint,
+                    )
+                if cfg_endpoint and cfg_endpoint != md_endpoint:
+                    logger.warning(
+                        "enrolled metadata endpoint %s overrides --endpoint "
+                        "%s (no --token given; supply both flags to "
+                        "re-point)", md_endpoint, cfg_endpoint,
+                    )
                 endpoint, token = md_endpoint, md_token
             else:
                 endpoint = cfg_endpoint or md_endpoint
@@ -395,13 +435,88 @@ class Server:
                 machine_proof=self.metadata.get(md.KEY_MACHINE_PROOF),
                 dispatch_fn=self.dispatcher,
             )
-            # persist auth failures so operators can distinguish "control
-            # plane revoked us" from network flakiness across restarts
-            self.session.on_auth_failure = lambda reason: self.metadata.set(
-                md.KEY_LAST_AUTH_FAILURE, f"{int(time.time())}|{reason[:200]}"
+            session = self.session
+            # pairs are persisted only once the control plane ACCEPTS the
+            # credential — a guessed or stale pair can then never become
+            # durable state that outranks fresh boot flags. The persist is
+            # skipped if a rotation changed metadata since this session
+            # was decided (the rotation is newer and owns the pair).
+            snapshot = (raw_md_endpoint, md_token)
+
+            def persist_on_connect() -> None:
+                nonlocal snapshot
+                with self._cred_mu:
+                    pair = (
+                        md.normalize_endpoint(session.endpoint),
+                        session.token,
+                    )
+                    cur = (
+                        self.metadata.get(md.KEY_ENDPOINT),
+                        self.metadata.get(md.KEY_TOKEN),
+                    )
+                    if cur == pair:
+                        snapshot = pair  # already recorded; reconnects no-op
+                        return
+                    if cur != snapshot:
+                        return  # superseded by a rotation; don't clobber
+                    self.metadata.set_credential_pair(*pair)
+                    # refresh: a credential promoted LATER in this
+                    # session's life (mid-stream revocation + flag
+                    # fallback) must still be persistable
+                    snapshot = pair
+
+            session.on_connected = persist_on_connect
+            self.session.on_auth_failure = self._make_auth_failure_handler(
+                session
             )
             self.session.start()
             logger.info("control-plane session started to %s", endpoint)
+
+    def persist_credential_pair(self, endpoint: str, token: str) -> None:
+        """Rotation writers (FIFO, updateToken) record the pair through
+        here so they serialize with the success-gated connect persist."""
+        with self._cred_mu:
+            self.metadata.set_credential_pair(endpoint, token)
+
+    def persist_token(self, token: str) -> None:
+        """Token-only rotation (no live session to name the endpoint) —
+        still serialized under _cred_mu so a dying session's late
+        persist_on_connect can't interleave and clobber the rotation."""
+        from gpud_tpu import metadata as md
+
+        with self._cred_mu:
+            self.metadata.set(md.KEY_TOKEN, token)
+
+    def _make_auth_failure_handler(self, session):
+        """Persist auth failures so operators can distinguish "control
+        plane revoked us" from network flakiness across restarts; and if
+        the boot flags carry a DIFFERENT token for the endpoint the
+        session is talking to, promote it once — the metadata credential
+        just proved dead, and the flag pair is the operator's standing
+        instruction (recovery path for a stale rotation or a re-point
+        attempted while only a token-only migration pair existed)."""
+        from gpud_tpu import metadata as md
+
+        def on_auth_failure(reason: str) -> None:
+            self.metadata.set(
+                md.KEY_LAST_AUTH_FAILURE, f"{int(time.time())}|{reason[:200]}"
+            )
+            cfg_endpoint = md.normalize_endpoint(self.config.endpoint)
+            if (
+                self.config.token
+                and self.config.token != session.token
+                and (not cfg_endpoint or cfg_endpoint == session.endpoint)
+                and not session.flag_token_tried
+            ):
+                session.flag_token_tried = True  # one shot: no ping-pong
+                logger.warning(
+                    "auth failed with the stored credential; retrying with "
+                    "the --token flag credential"
+                )
+                # un-parks the session's auth wait (it watches .token)
+                session.token = self.config.token
+
+        return on_auth_failure
 
     def _start_token_fifo(self) -> None:
         """FIFO so `tpud up`'s login can hand a fresh token to a running
@@ -445,12 +560,15 @@ class Server:
                             active = (
                                 self.session.endpoint
                                 if self.session is not None
-                                else (self.config.endpoint or "").rstrip("/")
-                                or self.metadata.get(md.KEY_ENDPOINT)
+                                else md.normalize_endpoint(self.config.endpoint)
+                                or md.normalize_endpoint(
+                                    self.metadata.get(md.KEY_ENDPOINT)
+                                )
                             )
                         if active:
-                            self.metadata.set(md.KEY_ENDPOINT, active)
-                        self.metadata.set(md.KEY_TOKEN, token)
+                            self.persist_credential_pair(active, token)
+                        else:
+                            self.persist_token(token)
                         logger.info("received new token via fifo; (re)starting session")
                         with self._session_mu:
                             if self.session is not None:

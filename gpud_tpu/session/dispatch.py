@@ -23,7 +23,7 @@ from gpud_tpu import host as pkghost
 from gpud_tpu import machine_info as machineinfo
 from gpud_tpu.fault_injector import Request as InjectRequest
 from gpud_tpu.log import audit, get_logger
-from gpud_tpu.metadata import KEY_ENDPOINT, KEY_TOKEN
+from gpud_tpu.metadata import KEY_TOKEN
 from gpud_tpu.process import run_bash_script
 
 if TYPE_CHECKING:
@@ -490,11 +490,16 @@ class Dispatcher:
         # persist the PAIR: the rotation came from the control plane the
         # session is talking to, and must survive a process restart that
         # re-supplies stale boot flags (server.py precedence rule)
-        if self.server.session is not None:
-            self.server.metadata.set(KEY_ENDPOINT, self.server.session.endpoint)
-        self.server.metadata.set(KEY_TOKEN, token)
-        if self.server.session is not None:
-            self.server.session.token = token
+        # single read: the FIFO watch thread nulls server.session under its
+        # own lock, so a check-then-deref here would race to AttributeError
+        session = self.server.session
+        if session is not None:
+            # atomic pair write — a crash between two separate writes
+            # would durably record a mismatched endpoint/token pair
+            self.server.persist_credential_pair(session.endpoint, token)
+            session.token = token
+        else:
+            self.server.persist_token(token)
         return {"status": "ok"}
 
     def _m_getToken(self, req: Dict) -> Dict:

@@ -167,6 +167,13 @@ class Session:
         # hammering the control plane with the normal backoff forever
         self.auth_failed = False
         self.on_auth_failure: Optional[Callable[[str], None]] = None
+        # fires after every successful connect with the credential that
+        # worked — the server persists the endpoint+token pair here, so
+        # only credentials the control plane actually accepted are recorded
+        self.on_connected: Optional[Callable[[], None]] = None
+        # set by the server's auth-failure handler after it promotes the
+        # boot-flag token once; guards against credential ping-pong
+        self.flag_token_tried = False
 
         # protocol auto: try v2 gRPC, fall back to legacy v1 dual streams
         # (reference: session_v2.go:49-80); injected transports pin v1
@@ -224,6 +231,11 @@ class Session:
                 backoff = min(backoff * BACKOFF_FACTOR, BACKOFF_MAX)
                 continue
             self._connected.set()
+            if self.on_connected is not None:
+                try:
+                    self.on_connected()
+                except Exception:  # noqa: BLE001
+                    logger.exception("on_connected callback failed")
             backoff = BACKOFF_INITIAL
             self._reconnect_signal.wait()
             self._connected.clear()

@@ -23,6 +23,7 @@ class FakeControlPlane:
         self._thread: Optional[threading.Thread] = None
         self.connected = threading.Event()
         self.reject_auth = False   # return 401 on session streams
+        self.accept_token: Optional[str] = None  # 401 any other bearer token
         self.auth_rejects = 0
 
     # -- server ------------------------------------------------------------
@@ -41,6 +42,11 @@ class FakeControlPlane:
         if self.reject_auth:
             self.auth_rejects += 1
             return web.Response(status=401, text="unauthorized")
+        if self.accept_token is not None:
+            bearer = req.headers.get("Authorization", "")
+            if bearer.removeprefix("Bearer ").strip() != self.accept_token:
+                self.auth_rejects += 1
+                return web.Response(status=401, text="unauthorized")
         stype = req.headers.get("X-TPUD-Session-Type", "")
         machine = req.headers.get("X-TPUD-Machine-ID", "")
         if stype == "read":
@@ -90,6 +96,17 @@ class FakeControlPlane:
         if q is None:
             raise RuntimeError(f"no session for {machine_id}")
         asyncio.run_coroutine_threadsafe(q.put(payload), self._loop).result(
+            timeout=5
+        )
+
+    def drop_session(self, machine_id: str) -> None:
+        """End the read stream, forcing the agent to reconnect (used with
+        accept_token changes to model a mid-stream revocation)."""
+        q = self.sessions.pop(machine_id, None)
+        if q is None:
+            raise RuntimeError(f"no session for {machine_id}")
+        self.connected.clear()
+        asyncio.run_coroutine_threadsafe(q.put(None), self._loop).result(
             timeout=5
         )
 

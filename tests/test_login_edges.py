@@ -69,7 +69,9 @@ def test_url_and_endpoint_normalization(tmp_db):
         endpoint="https://cp.example/",
     )
     assert cap["url"] == "https://cp.example/api/v1/login"
-    assert meta.get(md.KEY_ENDPOINT) == "https://cp.example/"
+    # persisted in canonical (no-trailing-slash) form so every reader can
+    # compare raw values without re-normalizing
+    assert meta.get(md.KEY_ENDPOINT) == "https://cp.example"
 
 
 def test_node_labels_namespaced_and_persisted(tmp_db):

@@ -3,6 +3,7 @@
 
 import os
 import stat
+import time
 
 import pytest
 
@@ -99,8 +100,6 @@ def test_stop_is_idempotent(tmp_path):
 
 
 def test_metrics_syncer_running_after_boot(tmp_path):
-    import time
-
     from gpud_tpu.metrics.registry import Registry
 
     # a FRESH registry isolates the pipeline under test from gauges other
@@ -135,8 +134,6 @@ def test_fifo_token_handoff_restarts_session(tmp_path):
     """`tpud up --token` hand-off path: a token written into the FIFO is
     persisted to metadata and the control-plane session restarts with it
     (server.py watch loop)."""
-    import time
-
     from gpud_tpu import metadata as md
     from tests.fake_control_plane import FakeControlPlane
 
@@ -184,8 +181,6 @@ def test_write_token_no_fifo_errors(tmp_path):
 def test_fifo_empty_write_is_ignored(tmp_path):
     """An empty write (the daemon's own shutdown nudge) must not wipe the
     stored token."""
-    import time
-
     from gpud_tpu import metadata as md
 
     cfg = _cfg(tmp_path)
@@ -281,8 +276,6 @@ def test_fifo_rotation_pairs_with_active_endpoint(tmp_path):
     """After a flag re-point, a FIFO rotation must pair the new token
     with the endpoint the session is ACTUALLY talking to — not a stale
     metadata endpoint from an old enrollment."""
-    import time
-
     from gpud_tpu import metadata as md
     from tests.fake_control_plane import FakeControlPlane
 
@@ -321,6 +314,182 @@ def test_fifo_rotation_pairs_with_active_endpoint(tmp_path):
                 time.sleep(0.05)
             assert s.session.endpoint == cfg.endpoint.rstrip("/")
             assert s.session.token == "fresh-T"
+        finally:
+            s.stop()
+    finally:
+        cp.stop()
+
+
+def test_pre_pairing_metadata_token_backfills_endpoint(tmp_path):
+    """Migration: older rotation code persisted only KEY_TOKEN (no
+    endpoint pair). On the first restart after upgrade with the same
+    unit-file flags, that rotated token must still beat the stale flag
+    token, and the pair must be backfilled so later boots agree."""
+    from gpud_tpu import metadata as md
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp = FakeControlPlane()
+    cp.start()
+    try:
+        cfg = _cfg(tmp_path)
+        cfg.endpoint = f"http://127.0.0.1:{cp.port}/"  # trailing slash: writer normalizes
+        cfg.token = "revoked-bootstrap-token"
+        cfg.machine_id = "migrate-box"
+        s = Server(config=cfg)
+        # pre-upgrade state: token rotated, endpoint never persisted
+        s.metadata.set(md.KEY_TOKEN, "rotated-by-old-code")
+        try:
+            s.start()
+            assert s.session is not None
+            assert s.session.token == "rotated-by-old-code"
+            # pair is persisted on successful CONNECT (not guessed at
+            # boot), so wait for the control plane to accept the session
+            assert cp.connected.wait(10)
+            deadline = time.time() + 10
+            while time.time() < deadline and not s.metadata.get(md.KEY_ENDPOINT):
+                time.sleep(0.05)
+            assert (
+                s.metadata.get(md.KEY_ENDPOINT)
+                == f"http://127.0.0.1:{cp.port}"
+            )
+        finally:
+            s.stop()
+    finally:
+        cp.stop()
+
+
+def test_auth_fallback_promotes_flag_token(tmp_path):
+    """A stale rotated credential that the control plane rejects must not
+    strand the daemon when the unit file carries a working token for the
+    same endpoint: the auth-failure handler promotes the flag token once,
+    and only the ACCEPTED pair is persisted."""
+    from gpud_tpu import metadata as md
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp = FakeControlPlane()
+    cp.start()
+    try:
+        cp.accept_token = "fresh-flag-T"
+        cfg = _cfg(tmp_path)
+        cfg.endpoint = f"http://127.0.0.1:{cp.port}"
+        cfg.token = "fresh-flag-T"
+        cfg.machine_id = "fallback-box"
+        s = Server(config=cfg)
+        # enrolled pair whose token the control plane has since revoked
+        s.metadata.set_credential_pair(cfg.endpoint, "stale-rotated-T")
+        try:
+            s.start()
+            assert s.session is not None
+            assert s.session.token == "stale-rotated-T"  # pair tried first
+            assert cp.connected.wait(15), "flag-token fallback never connected"
+            assert cp.auth_rejects >= 1  # the stale credential was refused
+            deadline = time.time() + 10
+            while (
+                time.time() < deadline
+                and s.metadata.get(md.KEY_TOKEN) != "fresh-flag-T"
+            ):
+                time.sleep(0.05)
+            assert s.metadata.get(md.KEY_TOKEN) == "fresh-flag-T"
+            assert s.metadata.get(md.KEY_ENDPOINT) == cfg.endpoint
+        finally:
+            s.stop()
+    finally:
+        cp.stop()
+
+
+def test_repoint_recovers_from_token_only_migration_state(tmp_path):
+    """Operator re-points (--endpoint CP-B --token B-tok) while metadata
+    holds only a pre-pairing token rotated by CP-A's old code. The
+    migration guess wrongly pairs that token with CP-B, CP-B refuses it,
+    and the fallback promotes the flag token — the daemon ends up on CP-B
+    with B-tok and persists that (correct) pair."""
+    from gpud_tpu import metadata as md
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp_b = FakeControlPlane()
+    cp_b.start()
+    try:
+        cp_b.accept_token = "B-tok"
+        cfg = _cfg(tmp_path)
+        cfg.endpoint = f"http://127.0.0.1:{cp_b.port}"
+        cfg.token = "B-tok"
+        cfg.machine_id = "repoint-migrate-box"
+        s = Server(config=cfg)
+        s.metadata.set(md.KEY_TOKEN, "cpA-rotated-tok")  # no endpoint pair
+        try:
+            s.start()
+            assert cp_b.connected.wait(15), "re-point never connected to CP-B"
+            deadline = time.time() + 10
+            while (
+                time.time() < deadline
+                and s.metadata.get(md.KEY_TOKEN) != "B-tok"
+            ):
+                time.sleep(0.05)
+            assert s.metadata.get(md.KEY_TOKEN) == "B-tok"
+            assert s.metadata.get(md.KEY_ENDPOINT) == cfg.endpoint
+            assert s.session.token == "B-tok"
+        finally:
+            s.stop()
+    finally:
+        cp_b.stop()
+
+
+def test_update_token_without_session_persists_token_only(tmp_path):
+    """updateToken with no live session (e.g. a FIFO rotation just tore
+    it down) must still persist the token and not crash — the handler
+    reads server.session exactly once."""
+    from gpud_tpu import metadata as md
+    from gpud_tpu.session.dispatch import Dispatcher
+
+    cfg = _cfg(tmp_path)
+    s = Server(config=cfg)
+    try:
+        s.start()
+        assert s.session is None  # no endpoint configured
+        resp = Dispatcher(s)({"method": "updateToken", "token": "late-T"})
+        assert resp["status"] == "ok"
+        assert s.metadata.get(md.KEY_TOKEN) == "late-T"
+        assert not s.metadata.get(md.KEY_ENDPOINT)
+    finally:
+        s.stop()
+
+
+def test_midstream_revocation_fallback_persists_promoted_pair(tmp_path):
+    """The control plane revokes the persisted credential AFTER a
+    successful connect. The reconnect 401s, the fallback promotes the
+    flag token, and the promoted pair must STILL be persisted (the
+    staleness snapshot follows the last persist, it isn't frozen at
+    session creation)."""
+    from gpud_tpu import metadata as md
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp = FakeControlPlane()
+    cp.start()
+    try:
+        cfg = _cfg(tmp_path)
+        cfg.endpoint = f"http://127.0.0.1:{cp.port}"
+        cfg.token = "recovery-flag-T"
+        cfg.machine_id = "revoke-box"
+        s = Server(config=cfg)
+        s.metadata.set_credential_pair(cfg.endpoint, "enrolled-T")
+        try:
+            s.start()
+            assert cp.connected.wait(15)
+            assert s.session.token == "enrolled-T"
+            # revocation: only the flag credential is admitted from now on
+            cp.accept_token = "recovery-flag-T"
+            cp.drop_session("revoke-box")
+            assert cp.connected.wait(20), "never reconnected after revocation"
+            deadline = time.time() + 10
+            while (
+                time.time() < deadline
+                and s.metadata.get(md.KEY_TOKEN) != "recovery-flag-T"
+            ):
+                time.sleep(0.05)
+            # the promoted credential is durable: the next restart will
+            # not retry the dead one
+            assert s.metadata.get(md.KEY_TOKEN) == "recovery-flag-T"
+            assert s.metadata.get(md.KEY_ENDPOINT) == cfg.endpoint
         finally:
             s.stop()
     finally:
