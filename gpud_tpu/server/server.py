@@ -542,45 +542,95 @@ class Server:
             return
 
         def watch():
+            import select as _select
+
             from gpud_tpu import metadata as md
 
-            while not self._fifo_stop.is_set():
+            def apply(token: str) -> None:
+                # persist the PAIR: the rotated token belongs to the
+                # endpoint the session is (about to be) talking to, and
+                # the pair must survive a process restart that re-supplies
+                # stale boot flags
+                with self._session_mu:
+                    active = (
+                        self.session.endpoint
+                        if self.session is not None
+                        else md.normalize_endpoint(self.config.endpoint)
+                        or md.normalize_endpoint(
+                            self.metadata.get(md.KEY_ENDPOINT)
+                        )
+                    )
+                if active:
+                    self.persist_credential_pair(active, token)
+                else:
+                    self.persist_token(token)
+                logger.info("received new token via fifo; (re)starting session")
+                with self._session_mu:
+                    if self.session is not None:
+                        self.session.stop()
+                        self.session = None
+                self._maybe_start_session()
+
+            # the watcher holds the FIFO open O_RDWR for the daemon's
+            # whole life: a reader always exists, so write_token never
+            # ENXIOs after boot AND — unlike an open/EOF/close loop — an
+            # ACKED write can never be discarded in the window where the
+            # last reader closes (Linux drops FIFO buffers at zero
+            # readers). A transient open failure (fd pressure) retries —
+            # one bad moment at boot must not disable rotation for the
+            # daemon's whole life.
+            fd = -1
+            while fd < 0:
                 try:
-                    # blocking open until a writer appears
-                    with open(fifo_path, "r", encoding="utf-8") as f:
-                        token = f.read().strip()
-                    if self._fifo_stop.is_set():
-                        return
-                    if token:
-                        # persist the PAIR: the rotated token belongs to
-                        # the endpoint the session is (about to be)
-                        # talking to, and the pair must survive a process
-                        # restart that re-supplies stale boot flags
-                        with self._session_mu:
-                            active = (
-                                self.session.endpoint
-                                if self.session is not None
-                                else md.normalize_endpoint(self.config.endpoint)
-                                or md.normalize_endpoint(
-                                    self.metadata.get(md.KEY_ENDPOINT)
-                                )
-                            )
-                        if active:
-                            self.persist_credential_pair(active, token)
-                        else:
-                            self.persist_token(token)
-                        logger.info("received new token via fifo; (re)starting session")
-                        with self._session_mu:
-                            if self.session is not None:
-                                self.session.stop()
-                                self.session = None
-                        self._maybe_start_session()
-                    # empty token: loop straight back into the blocking
-                    # open — sleeping here would leave the FIFO readerless
-                    # and make a concurrent write_token fail with ENXIO
-                except OSError:
+                    fd = os.open(fifo_path, os.O_RDWR)
+                except OSError as e:
+                    logger.warning("token fifo unavailable: %s; retrying", e)
                     if self._fifo_stop.wait(1.0):
                         return
+            buf = b""
+            try:
+                while not self._fifo_stop.is_set():
+                    if buf and b"\n" not in buf:
+                        # a writer sent bytes with no newline (raw
+                        # `printf > fifo` rotation). The old EOF-framed
+                        # reader accepted those; emulate it: if the
+                        # writer goes quiet, the buffer IS the delivery
+                        ready, _, _ = _select.select([fd], [], [], 1.0)
+                        if not ready:
+                            token = buf.decode("utf-8", "replace").strip()
+                            buf = b""
+                            if token:
+                                apply(token)
+                            continue
+                    try:
+                        chunk = os.read(fd, 4096)  # blocks until a write
+                    except OSError:
+                        if self._fifo_stop.wait(1.0):
+                            return
+                        continue
+                    if self._fifo_stop.is_set():
+                        return
+                    buf += chunk
+                    if b"\n" not in buf:
+                        continue  # partial delivery; newline or quiet next
+                    *lines, buf = buf.split(b"\n")  # tail = pending partial
+                    # rapid successive write_token calls coalesce into ONE
+                    # read; each newline-delimited line is a separate
+                    # delivery and the LATEST rotation wins — joining them
+                    # would persist a corrupt multi-line token that then
+                    # rides an Authorization header
+                    deliveries = [
+                        ln.decode("utf-8", "replace").strip()
+                        for ln in lines
+                    ]
+                    deliveries = [d for d in deliveries if d]
+                    if deliveries:
+                        apply(deliveries[-1])
+            finally:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
 
         self._fifo_stop = threading.Event()
         self._fifo_thread = threading.Thread(

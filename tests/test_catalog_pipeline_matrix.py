@@ -1,0 +1,196 @@
+"""Per-entry catalog → component pipeline matrix.
+
+test_catalog_organic proves every entry's REGEX matches its organic
+driver line; this suite proves the whole per-entry PIPELINE behaves:
+scan mode (kmsg ring → catalog → evolve_health → CheckResult) and daemon
+mode (Syncer → EventStore → health evaluation) both surface each entry
+with the severity, repair action and event type its catalog row
+declares. This mirrors the reference's per-XID component tests, which
+drive each code through component state rather than only the matcher
+(reference: components/accelerator/nvidia/xid/component_test.go — every
+code asserted through States(), not just the regex table).
+"""
+
+import pytest
+
+from gpud_tpu.api.v1.types import EventType, HealthStateType, RepairActionType
+from gpud_tpu.components.base import TpudInstance
+from gpud_tpu.components.tpu import catalog
+from gpud_tpu.components.tpu.error_kmsg import TPUErrorKmsgComponent
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.kmsg.watcher import Message
+
+from tests.test_catalog_organic import ORGANIC
+
+ENTRY_NAMES = sorted(e.name for e in catalog.CATALOG)
+
+
+def _organic_line(name: str) -> str:
+    lines = ORGANIC.get(name)
+    assert lines, f"no organic corpus line for catalog entry {name}"
+    return lines[0]
+
+
+def _scan_component(tmp_path, monkeypatch, lines) -> TPUErrorKmsgComponent:
+    """Scan-mode component (no event store) over a fixture ring buffer."""
+    fixture = tmp_path / "kmsg.fixture"
+    fixture.write_text(
+        "".join(
+            f"2,{200 + i},{100_000_000 + i * 1000},-;{line}\n"
+            for i, line in enumerate(lines)
+        )
+    )
+    monkeypatch.setenv("TPUD_KMSG_FILE_PATH", str(fixture))
+    return TPUErrorKmsgComponent(TpudInstance())
+
+
+@pytest.mark.parametrize("name", ENTRY_NAMES)
+def test_scan_mode_surfaces_entry_with_declared_severity(
+    name, tmp_path, monkeypatch
+):
+    """One organic line in the ring → check_once reports the entry by
+    name, with health driven by the entry's `critical` flag and the
+    entry's repair actions plumbed into suggested_actions."""
+    entry = catalog.lookup(name)
+    c = _scan_component(tmp_path, monkeypatch, [_organic_line(name)])
+    r = c.check_once()
+    assert name in r.reason, (name, r.reason)
+    if entry.critical:
+        assert r.health == HealthStateType.UNHEALTHY, (name, r.health)
+    else:
+        # "non-critical errors never push past Degraded"
+        assert r.health != HealthStateType.UNHEALTHY, (name, r.health)
+    wanted = [
+        a for a in entry.repair_actions
+        if a != RepairActionType.IGNORE_NO_ACTION_REQUIRED
+    ]
+    if wanted:
+        assert r.suggested_actions is not None, name
+        got = r.suggested_actions.repair_actions
+        for act in wanted:
+            assert act in got, (name, act, got)
+
+
+@pytest.mark.parametrize("name", ENTRY_NAMES)
+def test_daemon_mode_persists_entry_event(name, tmp_db):
+    """The daemon path: Syncer matches the organic line, persists an
+    Event carrying the entry's name/type plus the raw kmsg line, and the
+    component's event-sourced health sees it."""
+    es = EventStore(tmp_db)
+    inst = TpudInstance(event_store=es)
+    c = TPUErrorKmsgComponent(inst)
+    entry = catalog.lookup(name)
+    msg = Message(
+        priority=2,
+        sequence=1,
+        timestamp_us=1_000_000,
+        message=_organic_line(name),
+        time=1_700_000_000.0,
+    )
+    ev = c.syncer.process(msg)
+    assert ev is not None, (name, msg.message)
+    assert ev.name == name
+    assert ev.type == entry.event_type
+    assert ev.extra_info["kmsg"] == msg.message
+    # persisted (Find-before-Insert restart contract)
+    stored = c.events(since=0)
+    assert [e.name for e in stored] == [name]
+    # the ticker-driven evaluation path sees the persisted event
+    c.time_now_fn = lambda: 1_700_000_100.0
+    r = c.check_once()
+    assert name in r.reason
+    if entry.critical:
+        assert r.health == HealthStateType.UNHEALTHY
+
+
+@pytest.mark.parametrize("name", ENTRY_NAMES)
+def test_daemon_mode_dedupes_identical_line(name, tmp_db):
+    """Two dedupe layers, asserted per entry: the same line within the
+    same second is dropped by the deduper, and a ring RE-READ after a
+    restart (fresh deduper, identical message+time) is dropped by the
+    store's Find-before-Insert (reference: xid/component.go:545-570)."""
+    es = EventStore(tmp_db)
+    c = TPUErrorKmsgComponent(TpudInstance(event_store=es))
+    line = _organic_line(name)
+    msg = Message(
+        priority=2,
+        sequence=1,
+        timestamp_us=1_000_000,
+        message=line,
+        time=1_700_000_000.0,
+    )
+    assert c.syncer.process(msg) is not None
+    # same line, same second: deduper drops it
+    assert c.syncer.process(msg) is None, name
+    assert len(c.events(since=0)) == 1, name
+    # daemon restart: a new component re-reads the same ring; the fresh
+    # deduper lets the line through but the store refuses the duplicate
+    c2 = TPUErrorKmsgComponent(TpudInstance(event_store=EventStore(tmp_db)))
+    c2.syncer.process(msg)
+    assert len(c2.events(since=0)) == 1, name
+
+
+@pytest.mark.parametrize("name", ENTRY_NAMES)
+def test_injected_form_reaches_same_entry(name, tmp_path, monkeypatch):
+    """The fault injector's canonical ``TPU-ERR:`` line for each entry
+    must land on the SAME catalog entry as the organic kernel line —
+    injection and organic detection share one path (SURVEY §4.7)."""
+    line = catalog.injection_line(name, chip_id=3, detail="matrix")
+    m = catalog.match(line)
+    assert m is not None, (name, line)
+    assert m.entry.name == name
+    c = _scan_component(tmp_path, monkeypatch, [line])
+    r = c.check_once()
+    assert name in r.reason
+
+
+def test_set_healthy_clears_every_entry(tmp_db):
+    """SetHealthy wipes the slate regardless of which entry was active —
+    one marker clears ALL accumulated error tracks (reference:
+    xid/set_healthy.go semantics), exercised across the full catalog."""
+    es = EventStore(tmp_db)
+    c = TPUErrorKmsgComponent(TpudInstance(event_store=es))
+    t = 1_700_000_000.0
+    for i, name in enumerate(ENTRY_NAMES):
+        c.syncer.process(
+            Message(
+                priority=2,
+                sequence=i,
+                timestamp_us=i * 1_000_000,
+                message=_organic_line(name),
+                time=t + i,
+            )
+        )
+    c.time_now_fn = lambda: t + 10_000
+    r = c.check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    c.set_healthy()
+    r = c.check_once()
+    assert r.health == HealthStateType.HEALTHY, r.reason
+
+
+def test_full_catalog_scan_reports_all_criticals(tmp_path, monkeypatch):
+    """Every entry's organic line in one ring buffer: the single scan
+    reports every critical entry simultaneously (no first-error
+    short-circuit) and health is Unhealthy."""
+    lines = [_organic_line(n) for n in ENTRY_NAMES]
+    c = _scan_component(tmp_path, monkeypatch, lines)
+    r = c.check_once()
+    assert r.health == HealthStateType.UNHEALTHY
+    criticals = [e.name for e in catalog.CATALOG if e.critical]
+    for name in criticals:
+        assert name in r.reason, f"critical entry {name} missing from reason"
+
+
+def test_event_types_match_catalog_rows():
+    """Catalog rows declare Fatal/Critical/Warning/Info event types that
+    the API layer understands — no entry can carry a type the event
+    pipeline would refuse to serialize."""
+    valid = {
+        EventType.FATAL,
+        EventType.CRITICAL,
+        EventType.WARNING,
+        EventType.INFO,
+    }
+    for e in catalog.CATALOG:
+        assert e.event_type in valid, (e.name, e.event_type)

@@ -494,3 +494,80 @@ def test_midstream_revocation_fallback_persists_promoted_pair(tmp_path):
             s.stop()
     finally:
         cp.stop()
+
+
+def test_fifo_coalesced_writes_latest_rotation_wins(tmp_path):
+    """Rapid successive write_token calls coalesce into one FIFO read;
+    each newline-delimited delivery is a separate rotation and the LAST
+    one must win — never a joined multi-line token (which would ride an
+    Authorization header verbatim)."""
+    from gpud_tpu import metadata as md
+    from tests.fake_control_plane import FakeControlPlane
+
+    cp = FakeControlPlane()
+    cp.start()
+    try:
+        cfg = _cfg(tmp_path)
+        cfg.endpoint = f"http://127.0.0.1:{cp.port}"
+        cfg.token = "boot-T"
+        cfg.machine_id = "coalesce-box"
+        s = Server(config=cfg)
+        try:
+            s.start()
+            deadline = time.time() + 10
+            wrote = 0
+            while time.time() < deadline and wrote < 5:
+                err = Server.write_token(f"burst-{wrote}", cfg.fifo_file())
+                if err is None:
+                    wrote += 1  # no sleep: force coalescing in one read
+                else:
+                    time.sleep(0.05)
+            assert wrote == 5
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                tok = s.metadata.get(md.KEY_TOKEN)
+                if tok == "burst-4":
+                    break
+                time.sleep(0.05)
+            assert s.metadata.get(md.KEY_TOKEN) == "burst-4"
+            assert "\n" not in s.metadata.get(md.KEY_TOKEN)
+        finally:
+            s.stop()
+    finally:
+        cp.stop()
+
+
+def test_fifo_raw_write_without_newline_still_applies(tmp_path):
+    """A raw `printf '%s' TOK > fifo` rotation (no trailing newline —
+    accepted by the historical EOF-framed reader) must still apply: when
+    the writer goes quiet the buffered bytes are the delivery."""
+    import os
+
+    from gpud_tpu import metadata as md
+
+    cfg = _cfg(tmp_path)
+    s = Server(config=cfg)
+    try:
+        s.start()
+        deadline = time.time() + 10
+        sent = False
+        while time.time() < deadline and not sent:
+            try:
+                fd = os.open(cfg.fifo_file(), os.O_WRONLY | os.O_NONBLOCK)
+                try:
+                    os.write(fd, b"raw-noeol-T")  # no newline on purpose
+                finally:
+                    os.close(fd)
+                sent = True
+            except OSError:
+                time.sleep(0.05)
+        assert sent
+        deadline = time.time() + 10
+        while (
+            time.time() < deadline
+            and s.metadata.get(md.KEY_TOKEN) != "raw-noeol-T"
+        ):
+            time.sleep(0.1)
+        assert s.metadata.get(md.KEY_TOKEN) == "raw-noeol-T"
+    finally:
+        s.stop()
