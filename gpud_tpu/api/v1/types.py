@@ -666,6 +666,25 @@ class LoginRequest:
             d["machine_info"] = self.machine_info.to_dict()
         return d
 
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "LoginRequest":
+        # the manager side decodes what the agent encodes (the reference
+        # only ships the agent; our runnable control plane needs both
+        # directions of the login wire type)
+        return cls(
+            token=d.get("token", ""),
+            machine_id=d.get("machine_id", ""),
+            network=dict(d.get("network", {}) or {}),
+            machine_info=(
+                MachineInfo.from_dict(d["machine_info"])
+                if d.get("machine_info")
+                else None
+            ),
+            node_labels=dict(d.get("node_labels", {}) or {}),
+            provider=d.get("provider", ""),
+            region=d.get("region", ""),
+        )
+
 
 @dataclass
 class LoginResponse:
@@ -674,6 +693,15 @@ class LoginResponse:
     machine_proof: str = ""
     error: str = ""
     status: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "machine_id": self.machine_id,
+            "token": self.token,
+            "machine_proof": self.machine_proof,
+            "error": self.error,
+            "status": self.status,
+        }
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "LoginResponse":
@@ -696,3 +724,14 @@ class GossipRequest:
         if self.machine_info is not None:
             d["machine_info"] = self.machine_info.to_dict()
         return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "GossipRequest":
+        return cls(
+            machine_id=d.get("machine_id", ""),
+            machine_info=(
+                MachineInfo.from_dict(d["machine_info"])
+                if d.get("machine_info")
+                else None
+            ),
+        )

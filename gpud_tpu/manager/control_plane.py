@@ -149,6 +149,12 @@ class ControlPlane:
         self.instance_id = instance_id or f"tpud-manager-{uuid.uuid4().hex[:8]}"
         self.agents: Dict[str, AgentHandle] = {}
         self._issued_tokens: Dict[str, str] = {}  # machine_id → token
+        # machine_id → MachineInfo dict from the last login/gossip (the
+        # reference control plane records the machine tree at enrollment).
+        # Bounded: dev mode accepts logins from anyone and a restart-
+        # looping agent with empty machine_id mints a fresh id per login
+        self.machine_infos: Dict[str, dict] = {}
+        self.machine_infos_max = 512
         self._lock = threading.Lock()
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
@@ -221,22 +227,41 @@ class ControlPlane:
     async def _login(self, request):  # noqa: ANN001
         from aiohttp import web
 
+        from gpud_tpu.api.v1.types import LoginRequest, LoginResponse
+
         body = await request.json()
+        # decode through the shared wire type: the manager consumes
+        # exactly what login.py's agent side encodes (api/v1/types.py),
+        # including the nested MachineInfo tree. The body is UNTRUSTED —
+        # a malformed tree must degrade to "no machine info", not fail
+        # the enrollment itself
+        if not isinstance(body, dict):
+            body = {}
+        try:
+            req = LoginRequest.from_dict(body)
+        except Exception:  # noqa: BLE001 — hostile/garbled machine_info
+            req = LoginRequest(
+                token=str(body.get("token", "") or ""),
+                machine_id=str(body.get("machine_id", "") or ""),
+            )
         self.logins.append(body)
         del self.logins[:-64]  # bounded like AgentHandle.unsolicited
         # fixed-token fleets must present the secret to enroll; otherwise
         # login would hand the session token to any caller
-        if self.session_token is not None and body.get("token") != self.session_token:
+        if self.session_token is not None and req.token != self.session_token:
             return web.Response(status=401, text="bad join token")
-        machine_id = body.get("machine_id") or f"m-{uuid.uuid4().hex[:12]}"
+        machine_id = req.machine_id or f"m-{uuid.uuid4().hex[:12]}"
         token = self.session_token or f"tok-{uuid.uuid4().hex}"
         self._issued_tokens[machine_id] = token
+        self._record_machine_info(
+            machine_id, req.machine_info.to_dict() if req.machine_info else {}
+        )
         return web.json_response(
-            {
-                "machine_id": machine_id,
-                "token": token,
-                "machine_proof": f"proof-{machine_id}",
-            }
+            LoginResponse(
+                machine_id=machine_id,
+                token=token,
+                machine_proof=f"proof-{machine_id}",
+            ).to_dict()
         )
 
     async def _session(self, request):  # noqa: ANN001
@@ -307,6 +332,33 @@ class ControlPlane:
             return web.Response(status=401, text="unauthorized")
         return web.json_response({"machines": self.machines()})
 
+    def _record_machine_info(self, machine_id: str, tree: dict) -> None:
+        """Insertion-ordered overwrite with FIFO eviction past the cap —
+        login-derived state stays bounded (same convention as the logins
+        list above)."""
+        with self._lock:
+            self.machine_infos.pop(machine_id, None)  # re-insert = newest
+            self.machine_infos[machine_id] = tree
+            while len(self.machine_infos) > self.machine_infos_max:
+                self.machine_infos.pop(next(iter(self.machine_infos)))
+
+    async def _machine_info_route(self, request):  # noqa: ANN001
+        """The MachineInfo tree recorded at the machine's last login
+        (reference: control plane machine view fed by LoginRequest)."""
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        machine_id = request.match_info["machine_id"]
+        missing = object()
+        with self._lock:  # racing FIFO eviction in _record_machine_info
+            tree = self.machine_infos.get(machine_id, missing)
+        if tree is missing:
+            return web.Response(status=404, text=f"unknown machine {machine_id}")
+        return web.json_response(
+            {"machine_id": machine_id, "machine_info": tree}
+        )
+
     async def _request_route(self, request):  # noqa: ANN001
         from aiohttp import web
 
@@ -337,6 +389,27 @@ class ControlPlane:
             )
         except (TimeoutError, AgentGone) as e:
             return web.Response(status=504, text=str(e))
+        if body["method"] == "gossip" and isinstance(payload, dict) and payload.get("machine_info"):
+            # refresh the recorded tree from the agent's gossip answer,
+            # normalized through the shared wire type. The answer already
+            # reached us successfully — a malformed tree skips the
+            # recording, it must not 500 the response the agent gave
+            from gpud_tpu.api.v1.types import GossipRequest
+
+            try:
+                g = GossipRequest.from_dict(
+                    {"machine_id": machine_id,
+                     "machine_info": payload["machine_info"]}
+                )
+                if g.machine_info is not None:
+                    self._record_machine_info(
+                        machine_id, g.machine_info.to_dict()
+                    )
+            except Exception:  # noqa: BLE001 — agent sent a garbled tree
+                logger.warning(
+                    "unparseable gossip machine_info from %s; not recorded",
+                    machine_id,
+                )
         return web.json_response({"machine_id": machine_id, "response": payload})
 
     async def _drain_route(self, request):  # noqa: ANN001
@@ -371,6 +444,9 @@ class ControlPlane:
         app.router.add_post("/api/v1/login", self._login)
         app.router.add_post("/api/v1/session", self._session)
         app.router.add_get("/v1/machines", self._machines_route)
+        app.router.add_get(
+            "/v1/machines/{machine_id}/machine-info", self._machine_info_route
+        )
         app.router.add_post(
             "/v1/machines/{machine_id}/request", self._request_route
         )

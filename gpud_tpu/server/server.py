@@ -587,6 +587,8 @@ class Server:
                     logger.warning("token fifo unavailable: %s; retrying", e)
                     if self._fifo_stop.wait(1.0):
                         return
+            poller = _select.poll()  # no FD_SETSIZE limit, unlike select()
+            poller.register(fd, _select.POLLIN)
             buf = b""
             try:
                 while not self._fifo_stop.is_set():
@@ -594,9 +596,11 @@ class Server:
                         # a writer sent bytes with no newline (raw
                         # `printf > fifo` rotation). The old EOF-framed
                         # reader accepted those; emulate it: if the
-                        # writer goes quiet, the buffer IS the delivery
-                        ready, _, _ = _select.select([fd], [], [], 1.0)
-                        if not ready:
+                        # writer goes quiet, the buffer IS the delivery.
+                        # (A write arriving inside the window doesn't
+                        # merge either — the read path below frames a
+                        # surviving raw partial before appending.)
+                        if not poller.poll(250):
                             token = buf.decode("utf-8", "replace").strip()
                             buf = b""
                             if token:
@@ -610,6 +614,16 @@ class Server:
                         continue
                     if self._fifo_stop.is_set():
                         return
+                    if buf and b"\n" not in buf and len(buf) < 1024:
+                        # the previous read left a newline-less raw
+                        # delivery (tokens fit one atomic pipe write, so
+                        # a small survivor is complete, not a fragment):
+                        # frame it BEFORE appending, or a tooling write
+                        # arriving in the quiet window would merge with it
+                        token = buf.decode("utf-8", "replace").strip()
+                        buf = b""
+                        if token:
+                            apply(token)
                     buf += chunk
                     if b"\n" not in buf:
                         continue  # partial delivery; newline or quiet next

@@ -1,0 +1,180 @@
+"""Generative wire-contract properties for every API v1 dataclass.
+
+The reference's api/v1 types are its ONE compatibility surface — agent,
+CLI, SDK and control plane all speak them — and its tests roundtrip each
+type through JSON (reference: api/v1/types_test.go). This suite does
+that generatively: seeded randomized instances of every dataclass that
+declares to_dict/from_dict are checked for
+
+- roundtrip stability: from_dict(to_dict(x)).to_dict() == to_dict(x)
+- JSON-encodability of every to_dict (the HTTP layer json.dumps's them)
+- tolerance of unknown keys (a NEWER peer added fields; from_dict must
+  ignore them, not raise — forward wire compat)
+- tolerance of the empty/None payload where from_dict declares it
+- numeric coercion: ints/floats arriving as JSON strings do not crash
+  the numeric fields that declare coercion (int(d.get(...)))
+"""
+
+import dataclasses
+import json
+import random
+import string
+import typing
+
+import pytest
+
+from gpud_tpu.api.v1 import types as T
+
+SEED = 20260729
+ROUNDS = 25
+
+# every dataclass with BOTH to_dict and from_dict participates
+WIRE_TYPES = [
+    obj
+    for obj in vars(T).values()
+    if dataclasses.is_dataclass(obj)
+    and callable(getattr(obj, "to_dict", None))
+    and callable(getattr(obj, "from_dict", None))
+]
+
+
+def _assert_wire_types_discovered():
+    names = {t.__name__ for t in WIRE_TYPES}
+    # the core wire surface must be present — if a rename drops one out
+    # of discovery this suite would silently shrink
+    for expected in (
+        "HealthState", "Event", "Metric", "SuggestedActions",
+        "ComponentHealthStates", "ComponentEvents", "ComponentMetrics",
+        "ComponentInfo", "PackageStatus", "TPUChipInfo", "TPUInfo",
+        "MachineInfo", "LoginRequest", "LoginResponse",
+    ):
+        assert expected in names, f"{expected} lost to_dict/from_dict"
+
+
+_assert_wire_types_discovered()
+
+
+def _rand_str(rng: random.Random) -> str:
+    alphabet = string.ascii_letters + string.digits + " .:/-_%\"'\\"
+    s = "".join(rng.choice(alphabet) for _ in range(rng.randint(0, 24)))
+    if rng.random() < 0.2:
+        s += "µ∆-雪-🙂"  # non-ASCII survives the JSON boundary
+    return s
+
+
+def _value_for(ftype, rng: random.Random, depth: int):
+    origin = typing.get_origin(ftype)
+    args = typing.get_args(ftype)
+    if ftype is str:
+        return _rand_str(rng)
+    if ftype is float:
+        return round(rng.uniform(0, 2_000_000_000), 3)
+    if ftype is int:
+        return rng.randint(0, 10**12)
+    if ftype is bool:
+        return rng.random() < 0.5
+    if origin in (list, typing.List):
+        inner = args[0] if args else str
+        return [
+            _value_for(inner, rng, depth + 1)
+            for _ in range(rng.randint(0, 3))
+        ]
+    if origin in (dict, typing.Dict):
+        kt = args[0] if args else str
+        vt = args[1] if len(args) > 1 else str
+        return {
+            _value_for(kt, rng, depth + 1): _value_for(vt, rng, depth + 1)
+            for _ in range(rng.randint(0, 3))
+        }
+    if origin is typing.Union:  # Optional[X]
+        non_none = [a for a in args if a is not type(None)]
+        if rng.random() < 0.4:
+            return None
+        return _value_for(non_none[0], rng, depth + 1)
+    if dataclasses.is_dataclass(ftype):
+        return _instance(ftype, rng, depth + 1)
+    if ftype is typing.Any:
+        return _rand_str(rng)
+    # unhandled annotation: fall back to the field default by signalling
+    return None
+
+
+def _instance(cls, rng: random.Random, depth: int = 0):
+    if depth > 3:
+        return cls()
+    kwargs = {}
+    hints = typing.get_type_hints(cls)
+    for f in dataclasses.fields(cls):
+        v = _value_for(hints.get(f.name, str), rng, depth)
+        if v is not None:
+            kwargs[f.name] = v
+    return cls(**kwargs)
+
+
+@pytest.mark.parametrize("cls", WIRE_TYPES, ids=lambda c: c.__name__)
+def test_roundtrip_stability(cls):
+    rng = random.Random(SEED + hash(cls.__name__) % 1000)
+    for _ in range(ROUNDS):
+        x = _instance(cls, rng)
+        d1 = x.to_dict()
+        # the HTTP layer serializes this verbatim
+        encoded = json.dumps(d1)
+        back = cls.from_dict(json.loads(encoded))
+        if back is None:
+            # Optional-payload from_dicts return None only for empty input
+            assert not d1 or not any(d1.values()), (cls.__name__, d1)
+            continue
+        d2 = back.to_dict()
+        assert d2 == d1, f"{cls.__name__} roundtrip drift:\n{d1}\n{d2}"
+
+
+@pytest.mark.parametrize("cls", WIRE_TYPES, ids=lambda c: c.__name__)
+def test_unknown_keys_ignored(cls):
+    """A newer peer may add fields; decoding must ignore them (the
+    reference's JSON decoding behavior) rather than raise."""
+    rng = random.Random(SEED)
+    x = _instance(cls, rng)
+    d = x.to_dict()
+    d["__future_field__"] = {"nested": [1, 2, 3]}
+    back = cls.from_dict(d)
+    assert back is not None
+
+
+@pytest.mark.parametrize("cls", WIRE_TYPES, ids=lambda c: c.__name__)
+def test_empty_payload_tolerated(cls):
+    """from_dict({}) must produce a defaulted instance (or None for the
+    Optional-payload decoders) — a minimal peer sends sparse objects."""
+    out = cls.from_dict({})
+    if out is not None:
+        json.dumps(out.to_dict())  # still encodable
+
+
+@pytest.mark.parametrize(
+    "cls", [T.TPUChipInfo, T.TPUInfo, T.Event, T.HealthState, T.Metric],
+    ids=lambda c: c.__name__,
+)
+def test_numeric_fields_coerce_from_strings(cls):
+    """JSON writers in other languages sometimes emit numbers as strings;
+    the numeric fields that declare coercion must accept them."""
+    rng = random.Random(SEED)
+    x = _instance(cls, rng)
+    d = x.to_dict()
+    for k, v in list(d.items()):
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            d[k] = str(v)
+    back = cls.from_dict(d)
+    assert back is not None
+    json.dumps(back.to_dict())
+
+
+def test_health_state_raw_output_truncated_on_the_wire():
+    hs = T.HealthState(raw_output="x" * (T.HealthState.MAX_RAW_OUTPUT + 500))
+    assert len(hs.raw_output) == T.HealthState.MAX_RAW_OUTPUT
+    back = T.HealthState.from_dict(hs.to_dict())
+    assert len(back.raw_output) == T.HealthState.MAX_RAW_OUTPUT
+
+
+def test_event_type_from_string_rejects_unknown():
+    assert T.EventType.from_string("Fatal") == T.EventType.FATAL
+    assert T.EventType.from_string("???") == T.EventType.UNKNOWN
+    assert T.EventType.from_string("") == T.EventType.UNKNOWN

@@ -674,3 +674,119 @@ def test_cli_manager_machines_clean_error_on_401(capsys):
         assert "401" in capsys.readouterr().err
     finally:
         cp.stop()
+
+
+def test_login_records_machine_info_tree(tmp_path):
+    """The manager decodes the agent's LoginRequest through the shared
+    wire type and records the MachineInfo tree, served back on the
+    operator API (reference: control-plane machine view fed by login)."""
+    import requests
+
+    cp = ControlPlane()
+    cp.start()
+    try:
+        body = {
+            "token": "join",
+            "machine_id": "mi-box",
+            "machine_info": {
+                "machine_id": "mi-box",
+                "hostname": "host-1",
+                "os": "Linux",
+                "tpu_info": {
+                    "accelerator_type": "v5p-8",
+                    "chip_count": 4,
+                    "chips": [{"chip_id": 0, "device_path": "/dev/accel0"}],
+                },
+            },
+        }
+        r = requests.post(f"{cp.endpoint}/api/v1/login", json=body, timeout=10)
+        assert r.status_code == 200
+        resp = r.json()
+        assert resp["machine_id"] == "mi-box"
+        assert resp["token"]
+        mi = requests.get(
+            f"{cp.endpoint}/v1/machines/mi-box/machine-info", timeout=10
+        )
+        assert mi.status_code == 200
+        tree = mi.json()["machine_info"]
+        assert tree["hostname"] == "host-1"
+        assert tree["os"] == "Linux"
+        assert tree["tpu_info"]["accelerator_type"] == "v5p-8"
+        assert tree["tpu_info"]["chips"][0]["device_path"] == "/dev/accel0"
+        # unknown machine → 404, not a stack trace
+        missing = requests.get(
+            f"{cp.endpoint}/v1/machines/nope/machine-info", timeout=10
+        )
+        assert missing.status_code == 404
+    finally:
+        cp.stop()
+
+
+def test_gossip_result_refreshes_machine_info(stack):
+    """An operator gossip request whose answer carries machine_info must
+    refresh the manager's recorded tree (normalized through the shared
+    wire type)."""
+    import requests
+
+    cp, _srv = stack
+    r = requests.post(
+        f"{cp.endpoint}/v1/machines/cp-agent-1/request",
+        json={"method": "gossip"},
+        params={"timeout": "15"},
+        timeout=25,
+    )
+    assert r.status_code == 200
+    # gossip computes machine info async; poll until the answer carries it
+    deadline = time.time() + 20
+    tree = None
+    while time.time() < deadline:
+        r = requests.post(
+            f"{cp.endpoint}/v1/machines/cp-agent-1/request",
+            json={"method": "gossip"},
+            params={"timeout": "15"},
+            timeout=25,
+        )
+        if r.json()["response"].get("machine_info"):
+            mi = requests.get(
+                f"{cp.endpoint}/v1/machines/cp-agent-1/machine-info",
+                timeout=10,
+            )
+            if mi.status_code == 200:
+                tree = mi.json()["machine_info"]
+                break
+        time.sleep(0.3)
+    assert tree and tree.get("hostname"), tree
+
+
+def test_machine_infos_bounded_with_fifo_eviction(tmp_path):
+    """Unauthenticated dev-mode logins mint fresh machine ids; the
+    recorded trees must stay bounded (FIFO eviction past the cap)."""
+    import requests
+
+    cp = ControlPlane()
+    cp.start()
+    try:
+        cp.machine_infos_max = 5
+        for i in range(8):
+            r = requests.post(
+                f"{cp.endpoint}/api/v1/login",
+                json={
+                    "token": "x",
+                    "machine_id": f"churn-{i}",
+                    "machine_info": {"hostname": f"h{i}"},
+                },
+                timeout=10,
+            )
+            assert r.status_code == 200
+        assert len(cp.machine_infos) == 5
+        assert "churn-0" not in cp.machine_infos  # oldest evicted
+        assert "churn-7" in cp.machine_infos
+        # evicted machine 404s; survivor serves its tree
+        assert requests.get(
+            f"{cp.endpoint}/v1/machines/churn-0/machine-info", timeout=10
+        ).status_code == 404
+        assert requests.get(
+            f"{cp.endpoint}/v1/machines/churn-7/machine-info", timeout=10
+        ).json()["machine_info"]["hostname"] == "h7"
+    finally:
+        cp.stop()

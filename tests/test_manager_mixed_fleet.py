@@ -1,0 +1,152 @@
+"""Mixed-protocol fleet against one manager.
+
+A real fleet upgrades gradually: v1-only agents (legacy chunked-stream
+transport) and v2-rev2 agents (typed gRPC) coexist on the SAME control
+plane. The manager must serve operator requests to both, keep their
+handles separate, and deliver drain semantics appropriately per
+transport (v2 gets a DrainNotice; v1 streams just close). Reference:
+session v1/v2 coexistence (pkg/session vs pkg/session/v2 — the
+reference agent picks one, the manager must accept both)."""
+
+import time
+
+import pytest
+
+from gpud_tpu.manager.control_plane import ControlPlane
+from gpud_tpu.session.session import Session
+
+
+@pytest.fixture()
+def cp(monkeypatch):
+    grpc = pytest.importorskip("grpc")  # noqa: F841
+    cp = ControlPlane()
+    cp.start()
+    assert cp.grpc_port > 0
+    monkeypatch.setenv("TPUD_SESSION_V2_TARGET", f"127.0.0.1:{cp.grpc_port}")
+    yield cp
+    cp.stop()
+
+
+def _agent(cp, machine_id, protocol):
+    s = Session(
+        endpoint=cp.endpoint,
+        machine_id=machine_id,
+        token="t",
+        machine_proof="p",
+        dispatch_fn=lambda req: {
+            "from": machine_id,
+            "method": req.get("method"),
+        },
+        protocol=protocol,
+    )
+    s.start()
+    return s
+
+
+def _wait_enrolled(cp, *machine_ids, timeout=15):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(m in cp.agents for m in machine_ids):
+            return
+        time.sleep(0.05)
+    raise AssertionError(
+        f"not all of {machine_ids} enrolled; have {sorted(cp.agents)}"
+    )
+
+
+def test_v1_and_v2_agents_coexist_and_answer(cp):
+    v1 = _agent(cp, "legacy-box", "v1")
+    v2 = _agent(cp, "typed-box", "auto")
+    try:
+        _wait_enrolled(cp, "legacy-box", "typed-box")
+        h1, h2 = cp.agent("legacy-box"), cp.agent("typed-box")
+        assert h1.transport == "v1"
+        assert h2.transport == "v2-rev2"
+        # requests route to the right agent over the right transport
+        r1 = h1.request({"method": "states"}, timeout=10)
+        r2 = h2.request({"method": "states"}, timeout=10)
+        assert r1["from"] == "legacy-box"
+        assert r2["from"] == "typed-box"
+        # machine list reports both with their transports
+        listed = {m["machine_id"]: m for m in cp.machines()}
+        assert listed["legacy-box"]["transport"] == "v1"
+        assert listed["typed-box"]["transport"] == "v2-rev2"
+    finally:
+        v1.stop()
+        v2.stop()
+
+
+def test_interleaved_requests_do_not_cross_wires(cp):
+    """Concurrent requests to both transports must come back with the
+    right per-agent payloads — no response cross-delivery between the v1
+    pump and the v2 typed stream."""
+    import concurrent.futures
+
+    v1 = _agent(cp, "ix-v1", "v1")
+    v2 = _agent(cp, "ix-v2", "auto")
+    try:
+        _wait_enrolled(cp, "ix-v1", "ix-v2")
+        h1, h2 = cp.agent("ix-v1"), cp.agent("ix-v2")
+        with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+            futs = []
+            for i in range(12):
+                h = h1 if i % 2 == 0 else h2
+                futs.append(ex.submit(h.request, {"method": f"m{i}"}, 10))
+            for i, f in enumerate(futs):
+                want_from = "ix-v1" if i % 2 == 0 else "ix-v2"
+                got = f.result(timeout=15)
+                assert got == {"from": want_from, "method": f"m{i}"}, (i, got)
+    finally:
+        v1.stop()
+        v2.stop()
+
+
+def test_drain_disconnects_both_transports(cp):
+    """Drain must push every agent off: v2 via DrainNotice, v1 by the
+    stream closing — and both reconnect afterwards."""
+    v1 = _agent(cp, "dr-v1", "v1")
+    v2 = _agent(cp, "dr-v2", "auto")
+    try:
+        _wait_enrolled(cp, "dr-v1", "dr-v2")
+        r1 = v1.reconnect_count
+        r2 = v2.reconnect_count
+        cp.drain("mixed-fleet maintenance")
+        deadline = time.time() + 20
+        while time.time() < deadline and (
+            v1.reconnect_count == r1 or v2.reconnect_count == r2
+        ):
+            time.sleep(0.05)
+        assert v1.reconnect_count > r1, "v1 agent never saw the drain"
+        assert v2.reconnect_count > r2, "v2 agent never saw the drain"
+        # both re-enroll (the manager keeps serving after a drain)
+        _wait_enrolled(cp, "dr-v1", "dr-v2")
+        assert cp.agent("dr-v1").request({"method": "post"}, 10)["from"] == "dr-v1"
+        assert cp.agent("dr-v2").request({"method": "post"}, 10)["from"] == "dr-v2"
+    finally:
+        v1.stop()
+        v2.stop()
+
+
+def test_same_machine_upgrading_transport_replaces_handle(cp):
+    """An agent that upgrades from v1 to v2 (daemon update) re-enrolls
+    under the same machine_id; the newest handle wins and requests flow
+    over the NEW transport."""
+    v1 = _agent(cp, "upgrade-box", "v1")
+    try:
+        _wait_enrolled(cp, "upgrade-box")
+        assert cp.agent("upgrade-box").transport == "v1"
+    finally:
+        v1.stop()
+    v2 = _agent(cp, "upgrade-box", "auto")
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            h = cp.agents.get("upgrade-box")
+            if h is not None and h.transport == "v2-rev2":
+                break
+            time.sleep(0.05)
+        h = cp.agent("upgrade-box")
+        assert h.transport == "v2-rev2"
+        assert h.request({"method": "states"}, 10)["from"] == "upgrade-box"
+    finally:
+        v2.stop()

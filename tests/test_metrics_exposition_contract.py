@@ -1,0 +1,193 @@
+"""Prometheus text-exposition contract, validated by an in-test parser.
+
+Existing tests assert specific escapes; this suite implements the actual
+exposition-format grammar (the consumer's view — what a Prometheus
+scraper does) and runs randomized registry content through it: every
+emitted line must parse, every labelset must roundtrip to the exact
+value that was set, HELP/TYPE metadata must precede samples, and the
+hostile cases (quotes, backslashes, newlines, unicode, +/-Inf, NaN)
+must survive the full render→parse cycle. Reference: the reference
+daemon exposes the same format and its scrape integration is its main
+fleet interface (pkg/metrics + /metrics handler)."""
+
+import math
+import random
+import re
+import string
+
+import pytest
+
+from gpud_tpu.metrics.registry import Registry
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)$"
+)
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    out = []
+    i = 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse_exposition(text: str):
+    """Parse per the text format; raises AssertionError on any violation.
+    Returns {(name, frozenset(labels.items())): float_value}."""
+    samples = {}
+    seen_meta = {}
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# HELP "):
+            parts = ln.split(" ", 3)
+            assert len(parts) >= 3, ln
+            seen_meta.setdefault(parts[2], set()).add("help")
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split(" ", 4)
+            assert len(parts) >= 4, ln
+            assert parts[3] in ("gauge", "counter", "histogram", "summary",
+                                "untyped"), ln
+            seen_meta.setdefault(parts[2], set()).add("type")
+            continue
+        assert not ln.startswith("#"), f"unknown comment line: {ln!r}"
+        m = _SAMPLE.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        name = m.group("name")
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            consumed = 0
+            for lm in _LABEL.finditer(raw):
+                labels[lm.group(1)] = _unescape(lm.group(2))
+                consumed = lm.end()
+            rest = raw[consumed:].strip(", ")
+            assert not rest, f"unparsed label residue {rest!r} in {ln!r}"
+        vs = m.group("value")
+        if vs == "+Inf":
+            value = math.inf
+        elif vs == "-Inf":
+            value = -math.inf
+        elif vs == "NaN":
+            value = math.nan
+        else:
+            value = float(vs)  # raises on malformed output
+        key = (name, frozenset(labels.items()))
+        assert key not in samples, f"duplicate sample {key}"
+        samples[key] = value
+        # metadata must precede the first sample of its family
+        family = name[:-6] if name.endswith("_total") else name
+        assert family in seen_meta or name in seen_meta, (
+            f"sample {name} before its HELP/TYPE"
+        )
+    return samples
+
+
+HOSTILE_STRINGS = [
+    'quote"inside',
+    "back\\slash",
+    "new\nline",
+    "tab\tchar",
+    "unicode-雪-µ",
+    "trailing-space ",
+    "",
+    "a" * 200,
+    '{"json": "looking"}',
+    "comma,equals=brace}",
+]
+
+
+def test_randomized_registry_roundtrips_through_parser():
+    rng = random.Random(20260729)
+    r = Registry()
+    expected = {}
+    for i in range(40):
+        name = "rt_" + "".join(
+            rng.choice(string.ascii_lowercase) for _ in range(8)
+        ) + f"_{i}"
+        g = r.gauge(name, f"help {i}")
+        for _ in range(rng.randint(1, 4)):
+            labels = {
+                "l" + str(j): rng.choice(HOSTILE_STRINGS)
+                for j in range(rng.randint(0, 3))
+            }
+            value = rng.choice(
+                [rng.uniform(-1e12, 1e12), 0.0, math.inf, -math.inf]
+            )
+            g.set(value, labels)
+            expected[(name, frozenset(labels.items()))] = value
+    samples = parse_exposition(r.render_prometheus())
+    for key, want in expected.items():
+        assert key in samples, f"labelset lost in exposition: {key}"
+        got = samples[key]
+        assert got == pytest.approx(want) or (
+            math.isinf(want) and got == want
+        ), (key, want, got)
+
+
+def test_nan_survives_as_nan_token():
+    r = Registry()
+    r.gauge("nan_metric", "h").set(math.nan, {"x": "y"})
+    samples = parse_exposition(r.render_prometheus())
+    (value,) = [
+        v for (n, _), v in samples.items() if n == "nan_metric"
+    ]
+    assert math.isnan(value)
+
+
+def test_counter_families_render_as_counters():
+    r = Registry()
+    c = r.counter("ops_total", "operations")
+    c.inc(labels={"op": "scan"})
+    c.inc(labels={"op": "scan"})
+    text = r.render_prometheus()
+    samples = parse_exposition(text)
+    assert samples[("ops_total", frozenset({("op", "scan")}.__iter__()))] == 2.0
+    assert "# TYPE ops_total counter" in text
+
+
+def test_live_daemon_exposition_parses(tmp_path):
+    """The real /metrics endpoint — the full default registry with every
+    component's gauges — must satisfy the same grammar a scraper
+    enforces."""
+    import urllib.request
+
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    kmsg = tmp_path / "kmsg"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp_path / "data"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        components_disabled=["network-latency"],
+        endpoint="",
+        token="",
+    )
+    s = Server(config=cfg)
+    try:
+        s.start()
+        with urllib.request.urlopen(
+            f"{s.base_url()}/metrics", timeout=10
+        ) as resp:
+            body = resp.read().decode("utf-8")
+        samples = parse_exposition(body)
+        assert any(n.startswith("tpud_") for n, _ in samples), (
+            "no daemon self-metrics exposed"
+        )
+    finally:
+        s.stop()

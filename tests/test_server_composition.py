@@ -571,3 +571,48 @@ def test_fifo_raw_write_without_newline_still_applies(tmp_path):
         assert s.metadata.get(md.KEY_TOKEN) == "raw-noeol-T"
     finally:
         s.stop()
+
+
+def test_fifo_raw_write_followed_by_tooling_write_not_merged(tmp_path):
+    """A raw newline-less write chased immediately by a write_token call
+    must yield TWO separate rotations (raw framed at the read boundary,
+    tooling token applied after) — never one merged corrupt token."""
+    import os
+
+    from gpud_tpu import metadata as md
+
+    cfg = _cfg(tmp_path)
+    s = Server(config=cfg)
+    try:
+        s.start()
+        deadline = time.time() + 10
+        sent = False
+        while time.time() < deadline and not sent:
+            try:
+                fd = os.open(cfg.fifo_file(), os.O_WRONLY | os.O_NONBLOCK)
+                try:
+                    os.write(fd, b"rawtokA")  # no newline
+                finally:
+                    os.close(fd)
+                sent = True
+            except OSError:
+                time.sleep(0.05)
+        assert sent
+        # chase it INSIDE the 250ms quiet window but in a separate read:
+        # the short sleep lets the watcher consume the raw chunk first.
+        # (A same-instant chase coalesces into one chunk — byte pipes
+        # carry no writer boundaries; the old EOF reader merged that case
+        # identically.)
+        time.sleep(0.1)
+        assert Server.write_token("toolB", cfg.fifo_file()) is None
+        deadline = time.time() + 10
+        while (
+            time.time() < deadline
+            and s.metadata.get(md.KEY_TOKEN) != "toolB"
+        ):
+            time.sleep(0.05)
+        tok = s.metadata.get(md.KEY_TOKEN)
+        assert tok == "toolB", tok  # latest wins
+        assert "rawtokA" not in tok and "\n" not in tok  # never merged
+    finally:
+        s.stop()
