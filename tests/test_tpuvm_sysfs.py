@@ -43,6 +43,7 @@ def _backend(name: str, **kw) -> SysfsBackend:
         ("v4-8", 4, "v4", "0x005e", "accel"),
         ("v5e-8", 8, "v5e", "0x0063", "vfio-pci"),
         ("v5p-8", 4, "v5p", "0x0062", "vfio-pci"),
+        ("v6e-8", 8, "v6e", "0x006f", "vfio-pci"),
     ],
 )
 def test_enumerates_stock_tree(fixture, n_chips, generation, device_id, driver):
@@ -84,6 +85,7 @@ def test_accelerator_type_inferred_from_pci_only():
     assert _backend("v4-8").accelerator_type() == "v4-8"      # 4 chips x 2 cores
     assert _backend("v5e-8").accelerator_type() == "v5e-8"    # suffix counts chips
     assert _backend("v5p-8").accelerator_type() == "v5p-8"
+    assert _backend("v6e-8").accelerator_type() == "v6e-8"  # Trillium: suffix counts chips
 
 
 def test_explicit_accelerator_type_wins():
@@ -97,7 +99,7 @@ def test_explicit_accelerator_type_wins():
 
 @pytest.mark.parametrize(
     "fixture,n_chips,links_per_chip",
-    [("v4-8", 4, 6), ("v5e-8", 8, 4), ("v5p-8", 4, 6)],
+    [("v4-8", 4, 6), ("v5e-8", 8, 4), ("v5p-8", 4, 6), ("v6e-8", 8, 4)],
 )
 def test_derived_ici_links_on_stock_tree(fixture, n_chips, links_per_chip):
     b = _backend(fixture)
