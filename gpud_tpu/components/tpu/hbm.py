@@ -17,7 +17,7 @@ from gpud_tpu.api.v1.types import (
     SuggestedActions,
 )
 from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
-from gpud_tpu.components.tpu.shared import sampler_for
+from gpud_tpu.components.tpu.shared import sampler_for, telemetry_source
 from gpud_tpu.metrics.registry import gauge
 
 NAME = "accelerator-tpu-hbm"
@@ -58,7 +58,7 @@ class TPUHbmComponent(PollingComponent):
             )
         tel = self.sampler.telemetry()
         ecc_pending = []
-        extra = {}
+        extra = {"telemetry_source": telemetry_source(self.tpu)}
         for cid, t in sorted(tel.items()):
             labels = {"component": NAME, "chip": str(cid)}
             _g_used.set(t.hbm_used_bytes, labels)

@@ -14,7 +14,7 @@ import time
 
 from gpud_tpu.api.v1.types import HealthStateType
 from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
-from gpud_tpu.components.tpu.shared import sampler_for
+from gpud_tpu.components.tpu.shared import sampler_for, telemetry_source
 from gpud_tpu.metrics.registry import gauge
 
 NAME = "accelerator-tpu-power"
@@ -67,7 +67,7 @@ class TPUPowerComponent(PollingComponent):
         tel = self.sampler.telemetry()
         now = self.time_now_fn()
         total_w = 0.0
-        extra = {}
+        extra = {"telemetry_source": telemetry_source(self.tpu)}
         with self._hist_mu:
             # prune chips gone from telemetry: hours-old samples from a
             # reset chip must not blend into its average when it returns

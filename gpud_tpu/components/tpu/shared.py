@@ -45,6 +45,16 @@ class TelemetrySampler:
             return list(self._links)
 
 
+def telemetry_source(instance: Optional[TPUInstance]) -> str:
+    """Measurement-vs-inventory label for check extra_info (VERDICT r3
+    #6): operators must be able to tell gRPC-measured telemetry
+    ("runtime-metrics") from CLI parses ("cli") or fixtures ("mock")."""
+    if instance is None:
+        return ""
+    src = getattr(instance, "telemetry_source", None)
+    return src() if callable(src) else ""
+
+
 _samplers_mu = threading.Lock()
 
 

@@ -13,7 +13,7 @@ from gpud_tpu.api.v1.types import (
     SuggestedActions,
 )
 from gpud_tpu.components.base import CheckResult, PollingComponent, TpudInstance
-from gpud_tpu.components.tpu.shared import sampler_for
+from gpud_tpu.components.tpu.shared import sampler_for, telemetry_source
 from gpud_tpu.metrics.registry import gauge
 
 NAME = "accelerator-tpu-temperature"
@@ -54,7 +54,7 @@ class TPUTemperatureComponent(PollingComponent):
         tel = self.sampler.telemetry()
         worst = -1.0
         slowdown_chips = []
-        extra = {}
+        extra = {"telemetry_source": telemetry_source(self.tpu)}
         for cid, t in sorted(tel.items()):
             labels = {"component": NAME, "chip": str(cid)}
             _g_temp.set(t.temperature_c, labels)

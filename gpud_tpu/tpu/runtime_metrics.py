@@ -1,0 +1,579 @@
+"""Side-band client for libtpu's runtime gRPC metrics service.
+
+This is the true NVML analog for TPU VMs (reference boundary:
+pkg/nvidia/nvml/lib/lib.go:11-16, pkg/nvidia/nvml/instance.go:43-97 — an
+always-on side-band library API with no exec and no device ownership).
+On a TPU VM, libtpu runs a gRPC server (default ``localhost:8431``,
+``TPU_RUNTIME_METRICS_PORTS`` when several runtime processes each serve
+their own port) exposing ``tpu.monitoring.runtime.RuntimeMetricService``
+— the same endpoint the public ``tpu-info`` CLI consumes. Talking to it
+directly gives per-poll, per-chip telemetry (HBM used/total, tensorcore
+duty cycle, …) without a subprocess fork+parse and without opening
+libtpu (which is exclusive).
+
+Wire handling follows the repo's CRI pattern (gpud_tpu/cri.py): gRPC
+framing from grpcio with identity serializers, protobuf payloads via the
+small hand codec. Message shapes follow the public tpu-info proto
+(tpu_metric_service.proto: MetricRequest{metric_name=1} →
+MetricResponse{metric=1 TPUMetric{name=1, description=2, metrics=3
+repeated Metric{attribute=1 Attribute{key=1, value=2 AttrValue oneof},
+gauge=2 Gauge oneof}}}). Because oneof field numbers have drifted across
+libtpu versions, the *decoder* keys off the wire type instead of exact
+field numbers: a varint in a Gauge is the int value, a fixed64 is the
+double value, length-delimited is a string — so the client stays correct
+even if the runtime reorders the oneof arms.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from gpud_tpu.cri import (
+    _read_varint as _cri_read_varint,
+    encode_field_bytes,
+    encode_field_str,
+    encode_field_varint,
+    encode_varint,
+    parse_message,
+)
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+SERVICE = "tpu.monitoring.runtime.RuntimeMetricService"
+DEFAULT_PORT = 8431
+DEFAULT_TIMEOUT = 2.0
+
+# libtpu's own env naming the serving port(s); tpud's override wins
+ENV_LIBTPU_PORTS = "TPU_RUNTIME_METRICS_PORTS"
+ENV_ADDR = "TPUD_RUNTIME_METRICS_ADDR"   # host:port[,host:port...]
+ENV_DISABLE = "TPUD_RUNTIME_METRICS"     # "0"/"false" disables the probe
+
+# Metric names served by current libtpu (the tpu-info core set)
+METRIC_HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+METRIC_HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
+METRIC_DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
+# Served by some runtime versions; consumed only when ListSupportedMetrics
+# advertises them (capability-gated, SURVEY §7 "metric APIs vary by
+# runtime version → isolate behind tpu.Instance with capability flags")
+METRIC_TENSORCORE_UTIL = "tpu.runtime.tensorcore.utilization.percent"
+METRIC_HBM_ECC_UNCORRECTABLE = "tpu.runtime.uncorrectable.hbm.memory.errors.count"
+CORE_METRICS = (METRIC_HBM_TOTAL, METRIC_HBM_USAGE, METRIC_DUTY_CYCLE)
+OPTIONAL_METRICS = (METRIC_TENSORCORE_UTIL, METRIC_HBM_ECC_UNCORRECTABLE)
+
+# Optional ICI per-link counters. No public libtpu version serves these
+# today; the names define the convention a runtime (or node agent proxy)
+# can export so fabric telemetry rides the same side-band channel.
+# Attributes: device-id (chip), link-id.
+ICI_METRIC_PREFIX = "tpu.runtime.ici."
+ICI_METRICS = {
+    "tpu.runtime.ici.link.state": "state",          # 1 up / 0 down
+    "tpu.runtime.ici.link.tx.bytes": "tx_bytes",
+    "tpu.runtime.ici.link.rx.bytes": "rx_bytes",
+    "tpu.runtime.ici.link.tx.errors": "tx_errors",
+    "tpu.runtime.ici.link.rx.errors": "rx_errors",
+    "tpu.runtime.ici.link.crc.errors": "crc_errors",
+    "tpu.runtime.ici.link.replays": "replays",
+}
+
+
+class RuntimeMetricsError(Exception):
+    """Transport or decode failure against the runtime metrics service."""
+
+
+@dataclass
+class MetricSample:
+    """One (attributes, value) row of a runtime metric."""
+
+    value: float = 0.0
+    is_int: bool = False
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+    @property
+    def device_id(self) -> int:
+        """The per-chip key: the first integer attribute (tpu-info reads
+        ``metric.attribute.value.int_attr`` the same way); -1 if none."""
+        for k in ("device-id", "device_id", "chip-id", "chip_id"):
+            v = self.attrs.get(k)
+            if isinstance(v, int):
+                return v
+        for v in self.attrs.values():
+            if isinstance(v, int):
+                return v
+        return -1
+
+    @property
+    def link_id(self) -> int:
+        for k in ("link-id", "link_id", "port-id", "port_id"):
+            v = self.attrs.get(k)
+            if isinstance(v, int):
+                return v
+        return -1
+
+
+# ---------------------------------------------------------------------------
+# payload encode (requests + the test fake's responses)
+# ---------------------------------------------------------------------------
+
+def encode_field_double(fnum: int, v: float) -> bytes:
+    return encode_varint(fnum << 3 | 1) + struct.pack("<d", v)
+
+
+def encode_field_int64(fnum: int, v: int) -> bytes:
+    """Like encode_field_varint but proto3-int64-correct for negatives
+    (two's complement 64-bit; a raw negative would loop forever in the
+    shift-based varint encoder)."""
+    return encode_field_varint(fnum, v & 0xFFFFFFFFFFFFFFFF)
+
+
+def encode_metric_request(metric_name: str) -> bytes:
+    return encode_field_str(1, metric_name)
+
+
+def encode_attr_value(v: object) -> bytes:
+    # public proto oneof: string_attr=1, bool_attr=2, int_attr=3, double_attr=4
+    if isinstance(v, bool):
+        return encode_field_varint(2, 1 if v else 0)
+    if isinstance(v, int):
+        return encode_field_int64(3, v)
+    if isinstance(v, float):
+        return encode_field_double(4, v)
+    return encode_field_str(1, str(v))
+
+
+def encode_metric(attrs: Dict[str, object], value, *,
+                  gauge_int_field: int = 2, gauge_double_field: int = 1) -> bytes:
+    """One Metric message: attribute=1, gauge=2 (oneof: as_double=1,
+    as_int=2 per the public proto; overridable so tests can model a
+    runtime that renumbered the oneof — the decoder must not care)."""
+    body = b""
+    for k, v in attrs.items():
+        attr = encode_field_str(1, k) + encode_field_bytes(2, encode_attr_value(v))
+        body += encode_field_bytes(1, attr)
+    if isinstance(value, bool) or isinstance(value, int):
+        gauge = encode_field_int64(gauge_int_field, int(value))
+    else:
+        gauge = encode_field_double(gauge_double_field, float(value))
+    body += encode_field_bytes(2, gauge)
+    return body
+
+
+def encode_metric_response(name: str, samples: List[Tuple[Dict[str, object], object]],
+                           **metric_kw) -> bytes:
+    tpu_metric = encode_field_str(1, name)
+    for attrs, value in samples:
+        tpu_metric += encode_field_bytes(3, encode_metric(attrs, value, **metric_kw))
+    return encode_field_bytes(1, tpu_metric)
+
+
+def encode_list_supported_response(names: List[str]) -> bytes:
+    out = b""
+    for n in names:
+        out += encode_field_bytes(1, encode_field_str(1, n))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# payload decode (wire-type driven, field-number tolerant)
+# ---------------------------------------------------------------------------
+
+def _decode_scalar_oneof(data: bytes) -> Tuple[object, bool]:
+    """Decode a one-armed scalar message (AttrValue) by wire type: varint
+    → int, fixed64 → double, bytes → utf-8 str. Returns (value, is_int).
+    Empty message → (0.0, False)."""
+    i = 0
+    while i < len(data):
+        key, i = _read_varint(data, i)
+        wire = key & 0x7
+        if wire == 0:
+            v, i = _read_varint(data, i)
+            return _zigzag_passthrough(v), True
+        if wire == 1:
+            if i + 8 > len(data):
+                raise RuntimeMetricsError("truncated attr fixed64")
+            return struct.unpack_from("<d", data, i)[0], False
+        if wire == 2:
+            ln, i = _read_varint(data, i)
+            return data[i:i + ln].decode("utf-8", "replace"), False
+        if wire == 5:
+            i += 4
+        else:
+            raise RuntimeMetricsError(f"unsupported attr wire type {wire}")
+    return 0.0, False
+
+
+def decode_metric(data: bytes) -> MetricSample:
+    fields = parse_message(data)
+    sample = MetricSample()
+    for raw in fields.get(1, []):           # attribute
+        if not isinstance(raw, bytes):
+            continue
+        attr = parse_message(raw)
+        key_raw = attr.get(1, [b""])[0]
+        key = key_raw.decode("utf-8", "replace") if isinstance(key_raw, bytes) else ""
+        val_raw = attr.get(2, [b""])[0]
+        if isinstance(val_raw, bytes):
+            v, _ = _decode_scalar_oneof(val_raw)
+            sample.attrs[key] = v
+    gauge_raw = fields.get(2, [b""])[0]     # gauge
+    if isinstance(gauge_raw, bytes) and gauge_raw:
+        # parse_message can't distinguish a varint int64 from a fixed64
+        # double (both come back as Python ints), so the gauge is decoded
+        # straight off the wire types instead
+        sample.value, sample.is_int = _decode_gauge(gauge_raw)
+    return sample
+
+
+def _decode_gauge(data: bytes) -> Tuple[float, bool]:
+    """Wire-type-driven gauge decode: varint arm → int, fixed64 arm →
+    IEEE-754 double, regardless of which oneof field number carried it."""
+    i = 0
+    while i < len(data):
+        key, i = _read_varint(data, i)
+        wire = key & 0x7
+        if wire == 0:
+            v, i = _read_varint(data, i)
+            return float(_zigzag_passthrough(v)), True
+        if wire == 1:
+            if i + 8 > len(data):
+                raise RuntimeMetricsError("truncated gauge fixed64")
+            return struct.unpack_from("<d", data, i)[0], False
+        if wire == 2:
+            ln, i = _read_varint(data, i)
+            raw = data[i:i + ln]
+            i += ln
+            try:
+                return float(raw.decode("ascii")), False
+            except (UnicodeDecodeError, ValueError):
+                continue
+        elif wire == 5:
+            i += 4
+        else:
+            raise RuntimeMetricsError(f"unsupported gauge wire type {wire}")
+    return 0.0, False
+
+
+def _zigzag_passthrough(v: int) -> int:
+    # proto3 int64 gauges are plain varints (two's complement); interpret
+    # huge positives as negatives like protobuf does
+    if v >= 1 << 63:
+        v -= 1 << 64
+    return v
+
+
+def _read_varint(data: bytes, i: int) -> Tuple[int, int]:
+    try:
+        return _cri_read_varint(data, i)
+    except ValueError as e:
+        raise RuntimeMetricsError(str(e)) from e
+
+
+def decode_metric_response(data: bytes) -> List[MetricSample]:
+    resp = parse_message(data)
+    metric_raw = resp.get(1, [b""])[0]
+    if not isinstance(metric_raw, bytes) or not metric_raw:
+        return []
+    tpu_metric = parse_message(metric_raw)
+    out: List[MetricSample] = []
+    for m in tpu_metric.get(3, []):
+        if isinstance(m, bytes):
+            out.append(decode_metric(m))
+    return out
+
+
+def decode_list_supported_response(data: bytes) -> List[str]:
+    resp = parse_message(data)
+    names: List[str] = []
+    for raw in resp.get(1, []):
+        if not isinstance(raw, bytes):
+            continue
+        f = parse_message(raw)
+        v = f.get(1, [b""])[0]
+        if isinstance(v, bytes) and v:
+            names.append(v.decode("utf-8", "replace"))
+    return names
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class RuntimeMetricsClient:
+    """One gRPC channel per serving port; results merged by device id
+    (each runtime process serves metrics for the chips it owns)."""
+
+    def __init__(self, addrs: Optional[List[str]] = None,
+                 timeout: float = DEFAULT_TIMEOUT) -> None:
+        self.addrs = addrs or resolve_addrs()
+        self.timeout = timeout
+        self._channels: Dict[str, object] = {}
+
+    def _chan(self, addr: str):
+        ch = self._channels.get(addr)
+        if ch is None:
+            import grpc
+
+            ch = grpc.insecure_channel(addr)
+            self._channels[addr] = ch
+        return ch
+
+    def close(self) -> None:
+        for ch in self._channels.values():
+            ch.close()
+        self._channels.clear()
+
+    def _call(self, addr: str, method: str, request: bytes) -> bytes:
+        import grpc
+
+        fn = self._chan(addr).unary_unary(
+            f"/{SERVICE}/{method}",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+        try:
+            return fn(request, timeout=self.timeout)
+        except grpc.RpcError as e:
+            raise RuntimeMetricsError(
+                f"{method}@{addr}: {e.code().name}: {e.details()}"
+            ) from e
+
+    def list_supported(self) -> List[str]:
+        """Union of supported metric names across serving ports; raises
+        only if *no* port answers."""
+        names: List[str] = []
+        seen = set()
+        last_err: Optional[Exception] = None
+        answered = False
+        for addr in self.addrs:
+            try:
+                got = decode_list_supported_response(
+                    self._call(addr, "ListSupportedMetrics", b"")
+                )
+                answered = True
+            except RuntimeMetricsError as e:
+                last_err = e
+                continue
+            for n in got:
+                if n not in seen:
+                    seen.add(n)
+                    names.append(n)
+        if not answered:
+            raise last_err or RuntimeMetricsError("no metrics port configured")
+        return names
+
+    def get_metric(self, name: str) -> List[MetricSample]:
+        """Samples merged across ports; a port that errors contributes
+        nothing (the others' chips still report — one hung runtime process
+        must not blind telemetry for the whole host)."""
+        out: List[MetricSample] = []
+        errs = 0
+        for addr in self.addrs:
+            try:
+                out.extend(decode_metric_response(
+                    self._call(addr, "GetRuntimeMetric", encode_metric_request(name))
+                ))
+            except RuntimeMetricsError as e:
+                errs += 1
+                logger.debug("runtime metric %s: %s", name, e)
+        if errs and errs == len(self.addrs):
+            raise RuntimeMetricsError(f"{name}: all {errs} metrics ports failed")
+        return out
+
+
+def resolve_addrs() -> List[str]:
+    """Serving addresses: tpud override → libtpu's ports env → default."""
+    override = os.environ.get(ENV_ADDR, "").strip()
+    if override:
+        return [a if ":" in a else f"localhost:{a}" for a in override.split(",") if a]
+    ports = os.environ.get(ENV_LIBTPU_PORTS, "").strip()
+    if ports:
+        out = []
+        for p in ports.split(","):
+            p = p.strip()
+            if p.isdigit():
+                out.append(f"localhost:{p}")
+        if out:
+            return out
+    return [f"localhost:{DEFAULT_PORT}"]
+
+
+def runtime_metrics_enabled() -> bool:
+    return os.environ.get(ENV_DISABLE, "").lower() not in ("0", "false", "no")
+
+
+# ---------------------------------------------------------------------------
+# backend
+# ---------------------------------------------------------------------------
+
+class RuntimeMetricsBackend:
+    """TPUInstance backend: sysfs enumeration + runtime-service telemetry.
+
+    Chip inventory, PCI facts and driver-binding liveness stay with the
+    wrapped side-band backend (SysfsBackend — the runtime service names
+    devices but knows nothing about PCI health); telemetry rides the gRPC
+    service. This mirrors the reference's split where device *identity*
+    comes from PCI (pkg/nvidia/pci) while *telemetry* comes from the
+    side-band library (pkg/nvidia/nvml/instance.go:43-97).
+
+    Capability set is whatever ``ListSupportedMetrics`` advertises at
+    construction; each capability degrades independently (SURVEY §7).
+    """
+
+    def __init__(self, inner, client: Optional[RuntimeMetricsClient] = None,
+                 probe_timeout: float = 1.0) -> None:
+        self.inner = inner
+        self.client = client or RuntimeMetricsClient(timeout=probe_timeout)
+        self._supported: List[str] = []
+        self._probe_error = ""
+        try:
+            self._supported = self.client.list_supported()
+        except RuntimeMetricsError as e:
+            self._probe_error = str(e)
+
+    def available(self) -> bool:
+        """True when the service answered and serves at least the HBM or
+        duty-cycle core metrics — an empty capability set means this
+        runtime gives us nothing the CLI/sysfs paths don't."""
+        return any(m in self._supported for m in CORE_METRICS)
+
+    def probe_error(self) -> str:
+        return self._probe_error
+
+    def supported_metrics(self) -> List[str]:
+        return list(self._supported)
+
+    # -- delegation to the enumeration backend -----------------------------
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+    def shutdown(self) -> None:
+        self.client.close()
+        self.inner.shutdown()
+
+    def is_mock(self) -> bool:
+        return self.inner.is_mock()
+
+    def telemetry_supported(self) -> bool:
+        return self.available()
+
+    def telemetry_source(self) -> str:
+        return "runtime-metrics"
+
+    def telemetry(self):
+        from gpud_tpu.tpu.instance import TPUChipTelemetry
+
+        chips = self.inner.devices()
+        out: Dict[int, TPUChipTelemetry] = {
+            cid: TPUChipTelemetry(chip_id=cid, hbm_total_bytes=c.hbm_total_bytes)
+            for cid, c in chips.items()
+        }
+
+        def apply(metric_name: str, setter, fold: str = "sum") -> None:
+            if metric_name not in self._supported:
+                return
+            try:
+                samples = self.client.get_metric(metric_name)
+            except RuntimeMetricsError as e:
+                logger.warning("runtime metric %s failed: %s", metric_name, e)
+                return
+            for cid, value in _fold_to_chips(samples, sorted(out), fold).items():
+                setter(out[cid], value)
+
+        # HBM bytes/error counts sum across a chip's cores; percent
+        # metrics take the max core (a chip is as busy as its busiest
+        # core; summing would read 200%)
+        apply(METRIC_HBM_USAGE,
+              lambda t, v: setattr(t, "hbm_used_bytes", int(v)))
+        apply(METRIC_HBM_TOTAL,
+              lambda t, v: setattr(t, "hbm_total_bytes", int(v)))
+        apply(METRIC_DUTY_CYCLE,
+              lambda t, v: setattr(t, "duty_cycle_pct", float(v)), fold="max")
+        apply(METRIC_TENSORCORE_UTIL,
+              lambda t, v: setattr(t, "tensorcore_util_pct", float(v)), fold="max")
+
+        def set_ecc(t, v) -> None:
+            t.hbm_ecc_uncorrectable = int(v)
+            if int(v) > 0:
+                t.hbm_ecc_pending = True
+        apply(METRIC_HBM_ECC_UNCORRECTABLE, set_ecc)
+        return out
+
+    # -- ICI: runtime counters when advertised, else inner's sysfs/derived -
+    def _ici_metric_names(self) -> List[str]:
+        return [n for n in self._supported if n in ICI_METRICS]
+
+    def ici_source(self) -> str:
+        if self._ici_metric_names():
+            return "runtime-metrics"
+        src = getattr(self.inner, "ici_source", None)
+        return src() if callable(src) else ""
+
+    def ici_supported(self) -> bool:
+        return bool(self._ici_metric_names()) or self.inner.ici_supported()
+
+    def ici_links(self):
+        from gpud_tpu.tpu.instance import ICILinkSnapshot, LinkState
+
+        names = self._ici_metric_names()
+        if not names:
+            return self.inner.ici_links()
+        links: Dict[Tuple[int, int], ICILinkSnapshot] = {}
+        for name in names:
+            attr = ICI_METRICS[name]
+            try:
+                samples = self.client.get_metric(name)
+            except RuntimeMetricsError as e:
+                logger.warning("runtime ICI metric %s failed: %s", name, e)
+                continue
+            for s in samples:
+                cid, lid = s.device_id, s.link_id
+                if cid < 0 or lid < 0:
+                    continue
+                snap = links.setdefault(
+                    (cid, lid), ICILinkSnapshot(chip_id=cid, link_id=lid)
+                )
+                if attr == "state":
+                    snap.state = LinkState.UP if s.value else LinkState.DOWN
+                else:
+                    setattr(snap, attr, int(s.value))
+        return [links[k] for k in sorted(links)]
+
+
+def _fold_to_chips(samples: List[MetricSample], chip_ids: List[int],
+                   fold: str = "sum") -> Dict[int, float]:
+    """Map per-device samples onto chip ids.
+
+    Direct id match when the runtime's device ids are the chip ids; rank
+    order when counts line up but ids are shifted (global-vs-local
+    numbering on multi-host slices); an even per-core fold otherwise
+    (v2/v3 report per TensorCore: 2 cores/chip), combining core values
+    per ``fold`` — "sum" for bytes/counts, "max" for percents."""
+    combine = max if fold == "max" else (lambda a, b: a + b)
+    by_dev: Dict[int, float] = {}
+    for s in samples:
+        d = s.device_id
+        if d < 0:
+            continue
+        by_dev[d] = combine(by_dev[d], s.value) if d in by_dev else s.value
+    if not by_dev or not chip_ids:
+        return {}
+    dev_ids = sorted(by_dev)
+    if set(dev_ids) <= set(chip_ids):
+        return {d: by_dev[d] for d in dev_ids}
+    if len(dev_ids) == len(chip_ids):
+        return {c: by_dev[d] for d, c in zip(dev_ids, chip_ids)}
+    if len(dev_ids) % len(chip_ids) == 0:
+        per = len(dev_ids) // len(chip_ids)
+        out: Dict[int, float] = {}
+        for i, cid in enumerate(chip_ids):
+            group = dev_ids[i * per:(i + 1) * per]
+            vals = [by_dev[d] for d in group]
+            out[cid] = max(vals) if fold == "max" else sum(vals)
+        return out
+    logger.warning(
+        "runtime metrics device ids %s don't map onto chips %s", dev_ids, chip_ids
+    )
+    return {}

@@ -177,6 +177,9 @@ class TpuInfoBackend(SysfsICILinksMixin, TPUInstance):
     def telemetry_supported(self) -> bool:
         return bool(self._chips)
 
+    def telemetry_source(self) -> str:
+        return "cli"
+
     def telemetry(self) -> Dict[int, TPUChipTelemetry]:
         try:
             r = self.run_fn([], timeout=TELEMETRY_TIMEOUT)

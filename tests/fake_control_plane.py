@@ -135,9 +135,9 @@ class FakeControlPlane:
         app = web.Application()
         app.router.add_post("/api/v1/login", self._login)
         app.router.add_post("/api/v1/session", self._session)
+        runner = web.AppRunner(app)
 
         async def go():
-            runner = web.AppRunner(app)
             await runner.setup()
             site = web.TCPSite(runner, "127.0.0.1", self.port)
             await site.start()
@@ -145,14 +145,38 @@ class FakeControlPlane:
                 self.port = s.getsockname()[1]
             self._started.set()
 
-        loop.run_until_complete(go())
-        loop.run_forever()
+        try:
+            loop.run_until_complete(go())
+            loop.run_forever()
+        finally:
+            # Tear down in-loop so no aiohttp object outlives its loop
+            # (otherwise GC-time __del__ raises "Event loop is closed").
+            try:
+                loop.run_until_complete(runner.cleanup())
+                loop.run_until_complete(loop.shutdown_asyncgens())
+            except Exception:  # noqa: BLE001
+                pass
+            loop.close()
 
     def stop(self) -> None:
         if self._loop is not None and self._loop.is_running():
+            # End open read-stream handlers first: they park on q.get(),
+            # and runner.cleanup() would otherwise wait out its shutdown
+            # timeout on them (leaving the loop thread alive for a minute)
+            async def _drain() -> None:
+                for q in self.sessions.values():
+                    q.put_nowait(None)
+                self.sessions.clear()
+
+            try:
+                asyncio.run_coroutine_threadsafe(_drain(), self._loop).result(
+                    timeout=2
+                )
+            except Exception:  # noqa: BLE001 — loop may be stopping already
+                pass
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
-            self._thread.join(timeout=3)
+            self._thread.join(timeout=5)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,122 @@
+"""A fake libtpu runtime-metrics gRPC server for tests.
+
+Serves ``tpu.monitoring.runtime.RuntimeMetricService`` the way libtpu
+does on a TPU VM (the endpoint tpu-info consumes), from an in-memory
+per-device value table the test mutates. Mirrors the reference's
+mock-injection seam for the NVML library boundary
+(pkg/nvidia/nvml/lib/lib.go:11-16).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional, Tuple
+
+import grpc
+
+from gpud_tpu.tpu import runtime_metrics as rtm
+
+
+class FakeRuntimeMetricsServer:
+    """``values``: metric name → list of (attrs dict, value). Ints encode
+    as Gauge.as_int varints, floats as Gauge.as_double fixed64s —
+    matching the public proto layout (overridable per-server to model a
+    runtime that renumbered the oneof arms)."""
+
+    def __init__(
+        self,
+        values: Optional[Dict[str, List[Tuple[Dict[str, object], object]]]] = None,
+        supported: Optional[List[str]] = None,
+        port: int = 0,
+        gauge_int_field: int = 2,
+        gauge_double_field: int = 1,
+    ) -> None:
+        self._mu = threading.Lock()
+        self.values = values or {}
+        self._supported = supported
+        self.gauge_int_field = gauge_int_field
+        self.gauge_double_field = gauge_double_field
+        self.calls: List[str] = []          # RPC log for assertions
+        self.fail_next: int = 0             # fail this many RPCs with UNAVAILABLE
+        self._server = grpc.server(futures.ThreadPoolExecutor(max_workers=4))
+        handler = grpc.method_handlers_generic_handler(
+            rtm.SERVICE,
+            {
+                "ListSupportedMetrics": grpc.unary_unary_rpc_method_handler(
+                    self._list_supported,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                ),
+                "GetRuntimeMetric": grpc.unary_unary_rpc_method_handler(
+                    self._get_metric,
+                    request_deserializer=lambda b: b,
+                    response_serializer=lambda b: b,
+                ),
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)
+
+    def set_values(self, values: Dict[str, List[Tuple[Dict[str, object], object]]]) -> None:
+        with self._mu:
+            self.values = values
+
+    # -- handlers ----------------------------------------------------------
+    def _maybe_fail(self, context) -> bool:
+        with self._mu:
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                context.abort(grpc.StatusCode.UNAVAILABLE, "injected failure")
+        return False
+
+    def _list_supported(self, request: bytes, context) -> bytes:
+        self.calls.append("ListSupportedMetrics")
+        self._maybe_fail(context)
+        with self._mu:
+            names = (
+                self._supported
+                if self._supported is not None
+                else sorted(self.values)
+            )
+            return rtm.encode_list_supported_response(list(names))
+
+    def _get_metric(self, request: bytes, context) -> bytes:
+        name = rtm.parse_message(request).get(1, [b""])[0]
+        name = name.decode("utf-8") if isinstance(name, bytes) else ""
+        self.calls.append(f"GetRuntimeMetric:{name}")
+        self._maybe_fail(context)
+        with self._mu:
+            samples = self.values.get(name, [])
+            return rtm.encode_metric_response(
+                name,
+                samples,
+                gauge_int_field=self.gauge_int_field,
+                gauge_double_field=self.gauge_double_field,
+            )
+
+
+def hbm_table(per_chip: Dict[int, Tuple[int, int, float]],
+              id_key: str = "device-id") -> Dict[str, List]:
+    """Convenience: {chip: (used, total, duty_pct)} → the values table."""
+    return {
+        rtm.METRIC_HBM_USAGE: [
+            ({id_key: cid}, used) for cid, (used, _t, _d) in per_chip.items()
+        ],
+        rtm.METRIC_HBM_TOTAL: [
+            ({id_key: cid}, total) for cid, (_u, total, _d) in per_chip.items()
+        ],
+        rtm.METRIC_DUTY_CYCLE: [
+            ({id_key: cid}, duty) for cid, (_u, _t, duty) in per_chip.items()
+        ],
+    }

@@ -29,20 +29,25 @@ def stack(tmp_path_factory):
     )
     srv = Server(config=cfg)
     srv.start()
+    # Await enrollment here so every test in the module is self-contained:
+    # each can assume the read stream is up regardless of run order/subset.
+    if not cp.connected.wait(15):
+        srv.stop()
+        cp.stop()
+        raise RuntimeError("daemon never opened the session read stream")
     yield cp, srv
     srv.stop()
     cp.stop()
 
 
 def test_session_connects(stack):
+    # enrollment itself is guaranteed by the fixture; assert the artifact
     cp, srv = stack
-    assert cp.connected.wait(10), "daemon never opened the read stream"
     assert "e2e-machine" in cp.sessions
 
 
 def test_states_over_session(stack):
     cp, srv = stack
-    cp.connected.wait(10)
     cp.send_request("e2e-machine", "q1", {"method": "states"})
     resp = cp.wait_response("q1")
     assert resp is not None, "no response on the write stream"
@@ -52,7 +57,6 @@ def test_states_over_session(stack):
 
 def test_inject_and_detect_over_session(stack):
     cp, srv = stack
-    cp.connected.wait(10)
     cp.send_request(
         "e2e-machine", "q2",
         {"method": "injectFault", "tpu_error_name": "tpu_ici_cable_fault", "chip_id": 0},
@@ -90,7 +94,6 @@ def test_set_healthy_over_session(stack):
 
 def test_diagnostic_over_session(stack):
     cp, srv = stack
-    cp.connected.wait(10)
     deadline = time.time() + 8
     while time.time() < deadline:
         rid = f"qd{int(time.time() * 1000)}"
@@ -148,7 +151,6 @@ def test_hostile_manager_frames_do_not_break_session(stack):
     wrong-shape frames, an oversized frame — must be dropped; a valid
     request afterwards is still answered (the serve loop survived)."""
     cp, srv = stack
-    cp.connected.wait(10)
     mid = "e2e-machine"
     cp.send_raw(mid, b"this is not json at all\n")
     cp.send_raw(mid, b"{\"req_id\": 42, \"data\": \"not-a-dict\"}\n")
