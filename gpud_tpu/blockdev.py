@@ -53,22 +53,63 @@ def _unescape_mount(s: str) -> str:
     return _OCTAL_ESCAPE.sub(lambda m: chr(int(m.group(1), 8)), s)
 
 
-def read_mounts(proc_mounts: str = "") -> Dict[str, Tuple[str, str]]:
-    """device path → (mount_point, fstype) from /proc/self/mounts.
-    First mount of a device wins (matches lsblk's MOUNTPOINT)."""
-    path = proc_mounts or "/proc/self/mounts"
-    out: Dict[str, Tuple[str, str]] = {}
+class MountEntry:
+    """One /proc/mounts row (octal escapes expanded)."""
+
+    __slots__ = ("device", "mount_point", "fstype", "options")
+
+    def __init__(self, device: str, mount_point: str, fstype: str,
+                 options: List[str]) -> None:
+        self.device = device
+        self.mount_point = mount_point
+        self.fstype = fstype
+        self.options = options
+
+
+def read_mount_table(
+    proc_mounts: str = "", host_root: Optional[str] = None
+) -> List[MountEntry]:
+    """All /dev/*-backed rows of the mount table, options included.
+
+    ``host_root`` (default: the TPUD_HOST_ROOT env; pass "" to suppress)
+    redirects to the host's table in containerized deployments — the
+    container's own /proc/self/mounts shows an overlay root, not the
+    node's disks."""
+    if host_root is None:
+        host_root = os.environ.get(ENV_HOST_ROOT, "")
+    path = proc_mounts or (
+        os.path.join(host_root, "proc", "mounts")
+        if host_root
+        else "/proc/self/mounts"
+    )
+    out: List[MountEntry] = []
     try:
         with open(path, "r", encoding="utf-8", errors="replace") as f:
             for line in f:
                 parts = line.split()
-                if len(parts) < 3 or not parts[0].startswith("/dev/"):
+                if len(parts) < 4 or not parts[0].startswith("/dev/"):
                     continue
-                dev = os.path.basename(parts[0])
-                if dev not in out:
-                    out[dev] = (_unescape_mount(parts[1]), parts[2])
+                out.append(MountEntry(
+                    device=parts[0],
+                    mount_point=_unescape_mount(parts[1]),
+                    fstype=parts[2],
+                    options=parts[3].split(","),
+                ))
     except OSError:
         pass
+    return out
+
+
+def read_mounts(proc_mounts: str = "") -> Dict[str, Tuple[str, str]]:
+    """device path → (mount_point, fstype) from /proc/self/mounts.
+    First mount of a device wins (matches lsblk's MOUNTPOINT)."""
+    out: Dict[str, Tuple[str, str]] = {}
+    # host_root="": callers (read_block_tree) already resolved any host
+    # prefix into proc_mounts — applying the env again would double it
+    for e in read_mount_table(proc_mounts, host_root=""):
+        dev = os.path.basename(e.device)
+        if dev not in out:
+            out[dev] = (e.mount_point, e.fstype)
     return out
 
 
@@ -168,7 +209,9 @@ def detect_containerized(host_root: str = "/") -> bool:
 
 
 __all__ = [
+    "MountEntry",
     "read_block_tree",
+    "read_mount_table",
     "read_mounts",
     "detect_containerized",
     "ENV_HOST_ROOT",

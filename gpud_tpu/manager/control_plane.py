@@ -332,10 +332,28 @@ class ControlPlane:
             return web.Response(status=401, text="unauthorized")
         return web.json_response({"machines": self.machines()})
 
+    # per-entry serialized-size cap: dev mode accepts unauthenticated
+    # logins, so without it a caller could pin machine_infos_max ×
+    # multi-MB trees in memory (entry *count* alone doesn't bound memory)
+    MACHINE_INFO_MAX_BYTES = 256 * 1024
+
     def _record_machine_info(self, machine_id: str, tree: dict) -> None:
         """Insertion-ordered overwrite with FIFO eviction past the cap —
         login-derived state stays bounded (same convention as the logins
-        list above)."""
+        list above). Oversized trees are dropped, not truncated: a
+        partial tree would present as authoritative machine state."""
+        try:
+            size = len(json.dumps(tree))
+        except (TypeError, ValueError):
+            logger.warning("unserializable machine_info from %s; not recorded",
+                           machine_id)
+            return
+        if size > self.MACHINE_INFO_MAX_BYTES:
+            logger.warning(
+                "machine_info from %s is %d bytes (cap %d); not recorded",
+                machine_id, size, self.MACHINE_INFO_MAX_BYTES,
+            )
+            return
         with self._lock:
             self.machine_infos.pop(machine_id, None)  # re-insert = newest
             self.machine_infos[machine_id] = tree

@@ -189,11 +189,13 @@ class Server:
 
     def _wire_kmsg_syncers(self) -> None:
         from gpud_tpu.components.cpu import match_cpu_lockup
+        from gpud_tpu.components.disk import match_disk_error
         from gpud_tpu.components.memory import match_oom
         from gpud_tpu.components.os_comp import match_kernel_panic
 
         for comp_name, match_fn in (
             ("cpu", match_cpu_lockup),
+            ("disk", match_disk_error),
             ("memory", match_oom),
             ("os", match_kernel_panic),
         ):
@@ -600,7 +602,23 @@ class Server:
                         # (A write arriving inside the window doesn't
                         # merge either — the read path below frames a
                         # surviving raw partial before appending.)
-                        if not poller.poll(250):
+                        # 1s quiet window: a writer pausing mid-token
+                        # >250ms could get its token torn in two; real
+                        # tokens arrive in one atomic pipe write, so the
+                        # longer window only delays the raw-printf path.
+                        if not poller.poll(1000):
+                            if len(buf) >= 1024:
+                                # same bound as the pre-append framing
+                                # below: a kilobyte+ newline-less blob is
+                                # not a credential token — persisting it
+                                # would evict a valid stored credential
+                                logger.warning(
+                                    "discarding %d-byte newline-less fifo "
+                                    "delivery (exceeds token bound)",
+                                    len(buf),
+                                )
+                                buf = b""
+                                continue
                             token = buf.decode("utf-8", "replace").strip()
                             buf = b""
                             if token:
@@ -614,16 +632,26 @@ class Server:
                         continue
                     if self._fifo_stop.is_set():
                         return
-                    if buf and b"\n" not in buf and len(buf) < 1024:
+                    if buf and b"\n" not in buf:
                         # the previous read left a newline-less raw
                         # delivery (tokens fit one atomic pipe write, so
                         # a small survivor is complete, not a fragment):
                         # frame it BEFORE appending, or a tooling write
-                        # arriving in the quiet window would merge with it
-                        token = buf.decode("utf-8", "replace").strip()
+                        # arriving in the quiet window would merge with
+                        # it. An over-bound survivor is garbage — discard
+                        # it here too, or it would merge with this chunk
+                        # and ride through the split below as one huge
+                        # "delivery" (bypassing the quiet-window bound).
+                        if len(buf) < 1024:
+                            token = buf.decode("utf-8", "replace").strip()
+                            if token:
+                                apply(token)
+                        else:
+                            logger.warning(
+                                "discarding %d-byte newline-less fifo "
+                                "delivery (exceeds token bound)", len(buf),
+                            )
                         buf = b""
-                        if token:
-                            apply(token)
                     buf += chunk
                     if b"\n" not in buf:
                         continue  # partial delivery; newline or quiet next
@@ -632,12 +660,20 @@ class Server:
                     # read; each newline-delimited line is a separate
                     # delivery and the LATEST rotation wins — joining them
                     # would persist a corrupt multi-line token that then
-                    # rides an Authorization header
-                    deliveries = [
-                        ln.decode("utf-8", "replace").strip()
-                        for ln in lines
-                    ]
-                    deliveries = [d for d in deliveries if d]
+                    # rides an Authorization header. The same 1024-byte
+                    # token bound applies per line: a newline-terminated
+                    # blob must not become the credential either.
+                    deliveries = []
+                    for ln in lines:
+                        if len(ln) >= 1024:
+                            logger.warning(
+                                "discarding %d-byte fifo line (exceeds "
+                                "token bound)", len(ln),
+                            )
+                            continue
+                        d = ln.decode("utf-8", "replace").strip()
+                        if d:
+                            deliveries.append(d)
                     if deliveries:
                         apply(deliveries[-1])
             finally:

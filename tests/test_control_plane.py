@@ -790,3 +790,30 @@ def test_machine_infos_bounded_with_fifo_eviction(tmp_path):
         ).json()["machine_info"]["hostname"] == "h7"
     finally:
         cp.stop()
+
+
+def test_oversized_machine_info_not_recorded(tmp_path):
+    """Dev mode accepts unauthenticated logins, so a multi-megabyte
+    machine_info tree must not be pinned in manager memory: entries over
+    the per-entry byte cap are dropped (login still succeeds)."""
+    import requests
+
+    cp = ControlPlane()
+    cp.start()
+    try:
+        # oversize a *known* wire field — unknown keys are stripped by the
+        # LoginRequest wire type before the manager ever sees them
+        big = {"machine_id": "fat-box",
+               "hostname": "h" * (ControlPlane.MACHINE_INFO_MAX_BYTES + 1024)}
+        r = requests.post(
+            f"{cp.endpoint}/api/v1/login",
+            json={"token": "join", "machine_id": "fat-box", "machine_info": big},
+            timeout=10,
+        )
+        assert r.status_code == 200  # enrollment itself unaffected
+        mi = requests.get(
+            f"{cp.endpoint}/v1/machines/fat-box/machine-info", timeout=10
+        )
+        assert mi.status_code == 404  # tree not recorded
+    finally:
+        cp.stop()

@@ -616,3 +616,127 @@ def test_fifo_raw_write_followed_by_tooling_write_not_merged(tmp_path):
         assert "rawtokA" not in tok and "\n" not in tok  # never merged
     finally:
         s.stop()
+
+
+def test_fifo_oversized_raw_write_discarded_not_applied(tmp_path):
+    """A kilobyte+ newline-less blob is not a credential token: the
+    quiet-window framing must discard it (same 1024-byte bound as the
+    pre-append framing) instead of persisting it as the credential —
+    and a real rotation afterwards still applies."""
+    import os
+
+    from gpud_tpu import metadata as md
+
+    cfg = _cfg(tmp_path)
+    s = Server(config=cfg)
+    try:
+        s.start()
+        blob = b"x" * 2048  # no newline
+        deadline = time.time() + 10
+        sent = False
+        while time.time() < deadline and not sent:
+            try:
+                fd = os.open(cfg.fifo_file(), os.O_WRONLY | os.O_NONBLOCK)
+                try:
+                    os.write(fd, blob)
+                finally:
+                    os.close(fd)
+                sent = True
+            except OSError:
+                time.sleep(0.05)
+        assert sent
+        # wait out the quiet window; blob must NOT become the token
+        time.sleep(1.5)
+        assert s.metadata.get(md.KEY_TOKEN) != blob.decode()
+        # the watcher is still alive and a real rotation applies
+        assert Server.write_token("after-blob-T", cfg.fifo_file()) is None
+        deadline = time.time() + 10
+        while (
+            time.time() < deadline
+            and s.metadata.get(md.KEY_TOKEN) != "after-blob-T"
+        ):
+            time.sleep(0.1)
+        assert s.metadata.get(md.KEY_TOKEN) == "after-blob-T"
+    finally:
+        s.stop()
+
+
+def test_fifo_oversized_blob_chased_by_rotation_not_merged(tmp_path):
+    """An oversized newline-less blob chased by a real write_token INSIDE
+    the quiet window must not merge into one giant credential: the blob
+    is discarded at the pre-append framing and the real token applies."""
+    import os
+
+    from gpud_tpu import metadata as md
+
+    cfg = _cfg(tmp_path)
+    s = Server(config=cfg)
+    try:
+        s.start()
+        blob = b"z" * 2048  # no newline
+        deadline = time.time() + 10
+        sent = False
+        while time.time() < deadline and not sent:
+            try:
+                fd = os.open(cfg.fifo_file(), os.O_WRONLY | os.O_NONBLOCK)
+                try:
+                    os.write(fd, blob)
+                finally:
+                    os.close(fd)
+                sent = True
+            except OSError:
+                time.sleep(0.05)
+        assert sent
+        time.sleep(0.1)  # inside the 1s quiet window, separate read
+        assert Server.write_token("chase-T", cfg.fifo_file()) is None
+        deadline = time.time() + 10
+        while (
+            time.time() < deadline
+            and s.metadata.get(md.KEY_TOKEN) != "chase-T"
+        ):
+            time.sleep(0.05)
+        tok = s.metadata.get(md.KEY_TOKEN)
+        assert tok == "chase-T", (len(tok or ""), (tok or "")[:40])
+        assert "z" not in tok
+    finally:
+        s.stop()
+
+
+def test_fifo_oversized_newline_terminated_blob_discarded(tmp_path):
+    """A >=1KB line WITH a trailing newline is bounded too — the per-line
+    bound in the split path, not just the quiet-window one."""
+    import os
+
+    from gpud_tpu import metadata as md
+
+    cfg = _cfg(tmp_path)
+    s = Server(config=cfg)
+    try:
+        s.start()
+        deadline = time.time() + 10
+        sent = False
+        while time.time() < deadline and not sent:
+            try:
+                fd = os.open(cfg.fifo_file(), os.O_WRONLY | os.O_NONBLOCK)
+                try:
+                    os.write(fd, b"w" * 2000 + b"\n")
+                finally:
+                    os.close(fd)
+                sent = True
+            except OSError:
+                time.sleep(0.05)
+        assert sent
+        time.sleep(0.5)
+        tok = s.metadata.get(md.KEY_TOKEN)
+        assert tok is None or "w" not in tok
+        # watcher alive: real rotation still lands
+        assert Server.write_token("post-blob-T", cfg.fifo_file()) is None
+        deadline = time.time() + 10
+        while (
+            time.time() < deadline
+            and s.metadata.get(md.KEY_TOKEN) != "post-blob-T"
+        ):
+            time.sleep(0.05)
+        assert s.metadata.get(md.KEY_TOKEN) == "post-blob-T"
+    finally:
+        s.stop()
