@@ -388,8 +388,9 @@ def cmd_release(args) -> int:
 
 
 def cmd_update(args) -> int:
-    """Reference: cmd/gpud update(+check) — here: set/inspect the
-    target-version file the watcher acts on."""
+    """Reference: cmd/gpud update(+check) — set/inspect the target-version
+    file the watcher acts on, or (``--install``) run the built-in
+    download→verify→install pipeline synchronously (update.go:19-50)."""
     from gpud_tpu.update import read_target_version, write_target_version
 
     cfg = _build_config(args)
@@ -401,6 +402,21 @@ def cmd_update(args) -> int:
     if not args.target_version:
         print("error: --target-version required (or --check)", file=sys.stderr)
         return 1
+    if args.install:
+        from gpud_tpu.update_install import perform_update
+
+        err = perform_update(
+            args.target_version,
+            base_url=args.base_url,
+            install_dir=args.install_dir,
+            signing_pub=args.signing_pub,
+            root_pub=args.root_pub,
+        )
+        if err:
+            print(f"FAIL: {err}", file=sys.stderr)
+            return 1
+        print(f"installed {args.target_version}")
+        return 0
     write_target_version(path, args.target_version)
     print(f"target version set to {args.target_version}; "
           "the running daemon restarts within 30s")
@@ -668,6 +684,12 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common_flags(pup)
     pup.add_argument("--check", action="store_true")
     pup.add_argument("--target-version", default="")
+    pup.add_argument("--install", action="store_true",
+                     help="download, verify, and install --target-version now")
+    pup.add_argument("--base-url", default="")
+    pup.add_argument("--install-dir", default="")
+    pup.add_argument("--signing-pub", default="")
+    pup.add_argument("--root-pub", default="")
     pup.set_defaults(fn=cmd_update, audited=True)
 
     pcp = sub.add_parser("custom-plugins", help="validate a plugin specs file")

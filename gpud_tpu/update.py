@@ -5,8 +5,9 @@ Reference: pkg/update — ``UpdateTargetVersion`` watches a version file
 when the target differs from the running version the daemon exits with a
 dedicated code so systemd/DaemonSet restarts it into the new binary. The
 binary-download path (update.go:19-50, pkg.gpud.dev tarballs + ed25519
-verification — see gpud_tpu/release/distsign.py) is gated behind an
-installer hook since this build ships as a Python package.
+verification) is the built-in pipeline in gpud_tpu/update_install.py
+(download → distsign verify → atomic install); ``TPUD_UPDATE_HOOK``
+remains an operator override for bespoke installs.
 """
 
 from __future__ import annotations
@@ -51,10 +52,19 @@ class VersionFileWatcher:
         current_version: str = __version__,
         on_update: Optional[Callable[[str], None]] = None,
         interval: float = POLL_INTERVAL,
+        installer: Optional[Callable[[str], Optional[str]]] = None,
     ) -> None:
         self.path = path
         self.current_version = current_version
         self.on_update = on_update or self._default_on_update
+        # built-in install pipeline (update_install.perform_update); when
+        # None and no hook is set the watcher warns-and-stays
+        if installer is None:
+            from gpud_tpu.update_install import installer_from_env
+
+            installer = installer_from_env()
+        self.installer = installer
+        self._exit: Callable[[int], None] = os._exit  # injectable for tests
         # env override so lifecycle e2e tests don't wait the 30s cadence;
         # clamped (a zero would busy-spin the loop) and logged so it can't
         # silently shadow an explicit interval in production
@@ -73,36 +83,48 @@ class VersionFileWatcher:
         self._thread: Optional[threading.Thread] = None
 
     def _default_on_update(self, target: str) -> None:
-        """Install via the update hook, then restart-exit. Without a hook
-        (or on hook failure) we must NOT exit: the restarted process would
-        still be the old version and see the same mismatch — a permanent
-        30-second crash loop on every node the update was pushed to."""
+        """Install (hook override, else the built-in pipeline), then
+        restart-exit. On install failure — or with nothing configured —
+        we must NOT exit: the restarted process would still be the old
+        version and see the same mismatch — a permanent 30-second crash
+        loop on every node the update was pushed to."""
         hook = os.environ.get(ENV_UPDATE_HOOK, "")
-        if not hook:
+        if hook:
+            from gpud_tpu.process import run_command
+
+            r = run_command(
+                ["bash", hook], timeout=15 * 60.0, env={"TARGET_VERSION": target}
+            )
+            if r.exit_code != 0:
+                logger.error(
+                    "update hook failed (exit %d): %s", r.exit_code, r.output[-500:]
+                )
+                return
+            logger.warning("update hook installed %s", target)
+        elif self.installer is not None:
+            err = self.installer(target)
+            if err:
+                logger.error(
+                    "built-in update to %s failed: %s; staying on %s",
+                    target, err, self.current_version,
+                )
+                return
+        else:
             if not getattr(self, "_warned_no_hook", False):
                 logger.warning(
-                    "target version %s != running %s but %s is not set; "
-                    "staying on the current version",
-                    target, self.current_version, ENV_UPDATE_HOOK,
+                    "target version %s != running %s but no update hook or "
+                    "built-in pipeline is configured; staying on the "
+                    "current version",
+                    target, self.current_version,
                 )
                 self._warned_no_hook = True
             return
-        from gpud_tpu.process import run_command
-
-        r = run_command(
-            ["bash", hook], timeout=15 * 60.0, env={"TARGET_VERSION": target}
-        )
-        if r.exit_code != 0:
-            logger.error(
-                "update hook failed (exit %d): %s", r.exit_code, r.output[-500:]
-            )
-            return
         logger.warning(
-            "update hook installed %s; exiting %d for supervisor restart",
+            "installed %s; exiting %d for supervisor restart",
             target, EXIT_CODE_UPDATE,
         )
         audit("self_update_exit", target=target, current=self.current_version)
-        os._exit(EXIT_CODE_UPDATE)  # noqa: SLF001 — immediate, like the reference
+        self._exit(EXIT_CODE_UPDATE)  # noqa: SLF001 — immediate, like the reference
 
     def check_once(self) -> bool:
         """Returns True if an update was triggered."""

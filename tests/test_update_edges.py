@@ -74,8 +74,9 @@ def test_no_hook_never_exits_and_warns_once(tmp_path, monkeypatch, caplog):
         assert w.check_once() is True  # triggered, but stayed alive
         w.check_once()
         w.check_once()
-    warns = [r for r in caplog.records if "is not set" in r.getMessage()]
-    assert len(warns) <= 1
+    warns = [r for r in caplog.records
+             if "staying on the current version" in r.getMessage()]
+    assert len(warns) == 1
 
 
 def test_hook_failure_stays_alive(tmp_path, monkeypatch):

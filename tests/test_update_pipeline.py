@@ -1,0 +1,279 @@
+"""Built-in self-update pipeline: download → distsign verify → atomic
+install → restart-exit (reference: pkg/update/update.go:19-50).
+
+A local HTTP package server (stdlib http.server on a loopback port) plays
+pkg.gpud.dev; packages are real tar.gz files signed with the distsign
+ed25519 chain. Covers: happy path (pinned signing key and root-key chain),
+tampered package/endorsement rejection, unreachable server, hostile
+tarballs, symlink swap across upgrades, and the watcher's crash-loop
+guard (failure never restart-exits)."""
+
+import http.server
+import io
+import os
+import tarfile
+import threading
+
+import pytest
+
+from gpud_tpu.release import distsign
+from gpud_tpu.update import EXIT_CODE_UPDATE, VersionFileWatcher, write_target_version
+from gpud_tpu.update_install import (
+    ENV_BASE_URL,
+    ENV_INSTALL_DIR,
+    ENV_SIGNING_PUB,
+    installer_from_env,
+    perform_update,
+)
+
+
+# -- helpers ------------------------------------------------------------------
+
+def make_package(dirpath, version, files=None):
+    """Build tpud-<version>.tar.gz in dirpath; returns its path."""
+    files = files or {"bin/tpud": "#!/bin/sh\necho " + version + "\n",
+                      "VERSION": version + "\n"}
+    pkg = os.path.join(str(dirpath), f"tpud-{version}.tar.gz")
+    with tarfile.open(pkg, "w:gz") as tf:
+        for name, content in files.items():
+            data = content.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            info.mode = 0o755 if name.startswith("bin/") else 0o644
+            tf.addfile(info, io.BytesIO(data))
+    return pkg
+
+
+@pytest.fixture
+def pkg_server(tmp_path):
+    """(serve_dir, base_url, signing_key, signing_pub, root_pub) with the
+    signing key endorsed by a root key and chain files published."""
+    serve = tmp_path / "serve"
+    serve.mkdir()
+    keys = tmp_path / "keys"
+    root_key, root_pub = distsign.write_keypair(str(keys), "root")
+    sign_key, sign_pub = distsign.write_keypair(str(keys), "signing")
+    # publish the signing key + its root endorsement next to the packages
+    pub_payload = open(sign_pub, "rb").read()
+    with open(serve / "signing.pub", "wb") as f:
+        f.write(pub_payload)
+    distsign.sign_key(root_key, str(serve / "signing.pub"),
+                      str(serve / "signing.pub.rootsig"))
+
+    handler = lambda *a, **kw: http.server.SimpleHTTPRequestHandler(  # noqa: E731
+        *a, directory=str(serve), **kw)
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    yield serve, base, sign_key, sign_pub, root_pub
+    httpd.shutdown()
+    t.join(timeout=5)
+
+
+def publish(serve, version, sign_key, files=None):
+    pkg = make_package(serve, version, files)
+    distsign.sign_package(sign_key, pkg)
+    return pkg
+
+
+# -- pipeline unit/integration ------------------------------------------------
+
+def test_install_happy_path_pinned_signing_key(pkg_server, tmp_path):
+    serve, base, sign_key, sign_pub, _root = pkg_server
+    publish(serve, "2.0.0", sign_key)
+    inst = tmp_path / "install"
+    err = perform_update("2.0.0", base_url=base, install_dir=str(inst),
+                         signing_pub=sign_pub)
+    assert err is None
+    assert (inst / "versions" / "2.0.0" / "VERSION").read_text() == "2.0.0\n"
+    cur = inst / "current"
+    assert cur.is_symlink() and os.readlink(cur) == os.path.join("versions", "2.0.0")
+    assert (cur / "bin" / "tpud").exists()
+
+
+def test_install_happy_path_root_key_chain(pkg_server, tmp_path):
+    """Only the ROOT public key is pinned locally; the signing key is
+    fetched from the server and must carry a valid root endorsement."""
+    serve, base, sign_key, _sign_pub, root_pub = pkg_server
+    publish(serve, "2.1.0", sign_key)
+    inst = tmp_path / "install"
+    err = perform_update("2.1.0", base_url=base, install_dir=str(inst),
+                         root_pub=root_pub)
+    assert err is None
+    assert (inst / "versions" / "2.1.0").is_dir()
+
+
+def test_unendorsed_signing_key_rejected(pkg_server, tmp_path):
+    """A rogue signing key (not endorsed by root) must fail the chain."""
+    serve, base, _sign_key, _sign_pub, root_pub = pkg_server
+    rogue_key, rogue_pub = distsign.write_keypair(str(tmp_path / "rogue"), "rogue")
+    # attacker swaps the published signing key but cannot forge the rootsig
+    with open(serve / "signing.pub", "wb") as f:
+        f.write(open(rogue_pub, "rb").read())
+    publish(serve, "6.6.6", rogue_key)
+    inst = tmp_path / "install"
+    err = perform_update("6.6.6", base_url=base, install_dir=str(inst),
+                         root_pub=root_pub)
+    assert err is not None and "endorsed" in err
+    assert not (inst / "versions").exists()
+
+
+def test_tampered_package_rejected_and_nothing_installed(pkg_server, tmp_path):
+    serve, base, sign_key, sign_pub, _root = pkg_server
+    pkg = publish(serve, "3.0.0", sign_key)
+    with open(pkg, "ab") as f:
+        f.write(b"\x00evil")
+    inst = tmp_path / "install"
+    err = perform_update("3.0.0", base_url=base, install_dir=str(inst),
+                         signing_pub=sign_pub)
+    assert err is not None and "signature" in err
+    assert not (inst / "versions").exists()
+    assert not (inst / "current").exists()
+
+
+def test_missing_package_on_server(pkg_server, tmp_path):
+    _serve, base, _k, sign_pub, _root = pkg_server
+    err = perform_update("9.9.9", base_url=base,
+                         install_dir=str(tmp_path / "i"), signing_pub=sign_pub)
+    assert err is not None and "download failed" in err
+
+
+def test_unreachable_server(tmp_path):
+    _key, pub = distsign.write_keypair(str(tmp_path), "s")
+    err = perform_update("1.0", base_url="http://127.0.0.1:1",
+                         install_dir=str(tmp_path / "i"), signing_pub=pub)
+    assert err is not None and "download failed" in err
+
+
+def test_path_traversal_package_rejected(pkg_server, tmp_path):
+    """A signed-but-hostile tarball must still not escape the staging dir
+    (signing proves provenance, not safety of a compromised builder)."""
+    serve, base, sign_key, sign_pub, _root = pkg_server
+    publish(serve, "4.0.0", sign_key, files={"../evil": "pwned\n"})
+    inst = tmp_path / "install"
+    err = perform_update("4.0.0", base_url=base, install_dir=str(inst),
+                         signing_pub=sign_pub)
+    assert err is not None and "unsafe" in err
+    assert not (tmp_path / "evil").exists()
+    assert not (inst / "versions").exists()
+
+
+def test_escaping_symlink_member_rejected(pkg_server, tmp_path):
+    serve, base, sign_key, sign_pub, _root = pkg_server
+    pkg = os.path.join(str(serve), "tpud-5.0.0.tar.gz")
+    with tarfile.open(pkg, "w:gz") as tf:
+        info = tarfile.TarInfo("etc")
+        info.type = tarfile.SYMTYPE
+        info.linkname = "/etc"
+        tf.addfile(info)
+    distsign.sign_package(sign_key, pkg)
+    err = perform_update("5.0.0", base_url=base,
+                         install_dir=str(tmp_path / "i"), signing_pub=sign_pub)
+    assert err is not None and "unsafe link" in err
+
+
+def test_invalid_target_version_strings(tmp_path):
+    _key, pub = distsign.write_keypair(str(tmp_path), "s")
+    for bad in ("", "../1.0", "a/b", ".hidden"):
+        err = perform_update(bad, base_url="http://127.0.0.1:1",
+                             install_dir=str(tmp_path / "i"), signing_pub=pub)
+        assert err is not None and "download" not in err
+
+
+def test_upgrade_swaps_current_symlink(pkg_server, tmp_path):
+    serve, base, sign_key, sign_pub, _root = pkg_server
+    inst = tmp_path / "install"
+    publish(serve, "1.0", sign_key)
+    publish(serve, "2.0", sign_key)
+    assert perform_update("1.0", base_url=base, install_dir=str(inst),
+                          signing_pub=sign_pub) is None
+    assert perform_update("2.0", base_url=base, install_dir=str(inst),
+                          signing_pub=sign_pub) is None
+    assert os.readlink(inst / "current") == os.path.join("versions", "2.0")
+    # both versions retained for rollback
+    assert (inst / "versions" / "1.0").is_dir()
+    # rollback = installing the old version again
+    assert perform_update("1.0", base_url=base, install_dir=str(inst),
+                          signing_pub=sign_pub) is None
+    assert os.readlink(inst / "current") == os.path.join("versions", "1.0")
+
+
+def test_missing_config_errors():
+    assert "base URL" in perform_update("1.0", install_dir="/tmp/x")
+    assert "install dir" in perform_update("1.0", base_url="http://x")
+
+
+# -- watcher integration ------------------------------------------------------
+
+def test_watcher_runs_builtin_installer_and_restart_exits(pkg_server, tmp_path,
+                                                          monkeypatch):
+    serve, base, sign_key, sign_pub, _root = pkg_server
+    publish(serve, "7.0.0", sign_key)
+    inst = tmp_path / "install"
+    monkeypatch.setenv(ENV_BASE_URL, base)
+    monkeypatch.setenv(ENV_INSTALL_DIR, str(inst))
+    monkeypatch.setenv(ENV_SIGNING_PUB, sign_pub)
+    tv = tmp_path / "tv"
+    write_target_version(str(tv), "7.0.0")
+    w = VersionFileWatcher(str(tv), current_version="1.0")
+    exits = []
+    w._exit = exits.append
+    assert w.check_once() is True
+    assert exits == [EXIT_CODE_UPDATE]
+    assert (inst / "versions" / "7.0.0").is_dir()
+
+
+def test_watcher_stays_alive_on_builtin_failure(pkg_server, tmp_path, monkeypatch):
+    """Crash-loop guard: verify failure (or unreachable server) must not
+    restart-exit — the restarted daemon would hit the same failure."""
+    serve, base, sign_key, sign_pub, _root = pkg_server
+    pkg = publish(serve, "8.0.0", sign_key)
+    with open(pkg, "ab") as f:
+        f.write(b"tamper")
+    inst = tmp_path / "install"
+    monkeypatch.setenv(ENV_BASE_URL, base)
+    monkeypatch.setenv(ENV_INSTALL_DIR, str(inst))
+    monkeypatch.setenv(ENV_SIGNING_PUB, sign_pub)
+    tv = tmp_path / "tv"
+    write_target_version(str(tv), "8.0.0")
+    w = VersionFileWatcher(str(tv), current_version="1.0")
+    exits = []
+    w._exit = exits.append
+    assert w.check_once() is True  # triggered, but no exit
+    assert exits == []
+    assert not (inst / "versions").exists()
+
+
+def test_hook_overrides_builtin_installer(pkg_server, tmp_path, monkeypatch):
+    """TPUD_UPDATE_HOOK keeps precedence so operators with bespoke
+    installs are unaffected by the built-in pipeline."""
+    serve, base, sign_key, sign_pub, _root = pkg_server
+    publish(serve, "9.0.0", sign_key)
+    inst = tmp_path / "install"
+    monkeypatch.setenv(ENV_BASE_URL, base)
+    monkeypatch.setenv(ENV_INSTALL_DIR, str(inst))
+    monkeypatch.setenv(ENV_SIGNING_PUB, sign_pub)
+    seen = tmp_path / "hook-ran"
+    hook = tmp_path / "hook.sh"
+    hook.write_text(f"#!/bin/bash\ntouch {seen}\nexit 0\n")
+    monkeypatch.setenv("TPUD_UPDATE_HOOK", str(hook))
+    tv = tmp_path / "tv"
+    write_target_version(str(tv), "9.0.0")
+    w = VersionFileWatcher(str(tv), current_version="1.0")
+    exits = []
+    w._exit = exits.append
+    w.check_once()
+    assert seen.exists()
+    assert exits == [EXIT_CODE_UPDATE]
+    assert not (inst / "versions").exists()  # built-in never ran
+
+
+def test_installer_from_env_requires_both_knobs(monkeypatch):
+    monkeypatch.delenv(ENV_BASE_URL, raising=False)
+    monkeypatch.delenv(ENV_INSTALL_DIR, raising=False)
+    assert installer_from_env() is None
+    monkeypatch.setenv(ENV_BASE_URL, "http://x")
+    assert installer_from_env() is None
+    monkeypatch.setenv(ENV_INSTALL_DIR, "/tmp/y")
+    assert installer_from_env() is not None
