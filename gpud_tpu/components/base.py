@@ -23,11 +23,14 @@ from typing import Callable, Dict, List, Optional, TYPE_CHECKING
 
 from gpud_tpu.api.v1.types import (
     Event,
+    EventType,
     HealthState,
     HealthStateType,
     SuggestedActions,
 )
 from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import counter, gauge, histogram
+from gpud_tpu.tracing import DEFAULT_TRACER
 
 if TYPE_CHECKING:  # avoid import cycles at runtime
     from gpud_tpu.eventstore import EventStore
@@ -37,6 +40,21 @@ if TYPE_CHECKING:  # avoid import cycles at runtime
 logger = get_logger(__name__)
 
 DEFAULT_POLL_INTERVAL = 60.0  # seconds (reference: temperature/component.go:83)
+
+# self-observability: every component check is measured (tentpole of the
+# observability layer; reference direction: pkg/metrics/recorder)
+_h_check_duration = histogram(
+    "tpud_component_check_duration_seconds",
+    "wall time of one component check, by component and outcome",
+)
+_c_checks = counter(
+    "tpud_component_check_total",
+    "component checks by component and status (success|failure)",
+)
+_g_last_check = gauge(
+    "tpud_component_last_check_unix_seconds",
+    "unix time the component last completed a check (staleness signal)",
+)
 
 
 class AlreadyRegisteredError(Exception):
@@ -194,6 +212,7 @@ class Component:
         self.instance = instance
         self._last_mu = threading.Lock()
         self._last_check_result: Optional[CheckResult] = None
+        self._last_check_duration = 0.0
 
     # -- identity ----------------------------------------------------------
     def name(self) -> str:
@@ -218,17 +237,40 @@ class Component:
 
     def check(self) -> CheckResult:
         """Run the check, trapping exceptions into an Unhealthy result so a
-        crashing data source never takes the poller loop down."""
-        try:
-            cr = self.check_once()
-        except Exception as e:  # noqa: BLE001 — health checks must not raise
-            logger.exception("component %s check failed", self.NAME)
-            cr = CheckResult(
-                component_name=self.NAME,
-                health=HealthStateType.UNHEALTHY,
-                reason=f"check failed: {e}",
-                error=traceback.format_exc(limit=5),
-            )
+        crashing data source never takes the poller loop down. Every check
+        is measured: duration histogram + success/failure counter + a trace
+        span in the ring (sqlite leaves nest under it)."""
+        t0 = time.monotonic()
+        raised = False
+        with DEFAULT_TRACER.span("component.check", component=self.NAME) as sp:
+            try:
+                cr = self.check_once()
+            except Exception as e:  # noqa: BLE001 — health checks must not raise
+                raised = True
+                logger.exception("component %s check failed", self.NAME)
+                cr = CheckResult(
+                    component_name=self.NAME,
+                    health=HealthStateType.UNHEALTHY,
+                    reason=f"check failed: {e}",
+                    error=traceback.format_exc(limit=5),
+                )
+            sp.set_attr("health", cr.health)
+            if cr.reason:
+                sp.set_attr("reason", cr.reason[:200])
+            if raised:
+                sp.status = "error"
+                sp.error = cr.reason[:500]
+        duration = time.monotonic() - t0
+        ok = not raised and cr.health == HealthStateType.HEALTHY
+        _h_check_duration.observe(duration, {"component": self.NAME})
+        _c_checks.inc(
+            labels={
+                "component": self.NAME,
+                "status": "success" if ok else "failure",
+            }
+        )
+        _g_last_check.set(time.time(), {"component": self.NAME})
+        self._last_check_duration = duration
         with self._last_mu:
             self._last_check_result = cr
         return cr
@@ -265,12 +307,18 @@ class PollingComponent(Component):
     """
 
     POLL_INTERVAL = DEFAULT_POLL_INTERVAL
+    # a check slower than SLOW_CHECK_FACTOR × poll_interval() can't keep its
+    # cadence; emit a Warning event so the control plane sees WHICH check is
+    # dragging (rate-limited: one event per cooldown window, not per cycle)
+    SLOW_CHECK_FACTOR = 1.0
+    SLOW_CHECK_EVENT_COOLDOWN = 300.0
 
     def __init__(self, instance: TpudInstance) -> None:
         super().__init__(instance)
         self._stop_event = threading.Event()
         self._poke_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._last_slow_event_at = 0.0
         self.time_now_fn: Callable[[], float] = time.time
 
     def start(self) -> None:
@@ -297,12 +345,49 @@ class PollingComponent(Component):
         # can never wedge daemon startup (reference runs the initial Check in
         # the spawned goroutine, temperature/component.go:81-97)
         self.check()
+        self._report_if_slow()
         while not self._stop_event.is_set():
             self._poke_event.wait(self.poll_interval())
             self._poke_event.clear()
             if self._stop_event.is_set():
                 return
             self.check()
+            self._report_if_slow()
+
+    def _report_if_slow(self) -> None:
+        """After-the-fact answer to 'why was this check slow': a check that
+        outran its own cadence becomes a Warning event in the eventstore,
+        carrying the measured duration (which /v1/debug/traces can then
+        break down span-by-span)."""
+        duration = self._last_check_duration
+        threshold = self.SLOW_CHECK_FACTOR * self.poll_interval()
+        es = getattr(self.instance, "event_store", None)
+        if es is None or threshold <= 0 or duration <= threshold:
+            return
+        now = self.time_now_fn()
+        if now - self._last_slow_event_at < self.SLOW_CHECK_EVENT_COOLDOWN:
+            return
+        self._last_slow_event_at = now
+        try:
+            es.bucket(self.NAME).insert(
+                Event(
+                    component=self.NAME,
+                    time=now,
+                    name="slow_check",
+                    type=EventType.WARNING,
+                    message=(
+                        f"check took {duration:.3f}s, over "
+                        f"{self.SLOW_CHECK_FACTOR:g}x the {self.poll_interval():g}s "
+                        "poll interval"
+                    ),
+                    extra_info={
+                        "duration_seconds": f"{duration:.6f}",
+                        "poll_interval_seconds": f"{self.poll_interval():g}",
+                    },
+                )
+            )
+        except Exception:  # noqa: BLE001 — observability must not kill the poller
+            logger.exception("slow-check event emit failed for %s", self.NAME)
 
     def close(self) -> None:
         self._stop_event.set()

@@ -34,9 +34,12 @@ from gpud_tpu.api.v1.types import (
     ComponentHealthStates,
     ComponentInfo,
     ComponentMetrics,
+    HealthState,
 )
 from gpud_tpu.fault_injector import Request as InjectRequest
 from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import counter, histogram
+from gpud_tpu.tracing import DEFAULT_TRACER
 
 if TYPE_CHECKING:
     from gpud_tpu.server.server import Server
@@ -45,6 +48,57 @@ logger = get_logger(__name__)
 
 DEFAULT_EVENTS_LOOKBACK = 3 * 3600  # /v1/events default window
 DEFAULT_METRICS_LOOKBACK = 3 * 3600
+
+# Prometheus text exposition content type (the scraper negotiates on the
+# version parameter; a bare text/plain is accepted but non-conformant)
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+DEFAULT_TRACES_LIMIT = 256
+
+_h_http = histogram(
+    "tpud_http_request_duration_seconds",
+    "HTTP API request latency by route and method",
+)
+_c_http = counter(
+    "tpud_http_requests_total",
+    "HTTP API requests by route, method and status code",
+)
+
+
+@web.middleware
+async def observe_middleware(request: web.Request, handler):
+    """Per-request latency + trace recording. Route label is the matched
+    route template (bounded cardinality); unmatched requests — hostile
+    paths, 404 probes — collapse into one 'unmatched' label rather than
+    minting a metric series per probed URL."""
+    t0 = time.monotonic()
+    start_unix = time.time()
+    status = 500
+    try:
+        resp = await handler(request)
+        status = resp.status
+        return resp
+    except web.HTTPException as e:
+        status = e.status
+        raise
+    finally:
+        duration = time.monotonic() - t0
+        resource = request.match_info.route.resource
+        route = resource.canonical if resource is not None else "unmatched"
+        _h_http.observe(duration, {"route": route, "method": request.method})
+        _c_http.inc(
+            labels={"route": route, "method": request.method, "status": str(status)}
+        )
+        # flat record (not the thread-local span stack): concurrent requests
+        # interleave on the one event-loop thread
+        DEFAULT_TRACER.record(
+            "http.request",
+            duration,
+            component="http",
+            start_unix=start_unix,
+            status="ok" if status < 500 else "error",
+            attrs={"route": route, "method": request.method, "status": status},
+        )
 
 
 def _json(data, status: int = 200) -> web.Response:
@@ -76,7 +130,7 @@ def _qfloat(req: web.Request, key: str, default: float) -> float:
 
 
 def build_app(srv: "Server") -> web.Application:
-    app = web.Application()
+    app = web.Application(middlewares=[observe_middleware])
     r = app.router
 
     async def healthz(_req: web.Request) -> web.Response:
@@ -207,12 +261,30 @@ def build_app(srv: "Server") -> web.Application:
                     metrics=metrics_by_comp.get(c.name(), []),
                 ).to_dict()
             )
+        if not comps:
+            # self-observability summary rides along as a pseudo-component
+            # entry so existing list-shaped consumers keep parsing
+            out.append(_self_info_entry(srv, start, now))
         return _json(out)
 
     async def prometheus(_req: web.Request) -> web.Response:
         return web.Response(
-            text=srv.metrics_registry.render_prometheus(),
-            content_type="text/plain",
+            body=srv.metrics_registry.render_prometheus().encode("utf-8"),
+            headers={"Content-Type": PROMETHEUS_CONTENT_TYPE},
+        )
+
+    async def debug_traces(req: web.Request) -> web.Response:
+        """Recent spans from the in-process trace ring, newest first
+        (?component= filters, ?limit= caps; see docs/observability.md)."""
+        component = req.query.get("component", "") or None
+        limit = int(_qfloat(req, "limit", DEFAULT_TRACES_LIMIT))
+        if limit < 0:
+            limit = DEFAULT_TRACES_LIMIT
+        return _json(
+            {
+                "spans": srv.tracer.snapshot(component=component, limit=limit),
+                "stats": srv.tracer.stats(),
+            }
         )
 
     async def machine_info_handler(_req: web.Request) -> web.Response:
@@ -372,12 +444,55 @@ def build_app(srv: "Server") -> web.Application:
     r.add_get("/v1/metrics", metrics_v1)
     r.add_get("/v1/info", info)
     r.add_get("/v1/plugins", plugins)
+    r.add_get("/v1/debug/traces", debug_traces)
     r.add_get("/metrics", prometheus)
     r.add_get("/machine-info", machine_info_handler)
     r.add_post("/inject-fault", inject_fault)
     r.add_get("/admin/config", admin_config)
     r.add_get("/admin/packages", admin_packages)
     return app
+
+
+SELF_COMPONENT = "tpud-self"
+
+
+def _self_info_entry(srv: "Server", start: float, now: float) -> dict:
+    """Daemon self-observability summary for /v1/info: trace-ring stats and
+    sqlite op totals, flattened to the ComponentInfo shape (extra_info is a
+    string map on the wire)."""
+    from gpud_tpu import sqlite as sqlite_mod
+
+    tstats = srv.tracer.stats()
+    extra = {
+        "trace_ring_capacity": str(tstats["capacity"]),
+        "trace_ring_size": str(tstats["size"]),
+        "trace_spans_recorded_total": str(tstats["recorded_total"]),
+        "trace_spans_dropped_total": str(tstats["dropped_total"]),
+    }
+    slowest = tstats.get("slowest")
+    if slowest:
+        extra["trace_slowest_name"] = slowest["name"]
+        extra["trace_slowest_duration_seconds"] = (
+            f"{slowest['duration_seconds']:.6f}"
+        )
+    for k, v in sqlite_mod.stats().items():
+        extra[f"sqlite_{k}"] = f"{v:.6f}" if isinstance(v, float) else str(v)
+    return ComponentInfo(
+        component=SELF_COMPONENT,
+        start_time=start,
+        end_time=now,
+        states=[
+            HealthState(
+                time=now,
+                component=SELF_COMPONENT,
+                name=SELF_COMPONENT,
+                reason="daemon self-observability summary",
+                extra_info=extra,
+            )
+        ],
+        events=[],
+        metrics=[],
+    ).to_dict()
 
 
 async def _run_blocking(srv: "Server", fn):

@@ -69,6 +69,10 @@ class Server:
 
         # metrics pipeline (reference: server.go:223-242)
         self.metrics_registry = metrics_registry or DEFAULT_REGISTRY
+        # in-process trace ring (served at /v1/debug/traces)
+        from gpud_tpu.tracing import DEFAULT_TRACER
+
+        self.tracer = DEFAULT_TRACER
         self.metrics_store = MetricsStore(
             self.db_rw, retention_seconds=self.config.metrics_retention_seconds
         )

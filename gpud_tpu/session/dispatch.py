@@ -24,12 +24,25 @@ from gpud_tpu import machine_info as machineinfo
 from gpud_tpu.fault_injector import Request as InjectRequest
 from gpud_tpu.log import audit, get_logger
 from gpud_tpu.metadata import KEY_TOKEN
+from gpud_tpu.metrics.registry import counter, histogram
 from gpud_tpu.process import run_bash_script
+from gpud_tpu.tracing import DEFAULT_TRACER
 
 if TYPE_CHECKING:
     from gpud_tpu.server.server import Server
 
 logger = get_logger(__name__)
+
+# session dispatch latency: the serve loop is single-threaded per session,
+# so one slow handler delays every queued control-plane request behind it
+_h_dispatch = histogram(
+    "tpud_session_dispatch_duration_seconds",
+    "control-plane session request dispatch latency by method",
+)
+_c_dispatch = counter(
+    "tpud_session_dispatch_total",
+    "control-plane session dispatches by method and outcome (ok|error)",
+)
 
 DEFAULT_BOOTSTRAP_TIMEOUT = 10 * 60.0
 # exit code asking the supervisor (systemd/DaemonSet) to restart us with
@@ -61,13 +74,28 @@ class Dispatcher:
             return {"error": f"invalid method {method!r}"}
         handler = getattr(self, f"_m_{method.replace('-', '_')}", None)
         if handler is None:
+            # the method name comes off the wire: label with a sentinel, not
+            # the raw string, or a hostile peer mints unbounded label sets
+            _c_dispatch.inc(labels={"method": "<unknown>", "outcome": "error"})
             return {"error": f"unknown method {method!r}"}
         audit("session_request", method=method)
+        outcome = "ok"
+        t0 = time.monotonic()
         try:
-            return handler(req)
+            with DEFAULT_TRACER.span(
+                "session.dispatch", component="session", attrs={"method": method}
+            ):
+                resp = handler(req)
+            if isinstance(resp, dict) and "error" in resp:
+                outcome = "error"
+            return resp
         except Exception as e:  # noqa: BLE001
+            outcome = "error"
             logger.exception("session method %s failed", method)
             return {"error": str(e)}
+        finally:
+            _h_dispatch.observe(time.monotonic() - t0, {"method": method})
+            _c_dispatch.inc(labels={"method": method, "outcome": outcome})
 
     # -- state/introspection ----------------------------------------------
     def _m_states(self, req: Dict) -> Dict:

@@ -18,6 +18,8 @@ import time
 from typing import Any, Iterable, Optional, Tuple
 
 from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import histogram
+from gpud_tpu.tracing import DEFAULT_TRACER
 
 logger = get_logger(__name__)
 
@@ -32,6 +34,16 @@ _stats = {
     "vacuum_seconds": 0.0,
 }
 
+# per-query latency distribution — the totals above say how much time sqlite
+# ate overall; the histogram says whether it was many fast queries or a few
+# stalls (WAL contention, checkpointing, a cold VACUUM)
+_h_query = histogram(
+    "tpud_sqlite_query_duration_seconds",
+    "SQLite query latency by operation kind (select|insert_update_delete|vacuum)",
+    buckets=(0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01,
+             0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0),
+)
+
 
 def stats() -> dict:
     with _stats_mu:
@@ -42,6 +54,16 @@ def _record(kind: str, seconds: float) -> None:
     with _stats_mu:
         _stats[f"{kind}_total"] += 1
         _stats[f"{kind}_seconds"] += seconds
+    _h_query.observe(seconds, {"op": kind})
+    # trace only as a child: standalone queries at scrape cadence would
+    # flood the ring, but inside a slow check/dispatch span the sqlite leaf
+    # is exactly the breakdown the debugger wants
+    DEFAULT_TRACER.record(
+        f"sqlite.{kind}",
+        seconds,
+        component="sqlite",
+        parent_required=True,
+    )
 
 
 class DB:

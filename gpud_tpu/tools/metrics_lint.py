@@ -1,0 +1,82 @@
+"""Metric-registry lint: naming and help-text discipline.
+
+Every metric the daemon registers must (a) carry the ``tpud_`` namespace
+prefix — fleet Prometheus setups scrape many exporters into one TSDB, and
+an unprefixed name collides or becomes unattributable — and (b) carry
+non-empty help text, because `/metrics` is the operator's first (often
+only) documentation of what a series means. The lint runs in CI via
+``tests/test_metrics_lint.py`` so new instrumentation cannot silently ship
+unnamed or undocumented metrics, and is runnable standalone:
+
+    python -m gpud_tpu.tools.metrics_lint
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List
+
+METRIC_NAME_PREFIX = "tpud_"
+
+# modules whose import (or cheap construction) registers every metric the
+# daemon can expose — keep in sync with new instrumentation sites
+_METRIC_MODULES = (
+    "gpud_tpu.components.all",
+    "gpud_tpu.components.base",
+    "gpud_tpu.server.app",
+    "gpud_tpu.session.dispatch",
+    "gpud_tpu.sqlite",
+)
+
+
+def lint_registry(registry) -> List[str]:
+    """Return one problem string per violation; empty list = clean."""
+    problems: List[str] = []
+    for m in registry.all_metrics():
+        if not m.name.startswith(METRIC_NAME_PREFIX):
+            problems.append(
+                f"{m.name}: missing {METRIC_NAME_PREFIX!r} name prefix"
+            )
+        if not m.help_text.strip():
+            problems.append(f"{m.name}: empty help text")
+    return problems
+
+
+def populate_default_registry() -> None:
+    """Import every metric-defining module so module-level registrations
+    land in the default registry, then construct the recorder (its metrics
+    register at construction, not import)."""
+    import importlib
+
+    for mod in _METRIC_MODULES:
+        importlib.import_module(mod)
+
+    from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
+    from gpud_tpu.metrics.store import SelfMetricsRecorder
+    from gpud_tpu.sqlite import DB
+
+    db = DB(":memory:")
+    try:
+        SelfMetricsRecorder(DEFAULT_REGISTRY, db)
+    finally:
+        db.close()
+
+
+def main() -> int:
+    populate_default_registry()
+    from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
+
+    problems = lint_registry(DEFAULT_REGISTRY)
+    for p in problems:
+        print(f"metrics-lint: {p}", file=sys.stderr)
+    n = len(DEFAULT_REGISTRY.all_metrics())
+    if problems:
+        print(f"metrics-lint: {len(problems)} problem(s) in {n} metrics",
+              file=sys.stderr)
+        return 1
+    print(f"metrics-lint: {n} metrics clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

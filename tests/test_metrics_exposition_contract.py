@@ -87,9 +87,13 @@ def parse_exposition(text: str):
         key = (name, frozenset(labels.items()))
         assert key not in samples, f"duplicate sample {key}"
         samples[key] = value
-        # metadata must precede the first sample of its family
-        family = name[:-6] if name.endswith("_total") else name
-        assert family in seen_meta or name in seen_meta, (
+        # metadata must precede the first sample of its family; histogram
+        # samples carry _bucket/_sum/_count suffixes over the family name
+        candidates = {name}
+        for suffix in ("_total", "_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                candidates.add(name[: -len(suffix)])
+        assert candidates & seen_meta.keys(), (
             f"sample {name} before its HELP/TYPE"
         )
     return samples

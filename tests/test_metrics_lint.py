@@ -1,0 +1,37 @@
+"""CI gate over the metric registry: every registered metric carries the
+``tpud_`` prefix and non-empty help text (gpud_tpu/tools/metrics_lint.py).
+New instrumentation that forgets either fails here, not in production."""
+
+from gpud_tpu.metrics.registry import DEFAULT_REGISTRY, Registry
+from gpud_tpu.tools import metrics_lint
+
+
+def test_lint_flags_bad_names_and_missing_help():
+    r = Registry()
+    r.gauge("unprefixed_metric", "has help")
+    r.counter("tpud_ok_total", "")
+    r.histogram("tpud_fine_seconds", "documented")
+    problems = metrics_lint.lint_registry(r)
+    assert sorted(problems) == [
+        "tpud_ok_total: empty help text",
+        "unprefixed_metric: missing 'tpud_' name prefix",
+    ]
+
+
+def test_lint_clean_registry_is_silent():
+    r = Registry()
+    r.gauge("tpud_a", "a")
+    r.histogram("tpud_b_seconds", "b")
+    assert metrics_lint.lint_registry(r) == []
+
+
+def test_every_daemon_metric_passes_lint():
+    """The real check: import every instrumentation site and lint the full
+    default registry. A new metric without prefix/help fails THIS test."""
+    metrics_lint.populate_default_registry()
+    assert len(DEFAULT_REGISTRY.all_metrics()) >= 30  # the daemon is instrumented
+    assert metrics_lint.lint_registry(DEFAULT_REGISTRY) == []
+
+
+def test_lint_cli_exit_code():
+    assert metrics_lint.main() == 0

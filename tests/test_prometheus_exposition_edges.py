@@ -1,0 +1,185 @@
+"""Prometheus exposition edge cases: non-finite values, label escaping,
+deterministic ordering, and the histogram bucket/sum/count rendering
+contract (ISSUE 1 satellite). Complements the grammar-level fuzz in
+test_metrics_exposition_contract.py with exact-output assertions."""
+
+import math
+import threading
+
+import pytest
+
+from gpud_tpu.metrics.registry import DEFAULT_BUCKETS, Histogram, Registry
+
+
+# -- non-finite values ------------------------------------------------------
+
+def test_inf_and_nan_render_as_exposition_tokens():
+    r = Registry()
+    g = r.gauge("tpud_edge", "h")
+    g.set(math.inf, {"k": "pos"})
+    g.set(-math.inf, {"k": "neg"})
+    g.set(math.nan, {"k": "nan"})
+    out = r.render_prometheus()
+    assert 'tpud_edge{k="pos"} +Inf' in out
+    assert 'tpud_edge{k="neg"} -Inf' in out
+    assert 'tpud_edge{k="nan"} NaN' in out
+
+
+def test_nan_observation_does_not_break_histogram_buckets():
+    h = Histogram("tpud_h", "h", buckets=(1.0,))
+    h.observe(math.nan)
+    h.observe(0.5)
+    # NaN lands in no finite bucket but still counts toward count/+Inf
+    samples = {(n, k): v for n, k, v in h.samples()}
+    assert samples[("tpud_h_bucket", (("le", "1"),))] == 1.0
+    assert samples[("tpud_h_bucket", (("le", "+Inf"),))] == 2.0
+    assert h.get_count() == 2
+
+
+# -- label escaping ---------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "raw,escaped",
+    [
+        ('say "hi"', 'say \\"hi\\"'),
+        ("back\\slash", "back\\\\slash"),
+        ("line\nbreak", "line\\nbreak"),
+        ('all\\"\n', 'all\\\\\\"\\n'),
+    ],
+)
+def test_label_value_escaping(raw, escaped):
+    r = Registry()
+    r.gauge("tpud_esc", "h").set(1.0, {"v": raw})
+    assert f'tpud_esc{{v="{escaped}"}} 1' in r.render_prometheus()
+
+
+def test_help_text_escaping_stays_single_line():
+    r = Registry()
+    r.gauge("tpud_help", "multi\nline \\ help")
+    out = r.render_prometheus()
+    (help_line,) = [ln for ln in out.splitlines() if ln.startswith("# HELP")]
+    assert help_line == "# HELP tpud_help multi\\nline \\\\ help"
+
+
+# -- deterministic ordering -------------------------------------------------
+
+def test_metric_families_and_labelsets_render_sorted():
+    r = Registry()
+    r.gauge("tpud_zz", "h").set(1.0)
+    r.gauge("tpud_aa", "h").set(1.0)
+    g = r.gauge("tpud_mm", "h")
+    # insertion order deliberately unsorted
+    g.set(1.0, {"x": "2"})
+    g.set(1.0, {"x": "1"})
+    g.set(1.0, {"a": "9", "b": "0"})
+    out = r.render_prometheus()
+    sample_lines = [ln for ln in out.splitlines() if not ln.startswith("#")]
+    assert sample_lines == sorted(sample_lines)
+    # two renders byte-identical (the scraper diffing relies on this)
+    assert out == r.render_prometheus()
+
+
+def test_label_keys_render_sorted_within_labelset():
+    r = Registry()
+    r.gauge("tpud_lk", "h").set(1.0, {"zeta": "1", "alpha": "2"})
+    assert 'tpud_lk{alpha="2",zeta="1"} 1' in r.render_prometheus()
+
+
+# -- histogram rendering ----------------------------------------------------
+
+def test_histogram_bucket_sum_count_rendering():
+    r = Registry()
+    h = r.histogram("tpud_lat_seconds", "latency", buckets=(0.1, 0.5, 2.5))
+    for v in (0.05, 0.3, 0.4, 1.0, 99.0):
+        h.observe(v, {"op": "x"})
+    out = r.render_prometheus()
+    assert "# TYPE tpud_lat_seconds histogram" in out
+    assert 'tpud_lat_seconds_bucket{op="x",le="0.1"} 1' in out
+    assert 'tpud_lat_seconds_bucket{op="x",le="0.5"} 3' in out  # cumulative
+    assert 'tpud_lat_seconds_bucket{op="x",le="2.5"} 4' in out
+    assert 'tpud_lat_seconds_bucket{op="x",le="+Inf"} 5' in out
+    assert 'tpud_lat_seconds_count{op="x"} 5' in out
+    (sum_line,) = [
+        ln for ln in out.splitlines() if ln.startswith('tpud_lat_seconds_sum')
+    ]
+    assert float(sum_line.split()[-1]) == pytest.approx(100.75)
+
+
+def test_histogram_buckets_sorted_and_deduped():
+    h = Histogram("tpud_h", "h", buckets=(5.0, 1.0, 1.0, math.inf))
+    assert h.buckets == (1.0, 5.0)  # sorted, deduped, +Inf implicit
+
+
+def test_histogram_rejects_empty_or_nan_buckets():
+    with pytest.raises(ValueError):
+        Histogram("tpud_h", "h", buckets=())
+    with pytest.raises(ValueError):
+        Histogram("tpud_h", "h", buckets=(math.nan, 1.0))
+
+
+def test_histogram_timer_records_on_success_and_exception():
+    h = Histogram("tpud_h", "h", buckets=DEFAULT_BUCKETS)
+    with h.time({"op": "ok"}):
+        pass
+    with pytest.raises(RuntimeError):
+        with h.time({"op": "boom"}):
+            raise RuntimeError("x")
+    assert h.get_count({"op": "ok"}) == 1
+    assert h.get_count({"op": "boom"}) == 1  # failure latency still observed
+
+
+def test_histogram_flows_through_gather():
+    r = Registry()
+    h = r.histogram("tpud_g_seconds", "h", buckets=(1.0,))
+    h.observe(0.5, {"c": "a"})
+    rows = r.gather(now=1700000000.0)
+    names = {(name, tuple(sorted(labels.items()))) for _, name, labels, _ in rows}
+    assert ("tpud_g_seconds_bucket", (("c", "a"), ("le", "+Inf"))) in names
+    assert ("tpud_g_seconds_sum", (("c", "a"),)) in names
+    assert ("tpud_g_seconds_count", (("c", "a"),)) in names
+    assert all(ts == 1700000000 for ts, *_ in rows)
+
+
+def test_histogram_type_mismatch_raises():
+    r = Registry()
+    r.gauge("tpud_x", "h")
+    with pytest.raises(TypeError):
+        r.histogram("tpud_x", "h")
+    r.histogram("tpud_y", "h")
+    with pytest.raises(TypeError):
+        r.counter("tpud_y", "h")
+
+
+# -- get-or-create atomicity (the check-then-create race fix) ---------------
+
+def test_concurrent_get_or_create_never_raises():
+    r = Registry()
+    errs = []
+    barrier = threading.Barrier(8)
+
+    def work():
+        try:
+            barrier.wait(timeout=5)
+            for i in range(50):
+                r.gauge(f"tpud_race_g_{i}", "h").set(1.0)
+                r.counter(f"tpud_race_c_{i}", "h").inc()
+                r.histogram(f"tpud_race_h_{i}", "h").observe(0.1)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errs == []
+    # all threads converged on one instance per name
+    assert r.counter("tpud_race_c_0", "h").get() == 8.0
+    assert r.histogram("tpud_race_h_0", "h").get_count() == 8
+
+
+def test_histogram_get_or_create_keeps_original_buckets():
+    r = Registry()
+    a = r.histogram("tpud_hb", "h", buckets=(1.0, 2.0))
+    b = r.histogram("tpud_hb", "h", buckets=(9.0,))
+    assert a is b and b.buckets == (1.0, 2.0)

@@ -1,0 +1,372 @@
+"""Self-observability layer (ISSUE 1 tentpole): check/HTTP/SQLite/dispatch
+latency instrumentation, the in-process trace ring, its HTTP surface
+(`/v1/debug/traces`, the /v1/info summary), and slow-check warning events.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gpud_tpu.api.v1.types import EventType, HealthStateType
+from gpud_tpu.components.base import (
+    CheckResult,
+    Component,
+    PollingComponent,
+    TpudInstance,
+)
+from gpud_tpu.components.base import (
+    _c_checks,
+    _g_last_check,
+    _h_check_duration,
+)
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.sqlite import DB
+from gpud_tpu.tracing import DEFAULT_TRACER, Tracer
+
+
+# -- tracer unit behaviour --------------------------------------------------
+
+def test_span_nesting_and_parent_ids():
+    tr = Tracer(capacity=16)
+    with tr.span("outer", component="c") as outer:
+        with tr.span("inner", component="c") as inner:
+            assert inner.parent_id == outer.span_id
+    spans = tr.snapshot()
+    # children finish (and record) before parents: newest-first = outer first
+    assert [s["name"] for s in spans] == ["outer", "inner"]
+    assert spans[1]["parent_id"] == spans[0]["span_id"]
+    assert all(s["duration_seconds"] >= 0 for s in spans)
+
+
+def test_span_error_status_propagates_and_reraises():
+    tr = Tracer(capacity=16)
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("nope")
+    (sp,) = tr.snapshot()
+    assert sp["status"] == "error"
+    assert "ValueError: nope" in sp["error"]
+
+
+def test_ring_is_bounded_and_counts_drops():
+    tr = Tracer(capacity=4)
+    for i in range(10):
+        tr.record(f"s{i}", 0.001)
+    st = tr.stats()
+    assert st["size"] == 4 and st["capacity"] == 4
+    assert st["recorded_total"] == 10 and st["dropped_total"] == 6
+    # newest-wins: the ring holds the last four
+    assert [s["name"] for s in tr.snapshot()] == ["s9", "s8", "s7", "s6"]
+
+
+def test_snapshot_component_filter_and_limit():
+    tr = Tracer(capacity=32)
+    for i in range(6):
+        tr.record(f"s{i}", 0.0, component="a" if i % 2 else "b")
+    assert {s["component"] for s in tr.snapshot(component="a")} == {"a"}
+    assert len(tr.snapshot(limit=2)) == 2
+
+
+def test_parent_required_record_drops_without_active_span():
+    tr = Tracer(capacity=16)
+    assert tr.record("leaf", 0.0, parent_required=True) is None
+    with tr.span("parent") as p:
+        leaf = tr.record("leaf", 0.0, parent_required=True)
+        assert leaf is not None and leaf.parent_id == p.span_id
+    assert len(tr.snapshot()) == 2
+
+
+def test_stats_reports_slowest_span():
+    tr = Tracer(capacity=8)
+    tr.record("fast", 0.001)
+    tr.record("slow", 2.5)
+    assert tr.stats()["slowest"]["name"] == "slow"
+
+
+# -- component check instrumentation ---------------------------------------
+
+class _OkComp(Component):
+    NAME = "obs-ok"
+
+    def check_once(self):
+        return CheckResult(self.NAME, reason="fine")
+
+
+class _BoomComp(Component):
+    NAME = "obs-boom"
+
+    def check_once(self):
+        raise RuntimeError("boom")
+
+
+def test_check_records_duration_success_and_staleness():
+    labels = {"component": _OkComp.NAME}
+    base_n = _h_check_duration.get_count(labels)
+    base_ok = _c_checks.get({**labels, "status": "success"})
+    c = _OkComp(TpudInstance())
+    c.check()
+    assert _h_check_duration.get_count(labels) == base_n + 1
+    assert _c_checks.get({**labels, "status": "success"}) == base_ok + 1
+    assert _g_last_check.get(labels) == pytest.approx(time.time(), abs=5.0)
+    assert c._last_check_duration >= 0.0
+
+
+def test_check_failure_counted_and_traced():
+    labels = {"component": _BoomComp.NAME, "status": "failure"}
+    base = _c_checks.get(labels)
+    c = _BoomComp(TpudInstance())
+    cr = c.check()
+    assert cr.health == HealthStateType.UNHEALTHY
+    assert _c_checks.get(labels) == base + 1
+    spans = DEFAULT_TRACER.snapshot(component=_BoomComp.NAME, limit=1)
+    assert spans and spans[0]["name"] == "component.check"
+    assert spans[0]["status"] == "error"
+
+
+def test_sqlite_queries_nest_under_check_span():
+    db = DB(":memory:")
+
+    class _DbComp(Component):
+        NAME = "obs-db"
+
+        def check_once(self):
+            db.query("SELECT 1")
+            return CheckResult(self.NAME)
+
+    _DbComp(TpudInstance()).check()
+    spans = DEFAULT_TRACER.snapshot(limit=10)
+    check = next(s for s in spans if s.get("component") == "obs-db")
+    leaf = next(s for s in spans if s["name"] == "sqlite.select"
+                and s.get("parent_id") == check["span_id"])
+    assert leaf["duration_seconds"] >= 0.0
+    # standalone queries (no active span) stay out of the ring
+    before = DEFAULT_TRACER.stats()["recorded_total"]
+    db.query("SELECT 2")
+    assert DEFAULT_TRACER.stats()["recorded_total"] == before
+    db.close()
+
+
+# -- slow-check warning events ----------------------------------------------
+
+class _SlowPoller(PollingComponent):
+    NAME = "obs-slow"
+    POLL_INTERVAL = 0.01
+    SLOW_CHECK_EVENT_COOLDOWN = 0.0
+
+    def check_once(self):
+        time.sleep(0.05)
+        return CheckResult(self.NAME)
+
+
+def test_slow_check_emits_warning_event():
+    db = DB(":memory:")
+    es = EventStore(db)
+    c = _SlowPoller(TpudInstance(event_store=es))
+    c.check()
+    c._report_if_slow()
+    evs = es.bucket(c.NAME).get(0)
+    assert evs, "no slow_check event emitted"
+    ev = evs[0]
+    assert ev.name == "slow_check" and ev.type == EventType.WARNING
+    assert float(ev.extra_info["duration_seconds"]) > c.POLL_INTERVAL
+    db.close()
+
+
+def test_slow_check_event_rate_limited():
+    db = DB(":memory:")
+    es = EventStore(db)
+    c = _SlowPoller(TpudInstance(event_store=es))
+    c.SLOW_CHECK_EVENT_COOLDOWN = 3600.0
+    c.check()
+    c._report_if_slow()
+    c._report_if_slow()  # inside cooldown — suppressed
+    assert len(es.bucket(c.NAME).get(0)) == 1
+    db.close()
+
+
+def test_fast_check_emits_no_event():
+    db = DB(":memory:")
+    es = EventStore(db)
+
+    class _Fast(PollingComponent):
+        NAME = "obs-fast"
+        POLL_INTERVAL = 60.0
+
+        def check_once(self):
+            return CheckResult(self.NAME)
+
+    c = _Fast(TpudInstance(event_store=es))
+    c.check()
+    c._report_if_slow()
+    assert es.bucket(c.NAME).get(0) == []
+    db.close()
+
+
+# -- server surface: middleware, /metrics, /v1/debug/traces, /v1/info ------
+
+@pytest.fixture(scope="module")
+def obs_srv(tmp_path_factory):
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    tmp = tmp_path_factory.mktemp("obs-server")
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp / "data"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        components_disabled=["network-latency"],
+        enable_auto_update=False,  # image has no cryptography package
+    )
+    s = Server(config=cfg)
+    s.start()
+    yield s
+    s.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(f"{srv.base_url()}{path}", timeout=10) as resp:
+        return resp.status, dict(resp.headers), resp.read().decode()
+
+
+def test_metrics_served_with_prometheus_content_type(obs_srv):
+    status, headers, body = _get(obs_srv, "/metrics")
+    assert status == 200
+    assert headers["Content-Type"] == "text/plain; version=0.0.4; charset=utf-8"
+    assert body.startswith("# ")
+
+
+def test_metrics_exposes_check_duration_histogram(obs_srv):
+    # boot runs every component's first check on its poller thread
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        _, _, body = _get(obs_srv, "/metrics")
+        if 'tpud_component_check_duration_seconds_bucket{component="cpu"' in body:
+            break
+        time.sleep(0.2)
+    assert 'tpud_component_check_duration_seconds_bucket{component="cpu",le="+Inf"}' in body
+    assert 'tpud_component_check_duration_seconds_sum{component="cpu"}' in body
+    assert 'tpud_component_check_duration_seconds_count{component="cpu"}' in body
+    assert "# TYPE tpud_component_check_duration_seconds histogram" in body
+
+
+def test_metrics_exposes_http_and_sqlite_latency(obs_srv):
+    _get(obs_srv, "/healthz")
+    _, _, body = _get(obs_srv, "/metrics")
+    assert 'tpud_http_request_duration_seconds_bucket{method="GET",route="/healthz",le=' in body
+    assert 'tpud_http_requests_total{method="GET",route="/healthz",status="200"}' in body
+    assert "tpud_sqlite_query_duration_seconds_bucket" in body
+    assert 'tpud_component_last_check_unix_seconds{component="cpu"}' in body
+
+
+def test_debug_traces_after_triggered_check(obs_srv):
+    status, _, _ = _get(
+        obs_srv, "/v1/components/trigger-check?componentName=cpu"
+    )
+    assert status == 200
+    status, _, body = _get(obs_srv, "/v1/debug/traces?component=cpu")
+    assert status == 200
+    d = json.loads(body)
+    spans = d["spans"]
+    assert spans, "no spans for the just-triggered cpu check"
+    assert spans[0]["name"] == "component.check"
+    assert spans[0]["component"] == "cpu"
+    assert spans[0]["duration_seconds"] >= 0.0
+    assert d["stats"]["capacity"] > 0
+
+
+def test_debug_traces_records_http_requests(obs_srv):
+    _get(obs_srv, "/healthz")
+    _, _, body = _get(obs_srv, "/v1/debug/traces?component=http")
+    spans = json.loads(body)["spans"]
+    assert any(
+        s["name"] == "http.request" and s["attrs"]["route"] == "/healthz"
+        for s in spans
+    )
+
+
+def test_debug_traces_limit_and_bad_limit(obs_srv):
+    _, _, body = _get(obs_srv, "/v1/debug/traces?limit=1")
+    assert len(json.loads(body)["spans"]) == 1
+    try:
+        status, _, _ = _get(obs_srv, "/v1/debug/traces?limit=banana")
+    except urllib.error.HTTPError as e:
+        status = e.code
+    assert status == 400
+
+
+def test_info_carries_self_observability_summary(obs_srv):
+    _, _, body = _get(obs_srv, "/v1/info")
+    entries = json.loads(body)
+    self_entry = next(e for e in entries if e["component"] == "tpud-self")
+    extra = self_entry["info"]["states"][0]["extra_info"]
+    assert int(extra["trace_ring_capacity"]) > 0
+    assert int(extra["trace_spans_recorded_total"]) > 0
+    assert "sqlite_select_total" in extra
+    # filtered requests keep the old component-only shape
+    _, _, body = _get(obs_srv, "/v1/info?components=cpu")
+    assert [e["component"] for e in json.loads(body)] == ["cpu"]
+
+
+def test_metrics_v1_serves_histogram_series_from_store(obs_srv):
+    obs_srv.metrics_syncer.sync_once()
+    _, _, body = _get(obs_srv, "/v1/metrics")
+    names = {
+        m["name"]
+        for comp in json.loads(body)
+        for m in comp.get("metrics", [])
+    }
+    assert "tpud_component_check_duration_seconds_count" in names
+    assert "tpud_component_check_duration_seconds_bucket" in names
+
+
+def test_unmatched_routes_collapse_to_one_label(obs_srv):
+    from gpud_tpu.server.app import _c_http
+
+    for i in range(3):
+        try:
+            _get(obs_srv, f"/no-such-route-{i}")
+        except urllib.error.HTTPError:
+            pass
+    assert _c_http.get(
+        {"route": "unmatched", "method": "GET", "status": "404"}
+    ) >= 3.0
+
+
+# -- session dispatch latency ----------------------------------------------
+
+def test_dispatch_latency_observed(obs_srv):
+    from gpud_tpu.session.dispatch import Dispatcher, _c_dispatch, _h_dispatch
+
+    d = Dispatcher(obs_srv)
+    base = _h_dispatch.get_count({"method": "states"})
+    assert "states" in str(d({"method": "states"}))
+    assert _h_dispatch.get_count({"method": "states"}) == base + 1
+    assert _c_dispatch.get({"method": "states", "outcome": "ok"}) >= 1.0
+    spans = DEFAULT_TRACER.snapshot(component="session", limit=5)
+    assert any(
+        s["name"] == "session.dispatch" and s["attrs"]["method"] == "states"
+        for s in spans
+    )
+
+
+def test_dispatch_error_outcome_and_unknown_method(obs_srv):
+    from gpud_tpu.session.dispatch import Dispatcher, _c_dispatch
+
+    d = Dispatcher(obs_srv)
+    base_err = _c_dispatch.get({"method": "setHealthy", "outcome": "error"})
+    d({"method": "setHealthy", "component": "ghost"})
+    assert _c_dispatch.get(
+        {"method": "setHealthy", "outcome": "error"}
+    ) == base_err + 1
+    base_unk = _c_dispatch.get({"method": "<unknown>", "outcome": "error"})
+    d({"method": "no-such-method"})
+    # hostile method names collapse into one sentinel label
+    assert _c_dispatch.get(
+        {"method": "<unknown>", "outcome": "error"}
+    ) == base_unk + 1
