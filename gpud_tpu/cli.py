@@ -72,20 +72,37 @@ def cmd_scan(args) -> int:
         os.environ["TPUD_KMSG_FILE_PATH"] = args.kmsg_path
     as_json = getattr(args, "as_json", False)
     sink = io.StringIO() if as_json else sys.stdout
-    results = scan(accelerator_type=args.accelerator_type, out=sink)
+    # scan itself stays stateless, but when a daemon has run here before,
+    # its persisted ledger adds the rolling-availability column for free
+    availability = {}
+    cfg = _build_config(args)
+    if not cfg.db_in_memory and os.path.isfile(cfg.state_file()):
+        try:
+            from gpud_tpu.health_history import HealthLedger
+            from gpud_tpu.sqlite import DB
+
+            availability = HealthLedger(DB(cfg.state_file())).availability_all()
+        except Exception:  # noqa: BLE001 — a corrupt DB must not block scan
+            availability = {}
+    results = scan(
+        accelerator_type=args.accelerator_type, out=sink,
+        availability=availability,
+    )
     if as_json:
-        print(_json.dumps(
-            [
-                {
-                    "component": r.component_name(),
-                    "health": r.health_state_type(),
-                    "reason": r.summary(),
-                    "extra_info": dict(r.extra_info),
-                }
-                for r in results
-            ],
-            indent=2,
-        ))
+        rows = []
+        for r in results:
+            row = {
+                "component": r.component_name(),
+                "health": r.health_state_type(),
+                "reason": r.summary(),
+                "extra_info": dict(r.extra_info),
+            }
+            # optional key: present only when a prior daemon run left a ledger
+            av = availability.get(r.component_name())
+            if av is not None:
+                row["availability"] = av
+            rows.append(row)
+        print(_json.dumps(rows, indent=2))
     unhealthy = [
         r for r in results if r.health_state_type() != HealthStateType.HEALTHY
     ]
@@ -249,6 +266,61 @@ def cmd_metadata(args) -> int:
     cfg = _build_config(args)
     md = Metadata(DB(cfg.state_file()))
     print(json.dumps(md.all(), indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_history(args) -> int:
+    """Health-transition timeline from the persisted ledger. Reads the
+    state DB directly (WAL mode), so it works whether or not the daemon is
+    up — the offline analog of ``GET /v1/states/history``."""
+    import os
+    import time as _time
+    from datetime import datetime
+
+    from gpud_tpu.health_history import HealthLedger
+    from gpud_tpu.sqlite import DB
+
+    cfg = _build_config(args)
+    path = cfg.state_file()
+    if not os.path.isfile(path):
+        print(f"no state DB at {path} (has the daemon ever run?)",
+              file=sys.stderr)
+        return 1
+    ledger = HealthLedger(DB(path))
+    since = _time.time() - args.since_hours * 3600.0
+    component = args.component or None
+    transitions = ledger.history(
+        component=component, since=since, limit=args.limit
+    )
+    availability = ledger.availability_all()
+    if getattr(args, "as_json", False):
+        print(json.dumps(
+            {"transitions": transitions, "availability": availability},
+            indent=2, sort_keys=True,
+        ))
+        return 0
+    if not transitions:
+        print(f"no transitions in the last {args.since_hours:g}h")
+    else:
+        comp_w = max(len(t["component"]) for t in transitions)
+        for t in transitions:
+            when = datetime.fromtimestamp(t["time"]).strftime("%Y-%m-%d %H:%M:%S")
+            line = (f"  {when}  {t['component']:<{comp_w}}  "
+                    f"{t['from']} → {t['to']}")
+            if t["reason"]:
+                line += f"  ({t['reason']})"
+            print(line)
+    rows = sorted(availability.items())
+    if component:
+        rows = [(c, av) for c, av in rows if c == component]
+    if rows:
+        print()
+        comp_w = max(len(c) for c, _ in rows)
+        for c, av in rows:
+            flap = "  FLAPPING" if ledger.is_flapping(c) else ""
+            print(f"  {c:<{comp_w}}  {av['state']:<11}  "
+                  f"availability {av['ratio'] * 100:6.2f}% "
+                  f"over {av['window_seconds'] / 3600:g}h{flap}")
     return 0
 
 
@@ -655,6 +727,19 @@ def build_parser() -> argparse.ArgumentParser:
     pm = sub.add_parser("metadata", help="dump the metadata table")
     _add_common_flags(pm)
     pm.set_defaults(fn=cmd_metadata)
+
+    phy = sub.add_parser(
+        "history", help="health-transition timeline + availability from the ledger"
+    )
+    _add_common_flags(phy)
+    phy.add_argument("--component", default="", help="filter to one component")
+    phy.add_argument("--since-hours", type=float, default=24.0,
+                     help="lookback window in hours (default 24)")
+    phy.add_argument("--limit", type=int, default=256,
+                     help="max transitions to show (0 = all)")
+    phy.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable timeline + availability")
+    phy.set_defaults(fn=cmd_history)
 
     pmi = sub.add_parser("machine-info", help="print machine info JSON")
     pmi.add_argument("--accelerator-type", default="")

@@ -126,6 +126,24 @@ class Client:
         data = self._req("GET", "/v1/metrics", params=params or None)
         return [ComponentMetrics.from_dict(d) for d in data]
 
+    def get_state_history(
+        self,
+        component: str = "",
+        since: Optional[float] = None,
+        limit: Optional[int] = None,
+    ) -> Dict:
+        """Persisted health-transition timeline (``/v1/states/history``):
+        ``{"transitions": [...], "count": n, "flapping": [...]}`` plus an
+        ``availability`` block when a single component is requested."""
+        params: Dict = {}
+        if component:
+            params["component"] = component
+        if since is not None:
+            params["since"] = since
+        if limit is not None:
+            params["limit"] = limit
+        return self._req("GET", "/v1/states/history", params=params or None)
+
     def get_info(self, components: Optional[List[str]] = None) -> List[ComponentInfo]:
         params = {"components": ",".join(components)} if components else None
         data = self._req("GET", "/v1/info", params=params)

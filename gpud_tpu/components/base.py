@@ -34,6 +34,7 @@ from gpud_tpu.tracing import DEFAULT_TRACER
 
 if TYPE_CHECKING:  # avoid import cycles at runtime
     from gpud_tpu.eventstore import EventStore
+    from gpud_tpu.health_history import HealthLedger
     from gpud_tpu.host import RebootEventStore
     from gpud_tpu.tpu.instance import TPUInstance
 
@@ -113,6 +114,7 @@ class TpudInstance:
         kmsg_path: str = "",
         failure_injector: Optional[FailureInjector] = None,
         config=None,
+        health_ledger: Optional["HealthLedger"] = None,
     ) -> None:
         self.machine_id = machine_id
         self.tpu_instance = tpu_instance
@@ -126,6 +128,9 @@ class TpudInstance:
         self.kmsg_path = kmsg_path
         self.failure_injector = failure_injector
         self.config = config
+        # health-transition ledger (None in scan mode — like event_store,
+        # one-shot scans record no persistent timeline)
+        self.health_ledger = health_ledger
         # cross-component fast path: the kmsg pipeline (inotify, ~ms) calls
         # these on fabric-class catalog matches so pollers can open an
         # adaptive fast-poll window instead of waiting out their cadence
@@ -270,6 +275,14 @@ class Component:
             }
         )
         _g_last_check.set(time.time(), {"component": self.NAME})
+        ledger = getattr(self.instance, "health_ledger", None)
+        if ledger is not None:
+            try:
+                annotations = ledger.observe(self.NAME, cr.health, cr.reason)
+                if annotations:
+                    cr.extra_info.update(annotations)
+            except Exception:  # noqa: BLE001 — accounting must not fail checks
+                logger.exception("health ledger observe failed for %s", self.NAME)
         self._last_check_duration = duration
         with self._last_mu:
             self._last_check_result = cr

@@ -152,7 +152,8 @@ class LibraryComponent(PollingComponent):
 
 
 # ---------------------------------------------------------------------------
-_g_latency = gauge("tpud_network_latency_ms", "RTT to edge targets")
+# base units on the wire (metrics_lint enforces this): seconds, not ms
+_g_latency = gauge("tpud_network_latency_seconds", "RTT to edge targets")
 
 
 class NetworkLatencyComponent(PollingComponent):
@@ -173,7 +174,7 @@ class NetworkLatencyComponent(PollingComponent):
         for name, rtt in self.measure_fn().items():
             if rtt is not None:
                 rtts[name] = rtt
-                _g_latency.set(rtt, {"component": self.NAME, "target": name})
+                _g_latency.set(rtt / 1000.0, {"component": self.NAME, "target": name})
         if not rtts:
             return CheckResult(
                 self.NAME,

@@ -19,6 +19,9 @@ DEFAULT_POLL_INTERVAL = 60               # 1m component cadence
 DEFAULT_SCRAPE_INTERVAL = 60             # 1m metrics syncer
 DEFAULT_RECORDER_INTERVAL = 15 * 60      # 15m self-metrics recorder
 DEFAULT_SESSION_PIPE_INTERVAL = 3        # 3s (reference: server.go:616)
+DEFAULT_HEALTH_FLAP_THRESHOLD = 5        # transitions within the flap window
+DEFAULT_HEALTH_FLAP_WINDOW = 600         # 10m flap-detection window
+DEFAULT_HEALTH_AVAILABILITY_WINDOW = 3600  # 1h rolling availability window
 
 STATE_FILE = "tpud.state"                # reference: default.go:137-157 (gpud.state)
 FIFO_FILE = "tpud.fifo"
@@ -48,6 +51,10 @@ class Config:
     db_in_memory: bool = False           # reference: pkg/server/server.go:132-154
     metrics_retention_seconds: int = DEFAULT_METRICS_RETENTION
     events_retention_seconds: int = DEFAULT_EVENTS_RETENTION
+    # health-transition ledger tuning (docs/observability.md)
+    health_flap_threshold: int = DEFAULT_HEALTH_FLAP_THRESHOLD
+    health_flap_window_seconds: int = DEFAULT_HEALTH_FLAP_WINDOW
+    health_availability_window_seconds: int = DEFAULT_HEALTH_AVAILABILITY_WINDOW
     poll_interval_seconds: int = DEFAULT_POLL_INTERVAL
     scrape_interval_seconds: int = DEFAULT_SCRAPE_INTERVAL
     compact_period_seconds: int = 0      # 0 = disabled (reference default)
@@ -103,6 +110,12 @@ class Config:
             return "metrics retention must be >= 60s"
         if self.events_retention_seconds < 60:
             return "events retention must be >= 60s"
+        if self.health_flap_threshold < 2:
+            return "health flap threshold must be >= 2"
+        if self.health_flap_window_seconds < 60:
+            return "health flap window must be >= 60s"
+        if self.health_availability_window_seconds < 60:
+            return "health availability window must be >= 60s"
         return None
 
 

@@ -15,9 +15,16 @@ from typing import Dict, List, Optional
 
 from gpud_tpu.api.v1.types import Event
 from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import counter
+from gpud_tpu.retention import RetentionPurger
 from gpud_tpu.sqlite import DB
 
 logger = get_logger(__name__)
+
+_c_purged = counter(
+    "tpud_eventstore_purged_total",
+    "events deleted by the retention purger, by component",
+)
 
 
 def _row_to_event(component: str, row) -> Event:
@@ -78,8 +85,9 @@ class EventStore:
 
     One store per daemon; buckets share the table keyed by component name.
     A background purger per bucket runs at retention/5 cadence
-    (reference: database.go:85-90) — implemented as one shared thread to
-    keep thread count flat.
+    (reference: database.go:85-90) — implemented as one shared
+    ``RetentionPurger`` thread (the pattern the health ledger shares) to
+    keep thread count flat, stoppable via ``close()``.
     """
 
     def __init__(self, db: DB, retention_seconds: int = DEFAULT_RETENTION) -> None:
@@ -87,8 +95,9 @@ class EventStore:
         self.retention_seconds = retention_seconds
         self._buckets: Dict[str, Bucket] = {}
         self._mu = threading.Lock()
-        self._stop = threading.Event()
-        self._purger: Optional[threading.Thread] = None
+        self._purger = RetentionPurger(
+            "tpud-eventstore-purger", retention_seconds / 5.0, self._purge_tick
+        )
         self.time_now_fn = time.time
         db.execute(
             f"""CREATE TABLE IF NOT EXISTS {TABLE} (
@@ -163,28 +172,27 @@ class EventStore:
 
     # -- retention ---------------------------------------------------------
     def start_purger(self) -> None:
-        if self._purger is not None:
-            return
-        self._purger = threading.Thread(
-            target=self._purge_loop, name="tpud-eventstore-purger", daemon=True
-        )
         self._purger.start()
 
-    def _purge_loop(self) -> None:
-        interval = max(60.0, self.retention_seconds / 5.0)  # reference: database.go:85-90
-        while not self._stop.wait(interval):
-            cutoff = self.time_now_fn() - self.retention_seconds
-            try:
-                n = self.db.execute(
-                    f"DELETE FROM {TABLE} WHERE timestamp<?", (cutoff,)
-                ).rowcount
-                if n:
-                    logger.info("eventstore purged %d events", n)
-            except Exception:  # noqa: BLE001
-                logger.exception("eventstore purge failed")
+    def _purge_tick(self) -> None:
+        """One purge pass, per component so the purge counter attributes
+        deletions (reference cadence: database.go:85-90)."""
+        cutoff = self.time_now_fn() - self.retention_seconds
+        comps = [
+            r[0]
+            for r in self.db.query(
+                f"SELECT DISTINCT component FROM {TABLE} WHERE timestamp<?",
+                (cutoff,),
+            )
+        ]
+        total = 0
+        for comp in comps:
+            n = self._purge(comp, cutoff)
+            if n:
+                _c_purged.inc(n, {"component": comp})
+                total += n
+        if total:
+            logger.info("eventstore purged %d events", total)
 
     def close(self) -> None:
-        self._stop.set()
-        if self._purger is not None:
-            self._purger.join(timeout=2.0)
-            self._purger = None
+        self._purger.close()

@@ -16,19 +16,35 @@ import hashlib
 import os
 from typing import Optional, Tuple
 
-from cryptography.hazmat.primitives import serialization
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+# gated: the daemon imports this module transitively (update watcher →
+# installer), and a host without the cryptography package must still run —
+# only the signing entry points themselves hard-require it
+try:
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+except ImportError:  # pragma: no cover - env-dependent
+    serialization = None
+    Ed25519PrivateKey = None
+    Ed25519PublicKey = None
 
 CHUNK = 1 << 20
+
+
+def _require_crypto() -> None:
+    if serialization is None:
+        raise RuntimeError(
+            "the 'cryptography' package is required for release signing"
+        )
 
 
 # -- key generation ----------------------------------------------------------
 
 def generate_keypair() -> Tuple[bytes, bytes]:
     """Returns (private_pem, public_pem)."""
+    _require_crypto()
     priv = Ed25519PrivateKey.generate()
     priv_pem = priv.private_bytes(
         serialization.Encoding.PEM,
@@ -56,6 +72,7 @@ def write_keypair(dir_path: str, name: str) -> Tuple[str, str]:
 
 
 def _load_private(path: str) -> Ed25519PrivateKey:
+    _require_crypto()
     with open(path, "rb") as f:
         key = serialization.load_pem_private_key(f.read(), password=None)
     if not isinstance(key, Ed25519PrivateKey):
@@ -64,6 +81,7 @@ def _load_private(path: str) -> Ed25519PrivateKey:
 
 
 def _load_public(path: str) -> Ed25519PublicKey:
+    _require_crypto()
     with open(path, "rb") as f:
         key = serialization.load_pem_public_key(f.read())
     if not isinstance(key, Ed25519PublicKey):

@@ -1,0 +1,56 @@
+"""Shared retention-purge loop.
+
+One pattern for every SQLite-backed store that ages out rows (eventstore,
+health-transition ledger, …): a daemon thread that calls a purge callback
+at ``retention/5`` cadence (reference: pkg/eventstore/database.go:85-90),
+stoppable via ``close()`` so daemon shutdown never leaves a purger running
+against a closed DB.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+MIN_INTERVAL = 60.0
+
+
+class RetentionPurger:
+    """Run ``purge_fn`` every ``interval_seconds`` (floored at 60 s) on a
+    named daemon thread. ``start`` is idempotent; ``close`` stops and joins.
+    A purge callback that raises is logged and retried next tick — a
+    transient DB error must not end retention for the process's life."""
+
+    def __init__(
+        self, name: str, interval_seconds: float, purge_fn: Callable[[], None]
+    ) -> None:
+        self.name = name
+        self.interval = max(MIN_INTERVAL, float(interval_seconds))
+        self._purge_fn = purge_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name=self.name, daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._purge_fn()
+            except Exception:  # noqa: BLE001 — retention must outlive one bad tick
+                logger.exception("%s purge failed", self.name)
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None

@@ -10,7 +10,7 @@ component reads the whole kmsg ring buffer).
 from __future__ import annotations
 
 import sys
-from typing import List, Optional, TextIO
+from typing import Dict, List, Optional, TextIO
 
 from gpud_tpu.components.all import all_components
 from gpud_tpu.components.base import (
@@ -36,9 +36,13 @@ def scan(
     accelerator_type: str = "",
     failure_injector: Optional[FailureInjector] = None,
     out: TextIO = sys.stdout,
+    availability: Optional[Dict[str, Dict]] = None,
 ) -> List[CheckResult]:
     """Run every supported component's check once and print a table.
-    Returns the check results (for tests / the CLI exit code)."""
+    ``availability`` (component -> availability dict from the health
+    ledger) adds a rolling-availability column when the host has a state
+    DB with history. Returns the check results (for tests / the CLI exit
+    code)."""
     tpu = new_instance(
         failure_injector=failure_injector, accelerator_type=accelerator_type
     )
@@ -94,7 +98,9 @@ def scan(
         cr = comp.check()
         results.append(cr)
         glyph = _HEALTH_GLYPH.get(cr.health_state_type(), "?")
-        out.write(f"  {comp.name():<{name_w}}  {glyph}  {cr.summary()}\n")
+        av = (availability or {}).get(comp.name())
+        av_col = f"  [avail {av['ratio'] * 100:5.1f}%]" if av else ""
+        out.write(f"  {comp.name():<{name_w}}  {glyph}{av_col}  {cr.summary()}\n")
         for st in cr.health_states():
             if st.suggested_actions:
                 out.write(

@@ -48,6 +48,8 @@ logger = get_logger(__name__)
 
 DEFAULT_EVENTS_LOOKBACK = 3 * 3600  # /v1/events default window
 DEFAULT_METRICS_LOOKBACK = 3 * 3600
+DEFAULT_HISTORY_LOOKBACK = 24 * 3600  # /v1/states/history default window
+DEFAULT_HISTORY_LIMIT = 256
 
 # Prometheus text exposition content type (the scraper negotiates on the
 # version parameter; a bare text/plain is accepted but non-conformant)
@@ -267,6 +269,34 @@ def build_app(srv: "Server") -> web.Application:
             out.append(_self_info_entry(srv, start, now))
         return _json(out)
 
+    async def states_history(req: web.Request) -> web.Response:
+        """Persisted health-transition timeline from the ledger
+        (?component=&since=&limit=&correlationSeconds=); each transition
+        carries the eventstore events within ±correlation window."""
+        ledger = srv.health_ledger
+        component = req.query.get("component", "") or None
+        since = _qfloat(
+            req, "since", time.time() - DEFAULT_HISTORY_LOOKBACK
+        )
+        limit = int(_qfloat(req, "limit", DEFAULT_HISTORY_LIMIT))
+        if limit < 0:
+            limit = DEFAULT_HISTORY_LIMIT
+        corr = _qfloat(req, "correlationSeconds", ledger.correlation_window)
+        transitions = ledger.history(
+            component=component, since=since, limit=limit
+        )
+        ledger.annotate_with_events(transitions, window=corr)
+        out = {
+            "transitions": transitions,
+            "count": len(transitions),
+            "flapping": ledger.flapping_components(),
+        }
+        if component:
+            av = ledger.availability(component)
+            if av is not None:
+                out["availability"] = av
+        return _json(out)
+
     async def prometheus(_req: web.Request) -> web.Response:
         return web.Response(
             body=srv.metrics_registry.render_prometheus().encode("utf-8"),
@@ -275,15 +305,23 @@ def build_app(srv: "Server") -> web.Application:
 
     async def debug_traces(req: web.Request) -> web.Response:
         """Recent spans from the in-process trace ring, newest first
-        (?component= filters, ?limit= caps; see docs/observability.md)."""
+        (?component= filters, ?since= unix-ts floor, ?limit= caps; see
+        docs/observability.md). Malformed numeric params are a 400."""
         component = req.query.get("component", "") or None
         limit = int(_qfloat(req, "limit", DEFAULT_TRACES_LIMIT))
         if limit < 0:
             limit = DEFAULT_TRACES_LIMIT
+        since = _qfloat(req, "since", 0.0)
+        stats = srv.tracer.stats()
         return _json(
             {
-                "spans": srv.tracer.snapshot(component=component, limit=limit),
-                "stats": srv.tracer.stats(),
+                "spans": srv.tracer.snapshot(
+                    component=component, limit=limit, since=since
+                ),
+                "stats": stats,
+                # surfaced at the envelope level: a consumer paging the ring
+                # must see at a glance whether spans fell out under it
+                "dropped_total": stats["dropped_total"],
             }
         )
 
@@ -440,6 +478,7 @@ def build_app(srv: "Server") -> web.Application:
     r.add_get("/v1/components/trigger-tag", trigger_check)
     r.add_post("/v1/components/set-healthy", set_healthy)
     r.add_get("/v1/states", states)
+    r.add_get("/v1/states/history", states_history)
     r.add_get("/v1/events", events)
     r.add_get("/v1/metrics", metrics_v1)
     r.add_get("/v1/info", info)
@@ -477,6 +516,12 @@ def _self_info_entry(srv: "Server", start: float, now: float) -> dict:
         )
     for k, v in sqlite_mod.stats().items():
         extra[f"sqlite_{k}"] = f"{v:.6f}" if isinstance(v, float) else str(v)
+    ledger = getattr(srv, "health_ledger", None)
+    if ledger is not None:
+        summary = ledger.summary()
+        extra["health_transitions_total"] = str(summary["transitions_total"])
+        extra["health_components_tracked"] = str(summary["components_tracked"])
+        extra["health_flapping_components"] = ",".join(summary["flapping"])
     return ComponentInfo(
         component=SELF_COMPONENT,
         start_time=start,

@@ -61,6 +61,20 @@ class Server:
         )
         self.reboot_event_store = pkghost.RebootEventStore(self.event_store)
         self.reboot_event_store.record_reboot()
+        # health-transition ledger: the persistent state timeline every
+        # component check writes through (gpud_tpu/health_history.py)
+        from gpud_tpu.health_history import HealthLedger
+
+        self.health_ledger = HealthLedger(
+            self.db_rw,
+            event_store=self.event_store,
+            retention_seconds=self.config.events_retention_seconds,
+            flap_threshold=self.config.health_flap_threshold,
+            flap_window_seconds=self.config.health_flap_window_seconds,
+            availability_window_seconds=(
+                self.config.health_availability_window_seconds
+            ),
+        )
         self.machine_id = (
             self.config.machine_id
             or self.metadata.machine_id()
@@ -105,6 +119,7 @@ class Server:
             kmsg_path=self._kmsg_path,
             failure_injector=failure_injector,
             config=self.config,
+            health_ledger=self.health_ledger,
         )
         self.registry = Registry(self.tpud_instance)
         enabled = set(self.config.components_enabled)
@@ -232,6 +247,7 @@ class Server:
                     comp.start()
             self.kmsg_watcher.start()
             self.event_store.start_purger()
+            self.health_ledger.start_purger()
             self.metrics_syncer.start()
             self.self_metrics.start()
             self.package_manager.start()
@@ -324,6 +340,7 @@ class Server:
                 comp.close()
             except Exception:  # noqa: BLE001
                 logger.exception("component %s close failed", comp.name())
+        self.health_ledger.close()
         self.event_store.close()
 
     def _reapply_config_overrides(self) -> None:

@@ -124,6 +124,27 @@ class Dispatcher:
             )
         return {"events": out}
 
+    def _m_stateHistory(self, req: Dict) -> Dict:
+        """Persisted health-transition timeline from the ledger, with
+        eventstore correlation — the control-plane view of what the HTTP
+        route ``/v1/states/history`` serves locally."""
+        ledger = self.server.health_ledger
+        component = req.get("component", "") or None
+        since = float(req.get("since", time.time() - 24 * 3600))
+        limit = int(req.get("limit", 256))
+        transitions = ledger.history(component=component, since=since, limit=limit)
+        ledger.annotate_with_events(transitions)
+        out: Dict = {
+            "history": transitions,
+            "count": len(transitions),
+            "flapping": ledger.flapping_components(),
+        }
+        if component:
+            av = ledger.availability(component)
+            if av is not None:
+                out["availability"] = av
+        return out
+
     def _m_metrics(self, req: Dict) -> Dict:
         since = float(req.get("since", time.time() - 3 * 3600))
         ms = self.server.metrics_store.read(since)

@@ -185,15 +185,18 @@ class Tracer:
 
     # -- reading -----------------------------------------------------------
     def snapshot(
-        self, component: Optional[str] = None, limit: int = 0
+        self, component: Optional[str] = None, limit: int = 0, since: float = 0.0
     ) -> List[Dict]:
-        """Newest-first span dicts, optionally filtered by component."""
+        """Newest-first span dicts, optionally filtered by component and/or a
+        unix-timestamp floor on span start."""
         with self._mu:
             spans = list(self._ring)
         spans.reverse()
         out = []
         for sp in spans:
             if component and sp.component != component:
+                continue
+            if since and sp.start_unix < since:
                 continue
             out.append(sp.to_dict())
             if limit and len(out) >= limit:

@@ -32,6 +32,8 @@ def test_scan_json_schema(capsys, tmp_path, monkeypatch):
     names = [r["component"] for r in doc]
     assert "cpu" in names and "accelerator-tpu-ici" in names
     for r in doc:
+        # "availability" appears only when a prior daemon run left a
+        # health ledger in the state DB; a fresh scan has no such DB
         assert set(r) == {"component", "health", "reason", "extra_info"}
         assert r["health"] in ("Healthy", "Degraded", "Unhealthy")
         assert isinstance(r["extra_info"], dict)

@@ -29,8 +29,12 @@ MATRIX = [
     # states: filters of the wrong shape must not crash the registry walk
     ("states", {"components": 42}, "no-crash"),
     ("states", {"components": ["no-such-component"]}, "ok"),
-    # events/metrics: non-numeric since
+    # events/metrics/stateHistory: non-numeric since/limit
     ("events", {"since": "yesterday"}, "error"),
+    ("stateHistory", {"since": "yesterday"}, "error"),
+    ("stateHistory", {"limit": "lots"}, "error"),
+    ("stateHistory", {"component": "no-such-component"}, "ok"),
+    ("stateHistory", {}, "ok"),
     ("metrics", {"since": {"nested": True}}, "error"),
     ("events", {"since": float("nan")}, "no-crash"),
     # gossip carries no params; junk must be ignored

@@ -8,6 +8,8 @@ import os
 
 import pytest
 
+pytest.importorskip("cryptography")  # distsign degrades to stubs without it
+
 from gpud_tpu.release import distsign
 
 

@@ -45,3 +45,36 @@ def test_latest_events_grouped(tmp_db):
     es.bucket("b").insert(Event(time=2.0, name="eb"))
     grouped = es.latest_events(0)
     assert set(grouped) == {"a", "b"}
+
+
+def test_purge_tick_counts_deletions_per_component(tmp_db):
+    from gpud_tpu import eventstore as es_mod
+
+    es = EventStore(tmp_db, retention_seconds=100)
+    es.time_now_fn = lambda: 1000.0
+    for t in (10.0, 20.0, 950.0):
+        es.bucket("a").insert(Event(time=t, name=f"a{t}"))
+    es.bucket("b").insert(Event(time=30.0, name="b30"))
+    before_a = es_mod._c_purged.get({"component": "a"})
+    before_b = es_mod._c_purged.get({"component": "b"})
+    es._purge_tick()  # cutoff = 900
+    assert [e.name for e in es.bucket("a").get(0)] == ["a950.0"]
+    assert es.bucket("b").get(0) == []
+    assert es_mod._c_purged.get({"component": "a"}) - before_a == 2
+    assert es_mod._c_purged.get({"component": "b"}) - before_b == 1
+
+
+def test_purger_thread_starts_and_stops_cleanly(tmp_db):
+    import threading
+
+    es = EventStore(tmp_db)
+    es.start_purger()
+    es.start_purger()  # idempotent
+    names = [t.name for t in threading.enumerate()]
+    assert names.count("tpud-eventstore-purger") == 1
+    es.close()
+    assert all(
+        not t.is_alive()
+        for t in threading.enumerate()
+        if t.name == "tpud-eventstore-purger"
+    )

@@ -1,0 +1,427 @@
+"""Health-transition ledger (gpud_tpu/health_history.py): persisted
+timeline, restart reconciliation, flap detection, availability/MTTR/MTBF
+math, retention, and the HTTP/dispatch/CLI exposure paths."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.health_history import HealthLedger
+from gpud_tpu.sqlite import DB
+
+
+@pytest.fixture()
+def clock():
+    """Injectable wall clock starting at a fixed epoch."""
+    state = {"now": 1000.0}
+
+    def now():
+        return state["now"]
+
+    now.advance = lambda dt: state.__setitem__("now", state["now"] + dt)
+    now.set = lambda t: state.__setitem__("now", t)
+    return now
+
+
+def _ledger(db, clock, **kw):
+    led = HealthLedger(db, **kw)
+    led.time_now_fn = clock
+    return led
+
+
+# -- transition recording ----------------------------------------------------
+
+def test_first_observation_mints_no_transition(tmp_db, clock):
+    led = _ledger(tmp_db, clock)
+    ann = led.observe("c1", HealthStateType.HEALTHY, "ok")
+    assert ann == {}
+    assert led.history() == []
+    # repeated same-state observations stay quiet too
+    clock.advance(60)
+    led.observe("c1", HealthStateType.HEALTHY, "ok")
+    assert led.history() == []
+
+
+def test_transitions_recorded_with_from_to_reason(tmp_db, clock):
+    led = _ledger(tmp_db, clock)
+    led.observe("c1", HealthStateType.HEALTHY)
+    clock.advance(60)
+    led.observe("c1", HealthStateType.UNHEALTHY, "hbm ecc")
+    clock.advance(60)
+    led.observe("c1", HealthStateType.HEALTHY, "cleared")
+    h = led.history()  # newest first
+    assert [(t["from"], t["to"]) for t in h] == [
+        (HealthStateType.UNHEALTHY, HealthStateType.HEALTHY),
+        (HealthStateType.HEALTHY, HealthStateType.UNHEALTHY),
+    ]
+    assert h[1]["reason"] == "hbm ecc"
+    assert h[0]["component"] == "c1"
+
+
+def test_history_filters_component_since_limit(tmp_db, clock):
+    led = _ledger(tmp_db, clock)
+    for comp in ("a", "b"):
+        led.observe(comp, HealthStateType.HEALTHY)
+    clock.advance(10)
+    led.observe("a", HealthStateType.UNHEALTHY)
+    clock.advance(10)
+    led.observe("b", HealthStateType.UNHEALTHY)
+    clock.advance(10)
+    led.observe("a", HealthStateType.HEALTHY)
+    assert len(led.history()) == 3
+    assert len(led.history(component="a")) == 2
+    assert len(led.history(limit=1)) == 1
+    cutoff = clock() - 15
+    assert all(t["time"] >= cutoff for t in led.history(since=cutoff))
+    assert len(led.history(since=cutoff)) == 2
+
+
+# -- restart reconciliation --------------------------------------------------
+
+def test_restart_same_state_continues_episode_without_phantom(tmp_db, clock):
+    led1 = _ledger(tmp_db, clock)
+    led1.observe("c1", HealthStateType.UNHEALTHY, "down")
+    clock.advance(120)
+    # "restart": a fresh ledger over the same DB, same first fresh state
+    led2 = _ledger(tmp_db, clock)
+    led2.observe("c1", HealthStateType.UNHEALTHY, "still down")
+    assert led2.history() == []
+
+
+def test_restart_into_different_state_mints_exactly_one_transition(tmp_db, clock):
+    led1 = _ledger(tmp_db, clock)
+    led1.observe("c1", HealthStateType.UNHEALTHY, "down")
+    clock.advance(120)
+    led2 = _ledger(tmp_db, clock)
+    led2.observe("c1", HealthStateType.HEALTHY, "recovered while daemon was down")
+    h = led2.history()
+    assert len(h) == 1
+    assert (h[0]["from"], h[0]["to"]) == (
+        HealthStateType.UNHEALTHY, HealthStateType.HEALTHY,
+    )
+
+
+# -- flap detection ----------------------------------------------------------
+
+def test_flap_threshold_annotates_and_emits_rate_limited_warning(tmp_db, clock):
+    es = EventStore(tmp_db)
+    led = _ledger(
+        tmp_db, clock, event_store=es,
+        flap_threshold=3, flap_window_seconds=600.0,
+        flap_event_cooldown=600.0,
+    )
+    states = [HealthStateType.HEALTHY, HealthStateType.UNHEALTHY]
+    led.observe("c1", states[0])
+    anns = []
+    for i in range(1, 4):  # 3 transitions inside the window
+        clock.advance(30)
+        anns.append(led.observe("c1", states[i % 2]))
+    assert anns[0] == {} and anns[1] == {}
+    assert anns[2]["flapping"] == "true"
+    assert anns[2]["transitions_in_window"] == "3"
+    assert led.is_flapping("c1")
+    assert led.flapping_components() == ["c1"]
+    flaps = [e for e in es.bucket("c1").get(0) if e.name == "health_flapping"]
+    assert len(flaps) == 1
+    assert flaps[0].type == "Warning"
+    # more flapping inside the cooldown: annotated but NOT re-emitted
+    clock.advance(30)
+    ann = led.observe("c1", states[0])
+    assert ann["flapping"] == "true"
+    flaps = [e for e in es.bucket("c1").get(0) if e.name == "health_flapping"]
+    assert len(flaps) == 1
+    # past the cooldown a still-flapping component emits again
+    clock.advance(601)
+    for _ in range(3):
+        clock.advance(10)
+        led.observe("c1", states[0])
+        led.observe("c1", states[1])
+    flaps = [e for e in es.bucket("c1").get(0) if e.name == "health_flapping"]
+    assert len(flaps) == 2
+
+
+def test_below_threshold_never_flags(tmp_db, clock):
+    led = _ledger(tmp_db, clock, flap_threshold=5, flap_window_seconds=600.0)
+    led.observe("c1", HealthStateType.HEALTHY)
+    clock.advance(30)
+    led.observe("c1", HealthStateType.UNHEALTHY)
+    clock.advance(30)
+    ann = led.observe("c1", HealthStateType.HEALTHY)
+    assert ann == {}
+    assert not led.is_flapping("c1")
+
+
+# -- availability / MTTR / MTBF ----------------------------------------------
+
+def test_availability_matches_hand_computed_timeline(tmp_db, clock):
+    led = _ledger(tmp_db, clock)
+    clock.set(1000.0)
+    led.observe("c1", HealthStateType.HEALTHY)      # 1000: healthy
+    clock.set(1100.0)
+    led.observe("c1", HealthStateType.UNHEALTHY)    # 1100: down
+    clock.set(1400.0)
+    led.observe("c1", HealthStateType.HEALTHY)      # 1400: back
+    clock.set(1500.0)
+    # window 500s => start=1000: healthy 1000-1100 and 1400-1500 = 200/500
+    av = led.availability("c1", window_seconds=500.0)
+    assert av["observed_seconds"] == pytest.approx(500.0)
+    assert av["healthy_seconds"] == pytest.approx(200.0)
+    assert av["ratio"] == pytest.approx(0.4)
+    # window clamped to first_seen: a 10000s window observes only 500s
+    av = led.availability("c1", window_seconds=10000.0)
+    assert av["observed_seconds"] == pytest.approx(500.0)
+    assert av["ratio"] == pytest.approx(0.4)
+    # window entirely inside the outage
+    av = led.availability("c1", window_seconds=450.0)  # start=1050
+    assert av["healthy_seconds"] == pytest.approx(150.0)  # 1050-1100? no: 1400-1500 + 1050-1100
+    assert av["ratio"] == pytest.approx(150.0 / 450.0)
+    assert led.availability("unknown") is None
+
+
+def test_mttr_mtbf_from_completed_episodes(tmp_db, clock):
+    led = _ledger(tmp_db, clock)
+    clock.set(0.0)
+    led.observe("c1", HealthStateType.HEALTHY)
+    # failure 1 at t=100 repaired at t=200 (100s)
+    clock.set(100.0); led.observe("c1", HealthStateType.UNHEALTHY)
+    clock.set(200.0); led.observe("c1", HealthStateType.HEALTHY)
+    # failure 2 at t=500 repaired at t=800 (300s)
+    clock.set(500.0); led.observe("c1", HealthStateType.UNHEALTHY)
+    clock.set(800.0); led.observe("c1", HealthStateType.HEALTHY)
+    mttr, mtbf = led.mttr_mtbf("c1")
+    assert mttr == pytest.approx(200.0)   # (100+300)/2
+    assert mtbf == pytest.approx(400.0)   # failure starts 100 and 500
+    # no history at all
+    assert led.mttr_mtbf("unknown") == (None, None)
+
+
+def test_degraded_time_counts_as_unavailable(tmp_db, clock):
+    led = _ledger(tmp_db, clock)
+    clock.set(0.0)
+    led.observe("c1", HealthStateType.HEALTHY)
+    clock.set(100.0); led.observe("c1", HealthStateType.DEGRADED)
+    clock.set(200.0)
+    av = led.availability("c1", window_seconds=200.0)
+    assert av["ratio"] == pytest.approx(0.5)
+    assert av["state"] == HealthStateType.DEGRADED
+
+
+def test_purge_tick_drops_old_transitions_and_stale_last_rows(tmp_db, clock):
+    led = _ledger(tmp_db, clock, retention_seconds=1000)
+    clock.set(0.0)
+    led.observe("old", HealthStateType.HEALTHY)
+    clock.set(10.0); led.observe("old", HealthStateType.UNHEALTHY)
+    clock.set(5000.0)
+    led.observe("fresh", HealthStateType.HEALTHY)
+    clock.advance(10)
+    led.observe("fresh", HealthStateType.UNHEALTHY)
+    led._purge_tick()
+    h = led.history()
+    assert len(h) == 1 and h[0]["component"] == "fresh"
+    # the 'old' component was last updated at t=10 — aged out of LAST_TABLE
+    assert led.components() == ["fresh"]
+
+
+def test_summary_rollup(tmp_db, clock):
+    led = _ledger(tmp_db, clock, flap_threshold=2, flap_window_seconds=600.0)
+    led.observe("a", HealthStateType.HEALTHY)
+    led.observe("b", HealthStateType.HEALTHY)
+    clock.advance(10)
+    led.observe("a", HealthStateType.UNHEALTHY)
+    clock.advance(10)
+    led.observe("a", HealthStateType.HEALTHY)
+    s = led.summary()
+    assert s["transitions_total"] == 2
+    assert s["components_tracked"] == 2
+    assert s["flapping"] == ["a"]
+
+
+def test_event_correlation_annotates_transitions(tmp_db, clock):
+    from gpud_tpu.api.v1.types import Event, EventType
+
+    es = EventStore(tmp_db)
+    led = _ledger(tmp_db, clock, event_store=es, correlation_window_seconds=60.0)
+    led.observe("c1", HealthStateType.HEALTHY)
+    clock.set(1200.0)
+    es.bucket("c1").insert(Event(
+        component="c1", time=1190.0, name="tpu_thermal_warning",
+        type=EventType.WARNING, message="near the flip",
+    ))
+    es.bucket("c1").insert(Event(
+        component="c1", time=500.0, name="unrelated",
+        type=EventType.INFO, message="far away",
+    ))
+    led.observe("c1", HealthStateType.UNHEALTHY, "overheated")
+    h = led.annotate_with_events(led.history())
+    assert [e["name"] for e in h[0]["events"]] == ["tpu_thermal_warning"]
+
+
+# -- live HTTP exposure -------------------------------------------------------
+
+def _get(live_server, path):
+    return json.load(urllib.request.urlopen(live_server.base_url() + path))
+
+
+def test_states_history_route_and_filters(live_server):
+    # wait for the first cpu check so the ledger tracks the component
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if "cpu" in live_server.health_ledger.components():
+            break
+        time.sleep(0.1)
+    out = _get(live_server, "/v1/states/history")
+    assert set(out) >= {"transitions", "count", "flapping"}
+    assert out["count"] == len(out["transitions"])
+    out = _get(live_server, "/v1/states/history?component=cpu&limit=5")
+    assert all(t["component"] == "cpu" for t in out["transitions"])
+    assert "availability" in out  # single-component view carries the ratio
+
+
+@pytest.mark.parametrize("query", [
+    "?since=abc", "?limit=xyz", "?correlationSeconds=nope",
+])
+def test_states_history_malformed_params_are_400(live_server, query):
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            live_server.base_url() + "/v1/states/history" + query
+        )
+    assert ei.value.code == 400
+
+
+def test_debug_traces_since_filter_and_drop_count(live_server):
+    out = _get(live_server, "/v1/debug/traces")
+    assert out["dropped_total"] == out["stats"]["dropped_total"]
+    assert out["spans"], "daemon must have traced something by now"
+    # a since floor in the future filters everything out
+    future = time.time() + 3600
+    out = _get(live_server, f"/v1/debug/traces?since={future}")
+    assert out["spans"] == []
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            live_server.base_url() + "/v1/debug/traces?since=bogus"
+        )
+    assert ei.value.code == 400
+
+
+def test_info_rollup_carries_ledger_summary(live_server):
+    info = _get(live_server, "/v1/info")
+    self_entry = [i for i in info if i["component"] == "tpud-self"][0]
+    extra = self_entry["info"]["states"][0]["extra_info"]
+    assert "health_transitions_total" in extra
+    assert int(extra["health_components_tracked"]) >= 1
+
+
+def test_sdk_get_state_history(live_server):
+    from gpud_tpu.client.v1 import Client
+
+    c = Client(base_url=live_server.base_url())
+    out = c.get_state_history(limit=10)
+    assert set(out) >= {"transitions", "count", "flapping"}
+
+
+# -- acceptance: restart-spanning timeline ------------------------------------
+
+def _cfg(tmp_path, **kw):
+    from gpud_tpu.config import default_config
+
+    kmsg = tmp_path / "kmsg"
+    kmsg.touch()
+    return default_config(
+        data_dir=str(tmp_path / "data"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        components_disabled=["network-latency"],
+        **kw,
+    )
+
+
+def _wait_health(srv, name, want, timeout=10):
+    comp = srv.registry.get(name)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        states = comp.last_health_states()
+        if states and states[0].health == want:
+            return states[0]
+        time.sleep(0.1)
+    raise AssertionError(f"{name} never reached {want}: {states}")
+
+
+def test_healthy_unhealthy_healthy_across_restart_is_two_transitions(
+    tmp_path, capsys
+):
+    """The PR's acceptance scenario: Healthy → Unhealthy (daemon 1) →
+    restart → Unhealthy continues (no phantom) → set-healthy → Healthy
+    (daemon 2) yields exactly two persisted transitions, visible over
+    HTTP, session dispatch, and the CLI."""
+    from gpud_tpu.fault_injector import Request as InjectRequest
+    from gpud_tpu.server.server import Server
+    from gpud_tpu.session.dispatch import Dispatcher
+
+    name = "accelerator-tpu-error-kmsg"
+    s1 = Server(config=_cfg(tmp_path))
+    s1.start()
+    try:
+        _wait_health(s1, name, HealthStateType.HEALTHY)
+        assert s1.fault_injector.inject(
+            InjectRequest(tpu_error_name="tpu_hbm_ecc_uncorrectable", chip_id=2)
+        ) is None
+        _wait_health(s1, name, HealthStateType.UNHEALTHY)
+    finally:
+        s1.stop()
+
+    s2 = Server(config=_cfg(tmp_path))
+    s2.start()
+    try:
+        # restart reconciliation: the component comes back Unhealthy from
+        # persisted events — same state, so still ONE transition on record
+        _wait_health(s2, name, HealthStateType.UNHEALTHY)
+        h = s2.health_ledger.history(component=name)
+        assert len(h) == 1, h
+        comp = s2.registry.get(name)
+        comp.set_healthy()
+        comp.check()
+        _wait_health(s2, name, HealthStateType.HEALTHY)
+        h = s2.health_ledger.history(component=name)
+        assert len(h) == 2, h
+        assert (h[1]["from"], h[1]["to"]) == (
+            HealthStateType.HEALTHY, HealthStateType.UNHEALTHY,
+        )
+        assert (h[0]["from"], h[0]["to"]) == (
+            HealthStateType.UNHEALTHY, HealthStateType.HEALTHY,
+        )
+        # HTTP view
+        out = _get(s2, f"/v1/states/history?component={name}")
+        assert out["count"] == 2
+        assert out["availability"]["state"] == HealthStateType.HEALTHY
+        assert 0.0 < out["availability"]["ratio"] <= 1.0
+        # correlation: the transition into Unhealthy carries the kmsg event
+        into_fail = [
+            t for t in out["transitions"]
+            if t["to"] == HealthStateType.UNHEALTHY
+        ][0]
+        assert any(
+            e["name"] == "tpu_hbm_ecc_uncorrectable" for e in into_fail["events"]
+        )
+        # session dispatch view
+        resp = Dispatcher(s2)({"method": "stateHistory", "component": name})
+        assert resp["count"] == 2
+    finally:
+        s2.stop()
+
+    # CLI view works against the state DB with the daemon down
+    from gpud_tpu.cli import main as cli_main
+
+    rc = cli_main([
+        "history", "--data-dir", str(tmp_path / "data"),
+        "--component", name, "--json",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert len(out["transitions"]) == 2
+    assert name in out["availability"]

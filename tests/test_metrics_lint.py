@@ -1,6 +1,9 @@
 """CI gate over the metric registry: every registered metric carries the
-``tpud_`` prefix and non-empty help text (gpud_tpu/tools/metrics_lint.py).
-New instrumentation that forgets either fails here, not in production."""
+``tpud_`` prefix, non-empty help text, Prometheus unit conventions
+(counters end ``_total``, durations in base seconds, histograms carry a
+base unit), and no reserved label names
+(gpud_tpu/tools/metrics_lint.py). New instrumentation that forgets any of
+these fails here, not in production."""
 
 from gpud_tpu.metrics.registry import DEFAULT_REGISTRY, Registry
 from gpud_tpu.tools import metrics_lint
@@ -35,3 +38,53 @@ def test_every_daemon_metric_passes_lint():
 
 def test_lint_cli_exit_code():
     assert metrics_lint.main() == 0
+
+
+def test_lint_counter_must_end_total():
+    r = Registry()
+    r.counter("tpud_things", "counted things")
+    assert metrics_lint.lint_registry(r) == [
+        "tpud_things: counter must end in '_total'"
+    ]
+
+
+def test_lint_histogram_must_carry_base_unit():
+    r = Registry()
+    r.histogram("tpud_request_latency", "no unit in the name")
+    problems = metrics_lint.lint_registry(r)
+    assert len(problems) == 1
+    assert "base unit suffix" in problems[0]
+    clean = Registry()
+    clean.histogram("tpud_latency_seconds", "time")
+    clean.histogram("tpud_payload_bytes", "size")
+    assert metrics_lint.lint_registry(clean) == []
+
+
+def test_lint_rejects_non_base_time_units():
+    r = Registry()
+    r.gauge("tpud_rtt_ms", "milliseconds are not a base unit")
+    r.counter("tpud_wait_minutes_total", "neither are minutes")
+    problems = sorted(metrics_lint.lint_registry(r))
+    assert len(problems) == 2
+    assert "'_ms'" in problems[0]
+    assert "'_minutes'" in problems[1]
+    # gauges that merely END in _total (cumulative-seconds mirrors) pass
+    clean = Registry()
+    clean.gauge("tpud_sqlite_select_seconds_total", "cumulative seconds")
+    assert metrics_lint.lint_registry(clean) == []
+
+
+def test_lint_rejects_reserved_label_names():
+    r = Registry()
+    g = r.gauge("tpud_bad_labels", "uses reserved labels")
+    g.set(1.0, {"le": "0.5"})
+    g.set(2.0, {"__internal": "x"})
+    problems = sorted(metrics_lint.lint_registry(r))
+    assert len(problems) == 2
+    assert "'__internal'" in problems[0]
+    assert "'le'" in problems[1]
+    # a histogram's self-minted per-bucket 'le' must NOT trip the rule
+    clean = Registry()
+    h = clean.histogram("tpud_ok_seconds", "fine")
+    h.observe(0.1, {"component": "c"})
+    assert metrics_lint.lint_registry(clean) == []

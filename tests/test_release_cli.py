@@ -1,3 +1,7 @@
+import pytest
+
+pytest.importorskip("cryptography")  # distsign degrades to stubs without it
+
 from gpud_tpu.cli import main
 from gpud_tpu.release import distsign
 

@@ -42,9 +42,9 @@ def test_purger_deletes_beyond_retention(tmp_path):
     b.insert(Event(component="c", time=now - 10, name="fresh"))
 
     stopper = OneShotStop()
-    store._stop = stopper
+    store._purger._stop = stopper
     store.time_now_fn = lambda: now
-    store._purge_loop()
+    store._purger._loop()
     # interval honors the retention/5 contract with the 60s floor
     assert stopper.waits[0] == max(60.0, 1000.0 / 5.0)
     names = [e.name for e in b.get(0)]
@@ -67,14 +67,14 @@ def test_purge_loop_survives_db_failure(tmp_path):
     db = DB(str(tmp_path / "s.db"))
     store = EventStore(db, retention_seconds=1000.0)
     stopper = OneShotStop()
-    store._stop = stopper
+    store._purger._stop = stopper
 
     class BoomDB:
         def execute(self, *a, **k):
             raise RuntimeError("disk full")
 
     store.db = BoomDB()
-    store._purge_loop()  # logs, does not raise
+    store._purger._loop()  # logs, does not raise
     assert len(stopper.waits) == 2
     db.close()
 

@@ -16,6 +16,8 @@ import threading
 
 import pytest
 
+pytest.importorskip("cryptography")  # distsign degrades to stubs without it
+
 from gpud_tpu.release import distsign
 from gpud_tpu.update import EXIT_CODE_UPDATE, VersionFileWatcher, write_target_version
 from gpud_tpu.update_install import (
