@@ -324,6 +324,62 @@ def cmd_history(args) -> int:
     return 0
 
 
+def cmd_remediation(args) -> int:
+    """Remediation audit ledger: what was diagnosed, what the policy
+    decided, what ran. Reads the state DB directly (WAL mode), daemon up
+    or not — the offline analog of ``GET /v1/remediation/audit``."""
+    import os
+    import time as _time
+    from datetime import datetime
+
+    from gpud_tpu.remediation.audit import AuditStore
+    from gpud_tpu.sqlite import DB
+
+    cfg = _build_config(args)
+    path = cfg.state_file()
+    if not os.path.isfile(path):
+        print(f"no state DB at {path} (has the daemon ever run?)",
+              file=sys.stderr)
+        return 1
+    store = AuditStore(DB(path))
+    since = _time.time() - args.since_hours * 3600.0
+    attempts = store.read(
+        component=args.component or None,
+        action=args.action or None,
+        outcome=args.outcome or None,
+        since=since,
+        limit=args.limit,
+    )
+    summary = store.summary()
+    if getattr(args, "as_json", False):
+        print(json.dumps(
+            {"attempts": attempts, "summary": summary},
+            indent=2, sort_keys=True,
+        ))
+        return 0
+    if not attempts:
+        print(f"no remediation attempts in the last {args.since_hours:g}h")
+    else:
+        comp_w = max(len(a["component"]) for a in attempts)
+        act_w = max(len(a["action"]) for a in attempts)
+        for a in attempts:
+            when = datetime.fromtimestamp(a["time"]).strftime(
+                "%Y-%m-%d %H:%M:%S"
+            )
+            line = (f"  {when}  {a['component']:<{comp_w}}  "
+                    f"{a['action']:<{act_w}}  {a['outcome']}")
+            if a["detail"]:
+                line += f"  ({a['detail']})"
+            print(line)
+    if summary["by_outcome"]:
+        print()
+        parts = ", ".join(
+            f"{k}={v}" for k, v in sorted(summary["by_outcome"].items())
+        )
+        print(f"  total {summary['attempts_total']}  ({parts})")
+    return 0
+
+
 def cmd_machine_info(args) -> int:
     from gpud_tpu.machine_info import get_machine_info
     from gpud_tpu.tpu.instance import new_instance
@@ -740,6 +796,24 @@ def build_parser() -> argparse.ArgumentParser:
     phy.add_argument("--json", action="store_true", dest="as_json",
                      help="machine-readable timeline + availability")
     phy.set_defaults(fn=cmd_history)
+
+    prm = sub.add_parser(
+        "remediation",
+        help="remediation audit ledger: policy decisions and repair attempts",
+    )
+    _add_common_flags(prm)
+    prm.add_argument("--component", default="", help="filter to one component")
+    prm.add_argument("--action", default="",
+                     help="filter by action (e.g. reboot_system)")
+    prm.add_argument("--outcome", default="",
+                     help="filter by outcome (e.g. dry_run, executed)")
+    prm.add_argument("--since-hours", type=float, default=24.0,
+                     help="lookback window in hours (default 24)")
+    prm.add_argument("--limit", type=int, default=256,
+                     help="max attempts to show (0 = all)")
+    prm.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable attempts + summary")
+    prm.set_defaults(fn=cmd_remediation)
 
     pmi = sub.add_parser("machine-info", help="print machine info JSON")
     pmi.add_argument("--accelerator-type", default="")

@@ -144,6 +144,38 @@ class Client:
             params["limit"] = limit
         return self._req("GET", "/v1/states/history", params=params or None)
 
+    def get_remediation_audit(
+        self,
+        component: str = "",
+        action: str = "",
+        outcome: str = "",
+        since: Optional[float] = None,
+        limit: Optional[int] = None,
+    ) -> Dict:
+        """Remediation audit ledger (``/v1/remediation/audit``):
+        ``{"attempts": [...], "count": n, "status": {...}}``."""
+        params: Dict = {}
+        for k, v in (
+            ("component", component), ("action", action), ("outcome", outcome)
+        ):
+            if v:
+                params[k] = v
+        if since is not None:
+            params["since"] = since
+        if limit is not None:
+            params["limit"] = limit
+        return self._req("GET", "/v1/remediation/audit", params=params or None)
+
+    def get_remediation_policy(self) -> Dict:
+        """Current remediation policy + guard state."""
+        return self._req("GET", "/v1/remediation/policy")
+
+    def set_remediation_policy(self, policy: Dict) -> Dict:
+        """Partial policy update (``POST /v1/remediation/policy``) — e.g.
+        ``{"enforce_actions": ["restart_runtime"]}`` graduates an action
+        out of dry-run."""
+        return self._req("POST", "/v1/remediation/policy", body=policy)
+
     def get_info(self, components: Optional[List[str]] = None) -> List[ComponentInfo]:
         params = {"components": ",".join(components)} if components else None
         data = self._req("GET", "/v1/info", params=params)

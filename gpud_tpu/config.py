@@ -22,6 +22,14 @@ DEFAULT_SESSION_PIPE_INTERVAL = 3        # 3s (reference: server.go:616)
 DEFAULT_HEALTH_FLAP_THRESHOLD = 5        # transitions within the flap window
 DEFAULT_HEALTH_FLAP_WINDOW = 600         # 10m flap-detection window
 DEFAULT_HEALTH_AVAILABILITY_WINDOW = 3600  # 1h rolling availability window
+DEFAULT_REMEDIATION_INTERVAL = 30        # remediation scan cadence
+DEFAULT_REMEDIATION_COOLDOWN = 300       # per-component attempt cooldown
+DEFAULT_REMEDIATION_RATE_CAPACITY = 6    # token-bucket burst
+DEFAULT_REMEDIATION_RATE_REFILL = 600    # one token back per 10m
+DEFAULT_REMEDIATION_MAX_REBOOTS = 2      # reboots allowed inside the window
+DEFAULT_REMEDIATION_REBOOT_WINDOW = 3600
+DEFAULT_REMEDIATION_ESCALATION_THRESHOLD = 3  # failed soft repairs => escalate
+DEFAULT_REMEDIATION_ESCALATION_WINDOW = 3600
 
 STATE_FILE = "tpud.state"                # reference: default.go:137-157 (gpud.state)
 FIFO_FILE = "tpud.fifo"
@@ -55,6 +63,24 @@ class Config:
     health_flap_threshold: int = DEFAULT_HEALTH_FLAP_THRESHOLD
     health_flap_window_seconds: int = DEFAULT_HEALTH_FLAP_WINDOW
     health_availability_window_seconds: int = DEFAULT_HEALTH_AVAILABILITY_WINDOW
+    # remediation engine (docs/remediation.md). Enabled by default but
+    # deny-by-default: with an empty enforce list every suggested action is
+    # decided dry_run and nothing mutates the host.
+    remediation_enabled: bool = True
+    remediation_interval_seconds: int = DEFAULT_REMEDIATION_INTERVAL
+    remediation_enforce_actions: List[str] = field(default_factory=list)
+    remediation_cooldown_seconds: int = DEFAULT_REMEDIATION_COOLDOWN
+    remediation_rate_capacity: int = DEFAULT_REMEDIATION_RATE_CAPACITY
+    remediation_rate_refill_seconds: int = DEFAULT_REMEDIATION_RATE_REFILL
+    remediation_max_reboots: int = DEFAULT_REMEDIATION_MAX_REBOOTS
+    remediation_reboot_window_seconds: int = DEFAULT_REMEDIATION_REBOOT_WINDOW
+    remediation_escalation_threshold: int = (
+        DEFAULT_REMEDIATION_ESCALATION_THRESHOLD
+    )
+    remediation_escalation_window_seconds: int = (
+        DEFAULT_REMEDIATION_ESCALATION_WINDOW
+    )
+    remediation_runtime_unit: str = ""   # empty = tpu-runtime.service
     poll_interval_seconds: int = DEFAULT_POLL_INTERVAL
     scrape_interval_seconds: int = DEFAULT_SCRAPE_INTERVAL
     compact_period_seconds: int = 0      # 0 = disabled (reference default)
@@ -116,6 +142,32 @@ class Config:
             return "health flap window must be >= 60s"
         if self.health_availability_window_seconds < 60:
             return "health availability window must be >= 60s"
+        if self.remediation_interval_seconds < 1:
+            return "remediation interval must be >= 1s"
+        if self.remediation_cooldown_seconds < 0:
+            return "remediation cooldown must be >= 0s"
+        if self.remediation_rate_capacity < 1:
+            return "remediation rate capacity must be >= 1"
+        if self.remediation_rate_refill_seconds < 1:
+            return "remediation rate refill must be >= 1s"
+        if self.remediation_max_reboots < 1:
+            return "remediation max reboots must be >= 1"
+        if self.remediation_reboot_window_seconds < 60:
+            return "remediation reboot window must be >= 60s"
+        if self.remediation_escalation_threshold < 1:
+            return "remediation escalation threshold must be >= 1"
+        if self.remediation_escalation_window_seconds < 60:
+            return "remediation escalation window must be >= 60s"
+        from gpud_tpu.remediation.policy import EXECUTABLE_ACTIONS
+
+        unknown = sorted(
+            set(self.remediation_enforce_actions) - set(EXECUTABLE_ACTIONS)
+        )
+        if unknown:
+            return (
+                f"unknown remediation enforce action(s) {unknown}; "
+                f"known: {list(EXECUTABLE_ACTIONS)}"
+            )
         return None
 
 

@@ -1,0 +1,196 @@
+"""Remediation audit ledger: every repair attempt, persisted.
+
+Auto-repair is only operable when every decision leaves a durable trail:
+what the trigger was, what the policy decided, what actually ran, and how
+it went. One append-only SQLite table (schema versioned like the
+eventstore/health-ledger tables), purged past retention by the shared
+``RetentionPurger``; the CLI opens a second store over the same state file
+(daemon running or not, WAL mode) for the offline ``tpud remediation``
+view.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import counter
+from gpud_tpu.retention import RetentionPurger
+from gpud_tpu.sqlite import DB
+
+logger = get_logger(__name__)
+
+TABLE = "tpud_remediation_audit_v0_1"
+
+DEFAULT_RETENTION = 14 * 86400  # matches the eventstore window
+
+_c_purged = counter(
+    "tpud_remediation_audit_purged_total",
+    "remediation audit rows deleted by the retention purger",
+)
+
+
+class AuditStore:
+    """Append-only remediation attempt ledger over the shared state DB."""
+
+    def __init__(
+        self, db: DB, retention_seconds: int = DEFAULT_RETENTION
+    ) -> None:
+        self.db = db
+        self.retention_seconds = retention_seconds
+        self.time_now_fn = time.time
+        db.execute(
+            f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+                id INTEGER PRIMARY KEY AUTOINCREMENT,
+                timestamp REAL NOT NULL,
+                component TEXT NOT NULL,
+                action TEXT NOT NULL,
+                suggested TEXT NOT NULL,
+                trigger_health TEXT NOT NULL,
+                trigger_reason TEXT,
+                decision TEXT NOT NULL,
+                outcome TEXT NOT NULL,
+                detail TEXT,
+                duration_seconds REAL NOT NULL DEFAULT 0
+            )"""
+        )
+        db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_comp_ts "
+            f"ON {TABLE} (component, timestamp)"
+        )
+        self._purger = RetentionPurger(
+            "tpud-remediation-audit-purger",
+            retention_seconds / 5.0,
+            self._purge_tick,
+        )
+
+    # -- write path --------------------------------------------------------
+    def record(
+        self,
+        component: str,
+        action: str,
+        suggested: str,
+        trigger_health: str,
+        trigger_reason: str,
+        decision: str,
+        outcome: str,
+        detail: str = "",
+        duration_seconds: float = 0.0,
+        ts: Optional[float] = None,
+    ) -> None:
+        self.db.execute(
+            f"INSERT INTO {TABLE} (timestamp, component, action, suggested, "
+            "trigger_health, trigger_reason, decision, outcome, detail, "
+            "duration_seconds) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
+            (
+                self.time_now_fn() if ts is None else ts,
+                component,
+                action,
+                suggested,
+                trigger_health,
+                trigger_reason or "",
+                decision,
+                outcome,
+                detail or "",
+                duration_seconds,
+            ),
+        )
+
+    # -- read path ---------------------------------------------------------
+    def read(
+        self,
+        component: Optional[str] = None,
+        action: Optional[str] = None,
+        outcome: Optional[str] = None,
+        since: float = 0.0,
+        limit: int = 0,
+    ) -> List[Dict]:
+        """Attempt rows, newest first."""
+        sql = (
+            f"SELECT timestamp, component, action, suggested, trigger_health, "
+            f"trigger_reason, decision, outcome, detail, duration_seconds "
+            f"FROM {TABLE} WHERE timestamp>=?"
+        )
+        params: list = [since]
+        for col, val in (
+            ("component", component), ("action", action), ("outcome", outcome)
+        ):
+            if val:
+                sql += f" AND {col}=?"
+                params.append(val)
+        sql += " ORDER BY timestamp DESC, id DESC"
+        if limit:
+            sql += " LIMIT ?"
+            params.append(limit)
+        return [
+            {
+                "time": r[0],
+                "component": r[1],
+                "action": r[2],
+                "suggested": r[3],
+                "trigger_health": r[4],
+                "trigger_reason": r[5] or "",
+                "decision": r[6],
+                "outcome": r[7],
+                "detail": r[8] or "",
+                "duration_seconds": r[9],
+            }
+            for r in self.db.query(sql, params)
+        ]
+
+    def last_attempt_time(self, component: str) -> Optional[float]:
+        """Newest audit row for the component — the cooldown anchor."""
+        row = self.db.query_one(
+            f"SELECT MAX(timestamp) FROM {TABLE} WHERE component=?",
+            (component,),
+        )
+        return row[0] if row and row[0] is not None else None
+
+    def count(
+        self,
+        component: Optional[str] = None,
+        action: Optional[str] = None,
+        outcomes: Optional[List[str]] = None,
+        since: float = 0.0,
+    ) -> int:
+        sql = f"SELECT COUNT(*) FROM {TABLE} WHERE timestamp>=?"
+        params: list = [since]
+        if component:
+            sql += " AND component=?"
+            params.append(component)
+        if action:
+            sql += " AND action=?"
+            params.append(action)
+        if outcomes:
+            sql += f" AND outcome IN ({','.join('?' * len(outcomes))})"
+            params.extend(outcomes)
+        row = self.db.query_one(sql, params)
+        return int(row[0]) if row else 0
+
+    def summary(self) -> Dict:
+        """Rollup for status views: total rows + per-outcome counts."""
+        rows = self.db.query(
+            f"SELECT outcome, COUNT(*) FROM {TABLE} GROUP BY outcome"
+        )
+        by_outcome = {r[0]: int(r[1]) for r in rows}
+        return {
+            "attempts_total": sum(by_outcome.values()),
+            "by_outcome": by_outcome,
+        }
+
+    # -- retention ---------------------------------------------------------
+    def start_purger(self) -> None:
+        self._purger.start()
+
+    def _purge_tick(self) -> None:
+        cutoff = self.time_now_fn() - self.retention_seconds
+        n = self.db.execute(
+            f"DELETE FROM {TABLE} WHERE timestamp<?", (cutoff,)
+        ).rowcount
+        if n:
+            _c_purged.inc(n)
+            logger.info("remediation audit purged %d rows", n)
+
+    def close(self) -> None:
+        self._purger.close()

@@ -297,6 +297,63 @@ def build_app(srv: "Server") -> web.Application:
                 out["availability"] = av
         return _json(out)
 
+    async def remediation_audit(req: web.Request) -> web.Response:
+        """Remediation audit ledger: every policy decision and repair
+        attempt (?component=&action=&outcome=&since=&limit=), newest
+        first, plus the engine's guard-state rollup."""
+        eng = srv.remediation
+        if eng is None:
+            return _json({"error": "remediation engine disabled"}, 404)
+        component = req.query.get("component", "") or None
+        action = req.query.get("action", "") or None
+        outcome = req.query.get("outcome", "") or None
+        since = _qfloat(req, "since", 0.0)
+        limit = int(_qfloat(req, "limit", DEFAULT_HISTORY_LIMIT))
+        if limit < 0:
+            limit = DEFAULT_HISTORY_LIMIT
+        attempts = eng.audit.read(
+            component=component, action=action, outcome=outcome,
+            since=since, limit=limit,
+        )
+        return _json(
+            {
+                "attempts": attempts,
+                "count": len(attempts),
+                "status": eng.status(),
+            }
+        )
+
+    async def remediation_policy_get(_req: web.Request) -> web.Response:
+        """Current remediation policy and guard state (allowlist,
+        cooldown, rate limit, reboot-window, escalation)."""
+        eng = srv.remediation
+        if eng is None:
+            return _json({"error": "remediation engine disabled"}, 404)
+        return _json(eng.status())
+
+    async def remediation_policy_post(req: web.Request) -> web.Response:
+        """Update the remediation policy at runtime: partial JSON object of
+        policy fields (enforce_actions graduates an action out of
+        dry-run). Audited; invalid keys are rejected field-by-field."""
+        eng = srv.remediation
+        if eng is None:
+            return _json({"error": "remediation engine disabled"}, 404)
+        try:
+            body = await req.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _json({"error": "invalid JSON body"}, 400)
+        if not isinstance(body, dict):
+            return _json({"error": "body must be a JSON object"}, 400)
+        from gpud_tpu.log import audit as audit_log
+
+        updated, errors = eng.policy.update(body)
+        if updated:
+            audit_log("remediation_policy_update", updated=",".join(updated))
+        out = {"status": "ok" if not errors else "partial", "updated": updated}
+        if errors:
+            out["errors"] = errors
+        return _json(out, 200 if updated or not errors else 400)
+
     async def prometheus(_req: web.Request) -> web.Response:
         return web.Response(
             body=srv.metrics_registry.render_prometheus().encode("utf-8"),
@@ -479,6 +536,9 @@ def build_app(srv: "Server") -> web.Application:
     r.add_post("/v1/components/set-healthy", set_healthy)
     r.add_get("/v1/states", states)
     r.add_get("/v1/states/history", states_history)
+    r.add_get("/v1/remediation/audit", remediation_audit)
+    r.add_get("/v1/remediation/policy", remediation_policy_get)
+    r.add_post("/v1/remediation/policy", remediation_policy_post)
     r.add_get("/v1/events", events)
     r.add_get("/v1/metrics", metrics_v1)
     r.add_get("/v1/info", info)

@@ -80,6 +80,40 @@ class Server:
             or self.metadata.machine_id()
             or pkghost.machine_id()
         )
+        # remediation engine: acts (under policy) on the suggested actions
+        # the components diagnose (gpud_tpu/remediation/, docs/remediation.md)
+        from gpud_tpu.remediation.engine import RemediationEngine
+        from gpud_tpu.remediation.policy import Policy as RemediationPolicy
+
+        self.remediation: Optional[RemediationEngine] = None
+        if self.config.remediation_enabled:
+            self.remediation = RemediationEngine(
+                registry=None,  # attached below once the registry exists
+                db=self.db_rw,
+                policy=RemediationPolicy(
+                    enforce_actions=list(self.config.remediation_enforce_actions),
+                    cooldown_seconds=float(self.config.remediation_cooldown_seconds),
+                    rate_capacity=self.config.remediation_rate_capacity,
+                    rate_refill_seconds=float(
+                        self.config.remediation_rate_refill_seconds
+                    ),
+                    max_reboots=self.config.remediation_max_reboots,
+                    reboot_window_seconds=float(
+                        self.config.remediation_reboot_window_seconds
+                    ),
+                    escalation_threshold=(
+                        self.config.remediation_escalation_threshold
+                    ),
+                    escalation_window_seconds=float(
+                        self.config.remediation_escalation_window_seconds
+                    ),
+                ),
+                event_store=self.event_store,
+                reboot_event_store=self.reboot_event_store,
+                interval_seconds=float(self.config.remediation_interval_seconds),
+                audit_retention_seconds=self.config.events_retention_seconds,
+                runtime_unit=self.config.remediation_runtime_unit,
+            )
 
         # metrics pipeline (reference: server.go:223-242)
         self.metrics_registry = metrics_registry or DEFAULT_REGISTRY
@@ -131,6 +165,12 @@ class Server:
             if name in disabled:
                 continue
             self.registry.must_register(init_func)
+
+        if self.remediation is not None:
+            # the engine scans (and its soft executors act through) the
+            # fully-populated registry
+            self.remediation.registry = self.registry
+            self.remediation.executors.registry = self.registry
 
         # shared kmsg watcher: one reader feeding every kmsg-consuming
         # component (reference hot-loop #2, SURVEY §3.1)
@@ -248,6 +288,8 @@ class Server:
             self.kmsg_watcher.start()
             self.event_store.start_purger()
             self.health_ledger.start_purger()
+            if self.remediation is not None:
+                self.remediation.start()
             self.metrics_syncer.start()
             self.self_metrics.start()
             self.package_manager.start()
@@ -340,6 +382,8 @@ class Server:
                 comp.close()
             except Exception:  # noqa: BLE001
                 logger.exception("component %s close failed", comp.name())
+        if self.remediation is not None:
+            self.remediation.close()
         self.health_ledger.close()
         self.event_store.close()
 

@@ -145,6 +145,39 @@ class Dispatcher:
                 out["availability"] = av
         return out
 
+    def _m_remediationStatus(self, req: Dict) -> Dict:
+        """Remediation engine rollup for the control plane: policy + guard
+        state plus the most recent audit rows (``limit``, ``since``,
+        ``component`` filters mirror ``GET /v1/remediation/audit``)."""
+        eng = getattr(self.server, "remediation", None)
+        if eng is None:
+            return {"error": "remediation engine disabled"}
+        limit = int(req.get("limit", 32))
+        since = float(req.get("since", 0.0))
+        component = req.get("component", "") or None
+        attempts = eng.audit.read(
+            component=component, since=since, limit=limit
+        )
+        return {
+            "remediation": eng.status(),
+            "attempts": attempts,
+            "count": len(attempts),
+        }
+
+    def _m_remediationPolicy(self, req: Dict) -> Dict:
+        """Runtime remediation-policy push (same field-by-field contract
+        as updateConfig: one invalid key must not block the rest)."""
+        eng = getattr(self.server, "remediation", None)
+        if eng is None:
+            return {"error": "remediation engine disabled"}
+        updated, errors = eng.policy.update(req.get("policy", {}))
+        if updated:
+            audit("remediation_policy_update", updated=",".join(updated))
+        out: Dict = {"status": "ok", "updated": updated}
+        if errors:
+            out["errors"] = errors
+        return out
+
     def _m_metrics(self, req: Dict) -> Dict:
         since = float(req.get("since", time.time() - 3 * 3600))
         ms = self.server.metrics_store.read(since)

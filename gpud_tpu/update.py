@@ -23,6 +23,11 @@ logger = get_logger(__name__)
 
 POLL_INTERVAL = 30.0   # reference: server.go:814-832
 EXIT_CODE_UPDATE = 244 # supervisor restarts into the new version
+# failed-target backoff: a target that keeps failing to install must not be
+# re-downloaded (and re-fail-logged) every 30s poll — back off exponentially
+# until the target file changes or the backoff window lapses
+BACKOFF_INITIAL = 300.0
+BACKOFF_MAX = 4 * 3600.0
 # script invoked with TARGET_VERSION env to install the new version before
 # the restart-exit (the reference's tarball-download step, update.go:19-50)
 ENV_UPDATE_HOOK = "TPUD_UPDATE_HOOK"
@@ -81,6 +86,13 @@ class VersionFileWatcher:
                 pass
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # failed-target memo: (target, failed_at, current_backoff)
+        import time as _time
+
+        self._now = _time.time  # injectable for tests
+        self._failed_target = ""
+        self._failed_at = 0.0
+        self._backoff = 0.0
 
     def _default_on_update(self, target: str) -> None:
         """Install (hook override, else the built-in pipeline), then
@@ -99,6 +111,7 @@ class VersionFileWatcher:
                 logger.error(
                     "update hook failed (exit %d): %s", r.exit_code, r.output[-500:]
                 )
+                self._note_failure(target)
                 return
             logger.warning("update hook installed %s", target)
         elif self.installer is not None:
@@ -108,6 +121,7 @@ class VersionFileWatcher:
                     "built-in update to %s failed: %s; staying on %s",
                     target, err, self.current_version,
                 )
+                self._note_failure(target)
                 return
         else:
             if not getattr(self, "_warned_no_hook", False):
@@ -126,13 +140,33 @@ class VersionFileWatcher:
         audit("self_update_exit", target=target, current=self.current_version)
         self._exit(EXIT_CODE_UPDATE)  # noqa: SLF001 — immediate, like the reference
 
+    def _note_failure(self, target: str) -> None:
+        """Record a failed install so ``check_once`` backs off this target
+        (doubling per consecutive failure) instead of re-downloading it
+        every poll. A different target resets the memo."""
+        if target == self._failed_target and self._backoff:
+            self._backoff = min(self._backoff * 2, BACKOFF_MAX)
+        else:
+            self._failed_target = target
+            self._backoff = BACKOFF_INITIAL
+        self._failed_at = self._now()
+        logger.warning(
+            "update to %s failed; next attempt in %.0fs unless the target "
+            "changes", target, self._backoff,
+        )
+
     def check_once(self) -> bool:
         """Returns True if an update was triggered."""
         target = read_target_version(self.path)
-        if target and target != self.current_version:
-            self.on_update(target)
-            return True
-        return False
+        if not target or target == self.current_version:
+            return False
+        if (
+            target == self._failed_target
+            and self._now() - self._failed_at < self._backoff
+        ):
+            return False  # persistently failing target: in backoff
+        self.on_update(target)
+        return True
 
     def start(self) -> None:
         if self._thread is not None:

@@ -22,6 +22,7 @@ configured, this pipeline runs instead.
 from __future__ import annotations
 
 import os
+import re
 import shutil
 import tarfile
 import tempfile
@@ -44,6 +45,9 @@ PACKAGE_FMT = "tpud-{version}.tar.gz"
 SIGNING_PUB_NAME = "signing.pub"
 
 DOWNLOAD_TIMEOUT = 120.0
+# target versions ride into download URLs and filesystem paths: whitelist
+# instead of blacklisting — `?`/`#`/whitespace would alter URL semantics
+VERSION_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
 MAX_PACKAGE_BYTES = 1 << 30  # 1 GiB hard cap on any downloaded artifact
 CURRENT_LINK = "current"
 VERSIONS_DIR = "versions"
@@ -88,8 +92,18 @@ def _safe_extract(tar_path: str, dest_dir: str) -> Optional[str]:
                         return f"unsafe link in package: {name!r} -> {m.linkname!r}"
                 elif not (m.isreg() or m.isdir()):
                     return f"unsupported member type in package: {name!r}"
+            # Python 3.10.0–3.10.11 predate the filter= parameter and raise
+            # TypeError on it; the member validation above already enforces
+            # the safety properties, so plain extract is equivalent there
+            use_filter = True
             for m in tf.getmembers():
-                tf.extract(m, dest_real, set_attrs=True, filter="data")
+                if use_filter:
+                    try:
+                        tf.extract(m, dest_real, set_attrs=True, filter="data")
+                        continue
+                    except TypeError:
+                        use_filter = False
+                tf.extract(m, dest_real, set_attrs=True)
         return None
     except (tarfile.TarError, OSError) as e:
         return f"package extraction failed: {e}"
@@ -125,7 +139,13 @@ def resolve_signing_pub(
         err = _download(url, dest)
         if err:
             return "", err
-    if not distsign.verify_key(root_pub, pub_path, sig_path):
+    try:
+        endorsed = distsign.verify_key(root_pub, pub_path, sig_path)
+    except (ValueError, RuntimeError, OSError) as e:
+        # malformed PEM / missing cryptography package must surface as the
+        # documented error-string contract, not a traceback up the watcher
+        return "", f"signing key verification failed: {e}"
+    if not endorsed:
         return "", "downloaded signing key is not endorsed by the pinned root key"
     return pub_path, None
 
@@ -138,13 +158,37 @@ def install_tree(extracted_dir: str, install_dir: str, version: str) -> Optional
     os.makedirs(versions, exist_ok=True)
     final = os.path.join(versions, version)
     staging = final + f".staging-{os.getpid()}"
+    aside = final + f".old-{os.getpid()}"
+    moved_aside = False
     try:
         if os.path.exists(staging):
             shutil.rmtree(staging)
         shutil.move(extracted_dir, staging)
         if os.path.exists(final):
-            shutil.rmtree(final)
-        os.rename(staging, final)
+            # reinstall of an already-installed version: move the live tree
+            # aside instead of deleting it, so a failure between here and
+            # the rename below can roll back — `current` must point at a
+            # live tree on every path out of this function
+            if os.path.exists(aside):
+                shutil.rmtree(aside)
+            os.rename(final, aside)
+            moved_aside = True
+        try:
+            os.rename(staging, final)
+        except OSError:
+            if moved_aside:
+                try:
+                    os.rename(aside, final)
+                    moved_aside = False
+                except OSError:
+                    # leave the aside tree on disk for manual recovery —
+                    # the cleanup below must not delete the only survivor
+                    moved_aside = False
+                    logger.exception(
+                        "rollback of %s failed; previous tree left at %s",
+                        final, aside,
+                    )
+            raise
         # atomic symlink swap: build aside, replace over
         link = os.path.join(install_dir, CURRENT_LINK)
         tmp_link = link + f".tmp-{os.getpid()}"
@@ -158,6 +202,8 @@ def install_tree(extracted_dir: str, install_dir: str, version: str) -> Optional
     finally:
         if os.path.exists(staging):
             shutil.rmtree(staging, ignore_errors=True)
+        if moved_aside and os.path.exists(aside):
+            shutil.rmtree(aside, ignore_errors=True)
 
 
 def perform_update(
@@ -179,7 +225,7 @@ def perform_update(
         return "no package base URL configured"
     if not install_dir:
         return "no install dir configured"
-    if not target_version or "/" in target_version or target_version.startswith("."):
+    if not target_version or not VERSION_RE.match(target_version):
         return f"invalid target version {target_version!r}"
 
     workdir = tempfile.mkdtemp(prefix="tpud-update-")
@@ -197,7 +243,12 @@ def perform_update(
             err = _download(url, dest)
             if err:
                 return err
-        err = distsign.verify_package(pub_path, pkg_path, sig_path)
+        try:
+            err = distsign.verify_package(pub_path, pkg_path, sig_path)
+        except (ValueError, RuntimeError, OSError) as e:
+            # a corrupt/hostile PEM or an env without the cryptography
+            # package raises; keep the Optional[str] error contract
+            err = str(e)
         if err:
             audit("self_update_verify_failed", target=target_version, error=err)
             return f"package signature rejected: {err}"

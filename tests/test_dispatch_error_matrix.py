@@ -94,6 +94,16 @@ MATRIX = [
     ),
     # reboot: wrong delay type must not spawn the reboot thread
     ("reboot", {"delay_seconds": "soon"}, "error"),
+    # remediation: bad filter types error; unknown component is empty-ok;
+    # a hostile policy body surfaces per-field errors without crashing
+    ("remediationStatus", {}, "ok"),
+    ("remediationStatus", {"since": "yesterday"}, "error"),
+    ("remediationStatus", {"limit": "lots"}, "error"),
+    ("remediationStatus", {"component": "no-such-component"}, "ok"),
+    ("remediationPolicy", {}, "ok"),
+    ("remediationPolicy", {"policy": "not-a-dict"}, "no-crash"),
+    ("remediationPolicy", {"policy": {"enforce_actions": ["bogus"]}}, "no-crash"),
+    ("remediationPolicy", {"policy": {"cooldown_seconds": "forever"}}, "no-crash"),
 ]
 
 

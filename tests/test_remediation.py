@@ -1,0 +1,461 @@
+"""Remediation engine (gpud_tpu/remediation/): policy matrix — dry-run
+default, cooldown, rate limit, reboot-window guard, escalation — plus audit
+persistence across restart and the executor tier."""
+
+import pytest
+
+from gpud_tpu.api.v1.types import (
+    HealthState,
+    HealthStateType,
+    RepairActionType,
+    SuggestedActions,
+)
+from gpud_tpu.process import RunResult
+from gpud_tpu.remediation.audit import AuditStore
+from gpud_tpu.remediation.engine import RemediationEngine
+from gpud_tpu.remediation.policy import (
+    ACTION_INSPECTION,
+    ACTION_REBOOT,
+    ACTION_RESTART_RUNTIME,
+    ACTION_RETRIGGER_CHECK,
+    ACTION_SET_HEALTHY,
+    Policy,
+    TokenBucket,
+    map_suggested_action,
+)
+
+
+@pytest.fixture()
+def clock():
+    state = {"now": 1000.0}
+
+    def now():
+        return state["now"]
+
+    now.advance = lambda dt: state.__setitem__("now", state["now"] + dt)
+    now.set = lambda t: state.__setitem__("now", t)
+    return now
+
+
+class FakeComp:
+    """Just enough component surface for the engine: name, states, check,
+    set_healthy."""
+
+    def __init__(self, name, health=HealthStateType.UNHEALTHY,
+                 actions=(RepairActionType.REBOOT_SYSTEM,), reason="broken"):
+        self._name = name
+        self.checked = 0
+        self.healthy_set = 0
+        self.check_recovers = False
+        self.set_state(health, actions, reason)
+
+    def set_state(self, health, actions=(), reason=""):
+        sa = (
+            SuggestedActions(description=reason, repair_actions=list(actions))
+            if actions
+            else None
+        )
+        self.states = [
+            HealthState(
+                component=self._name, health=health, reason=reason,
+                suggested_actions=sa,
+            )
+        ]
+
+    def name(self):
+        return self._name
+
+    def last_health_states(self):
+        return list(self.states)
+
+    def check(self):
+        from gpud_tpu.components.base import CheckResult
+
+        self.checked += 1
+        if self.check_recovers:
+            self.set_state(HealthStateType.HEALTHY, (), "recovered")
+            return CheckResult(self._name, health=HealthStateType.HEALTHY)
+        return CheckResult(
+            self._name, health=HealthStateType.UNHEALTHY, reason="still broken"
+        )
+
+    def set_healthy(self):
+        self.healthy_set += 1
+        self.set_state(HealthStateType.HEALTHY, (), "cleared")
+
+
+class FakeRegistry:
+    def __init__(self, comps):
+        self.comps = list(comps)
+
+    def all(self):
+        return list(self.comps)
+
+    def get(self, name):
+        for c in self.comps:
+            if c.name() == name:
+                return c
+        return None
+
+
+class FakeRebootStore:
+    def __init__(self):
+        self.events = []  # unix timestamps
+
+    def get_reboot_events(self, since):
+        return [t for t in self.events if t >= since]
+
+
+def make_engine(tmp_db, clock, comps, soft_repairs=None, reboot_store=None,
+                run_ok=True, reboot_ok=True, **policy_kw):
+    calls = {"run": [], "reboot": 0}
+
+    def run_command_fn(argv, timeout=0, env=None):
+        calls["run"].append(argv)
+        if run_ok:
+            return RunResult(exit_code=0, output="ok")
+        return RunResult(exit_code=1, output="unit failed to restart")
+
+    def reboot_fn():
+        calls["reboot"] += 1
+        return None if reboot_ok else "reboot command failed"
+
+    eng = RemediationEngine(
+        registry=FakeRegistry(comps),
+        db=tmp_db,
+        policy=Policy(**policy_kw),
+        reboot_event_store=reboot_store,
+        soft_repairs=soft_repairs if soft_repairs is not None else {},
+        run_command_fn=run_command_fn,
+        reboot_fn=reboot_fn,
+    )
+    eng.time_now_fn = clock
+    eng.calls = calls
+    return eng
+
+
+# -- action mapping ----------------------------------------------------------
+
+def test_map_suggested_action_vocabulary():
+    assert map_suggested_action(
+        RepairActionType.IGNORE_NO_ACTION_REQUIRED, None) is None
+    assert map_suggested_action(
+        RepairActionType.CHECK_USER_APP_AND_TPU, None) == ACTION_RETRIGGER_CHECK
+    assert map_suggested_action(
+        RepairActionType.REBOOT_SYSTEM, None) == ACTION_REBOOT
+    assert map_suggested_action(
+        RepairActionType.REBOOT_SYSTEM, ACTION_RESTART_RUNTIME
+    ) == ACTION_RESTART_RUNTIME
+    assert map_suggested_action(
+        RepairActionType.HARDWARE_INSPECTION, None) == ACTION_INSPECTION
+    assert map_suggested_action("SOMETHING_NEW", None) is None
+
+
+# -- dry-run default ---------------------------------------------------------
+
+def test_default_policy_is_dry_run_and_mutates_nothing(tmp_db, clock):
+    comp = FakeComp("c1")
+    eng = make_engine(tmp_db, clock, [comp])
+    rows = eng.scan_once()
+    assert len(rows) == 1
+    assert rows[0]["action"] == ACTION_REBOOT
+    assert rows[0]["decision"] == "dry_run"
+    assert rows[0]["outcome"] == "dry_run"
+    assert rows[0]["trigger_health"] == HealthStateType.UNHEALTHY
+    assert eng.calls["reboot"] == 0 and eng.calls["run"] == []
+    # persisted, not just returned
+    assert eng.audit.read()[0]["outcome"] == "dry_run"
+
+
+def test_healthy_and_ignore_states_produce_no_rows(tmp_db, clock):
+    healthy = FakeComp("ok", health=HealthStateType.HEALTHY, actions=())
+    ignored = FakeComp(
+        "ign", actions=(RepairActionType.IGNORE_NO_ACTION_REQUIRED,)
+    )
+    eng = make_engine(tmp_db, clock, [healthy, ignored])
+    assert eng.scan_once() == []
+    assert eng.audit.read() == []
+
+
+def test_hardware_inspection_is_a_manual_marker(tmp_db, clock):
+    comp = FakeComp("c1", actions=(RepairActionType.HARDWARE_INSPECTION,))
+    eng = make_engine(tmp_db, clock, [comp])
+    rows = eng.scan_once()
+    assert rows[0]["action"] == ACTION_INSPECTION
+    assert rows[0]["decision"] == "manual"
+    assert rows[0]["outcome"] == "manual"
+    assert eng.calls["reboot"] == 0
+
+
+# -- cooldown ----------------------------------------------------------------
+
+def test_cooldown_gates_repeat_attempts_per_component(tmp_db, clock):
+    comp = FakeComp("c1")
+    eng = make_engine(tmp_db, clock, [comp], cooldown_seconds=300.0)
+    assert len(eng.scan_once()) == 1
+    clock.advance(30)
+    assert eng.scan_once() == []  # in cooldown: no new rows
+    clock.advance(300)
+    assert len(eng.scan_once()) == 1
+    assert len(eng.audit.read()) == 2
+
+
+def test_cooldown_is_per_component(tmp_db, clock):
+    eng = make_engine(
+        tmp_db, clock, [FakeComp("a"), FakeComp("b")], cooldown_seconds=300.0
+    )
+    rows = eng.scan_once()
+    assert {r["component"] for r in rows} == {"a", "b"}
+
+
+# -- allowlist / execution ---------------------------------------------------
+
+def test_allowlisted_reboot_executes_through_injected_fn(tmp_db, clock):
+    comp = FakeComp("c1")
+    eng = make_engine(tmp_db, clock, [comp], enforce_actions=[ACTION_REBOOT])
+    rows = eng.scan_once()
+    assert rows[0]["decision"] == "execute"
+    assert rows[0]["outcome"] == "executed"
+    assert eng.calls["reboot"] == 1
+
+
+def test_failed_hard_repair_is_audited_failed(tmp_db, clock):
+    comp = FakeComp("c1")
+    eng = make_engine(
+        tmp_db, clock, [comp], reboot_ok=False,
+        enforce_actions=[ACTION_REBOOT],
+    )
+    rows = eng.scan_once()
+    assert rows[0]["outcome"] == "failed"
+    assert "reboot command failed" in rows[0]["detail"]
+
+
+def test_restart_runtime_soft_repair_executes_systemctl(tmp_db, clock):
+    comp = FakeComp("accelerator-tpu-runtime")
+    eng = make_engine(
+        tmp_db, clock, [comp],
+        soft_repairs={"accelerator-tpu-runtime": ACTION_RESTART_RUNTIME},
+        enforce_actions=[ACTION_RESTART_RUNTIME],
+    )
+    rows = eng.scan_once()
+    assert rows[0]["action"] == ACTION_RESTART_RUNTIME
+    assert rows[0]["outcome"] == "executed"
+    assert eng.calls["run"] == [
+        ["systemctl", "restart", "tpu-runtime.service"]
+    ]
+    assert eng.calls["reboot"] == 0  # soft repair stands in for the reboot
+
+
+def test_retrigger_check_outcome_tracks_resulting_health(tmp_db, clock):
+    comp = FakeComp("c1", actions=(RepairActionType.CHECK_USER_APP_AND_TPU,))
+    eng = make_engine(
+        tmp_db, clock, [comp], enforce_actions=[ACTION_RETRIGGER_CHECK]
+    )
+    rows = eng.scan_once()
+    assert comp.checked == 1
+    assert rows[0]["outcome"] == "failed"  # still unhealthy after re-check
+    comp.check_recovers = True
+    clock.advance(400)
+    rows = eng.scan_once()
+    assert rows[0]["outcome"] == "executed"
+
+
+def test_set_healthy_executor(tmp_db, clock):
+    comp = FakeComp("sticky")
+    eng = make_engine(
+        tmp_db, clock, [comp],
+        soft_repairs={"sticky": ACTION_SET_HEALTHY},
+        enforce_actions=[ACTION_SET_HEALTHY],
+    )
+    rows = eng.scan_once()
+    assert rows[0]["outcome"] == "executed"
+    assert comp.healthy_set == 1
+
+
+# -- rate limit --------------------------------------------------------------
+
+def test_token_bucket_rate_limits_enforced_repairs(tmp_db, clock):
+    comps = [FakeComp("a"), FakeComp("b")]
+    eng = make_engine(
+        tmp_db, clock, comps,
+        enforce_actions=[ACTION_REBOOT],
+        rate_capacity=1, rate_refill_seconds=600.0,
+        max_reboots=10,
+    )
+    rows = eng.scan_once()
+    outcomes = {r["component"]: r["outcome"] for r in rows}
+    assert outcomes == {"a": "executed", "b": "blocked_rate_limit"}
+    assert eng.calls["reboot"] == 1
+
+
+def test_dry_run_consumes_no_tokens(tmp_db, clock):
+    comps = [FakeComp(f"c{i}") for i in range(4)]
+    eng = make_engine(tmp_db, clock, comps, rate_capacity=1)
+    rows = eng.scan_once()
+    assert [r["outcome"] for r in rows] == ["dry_run"] * 4
+
+
+def test_token_bucket_refills_over_time(clock):
+    pol = Policy(rate_capacity=2, rate_refill_seconds=100.0)
+    b = TokenBucket(pol)
+    assert b.take(1000.0) and b.take(1000.0)
+    assert not b.take(1000.0)
+    assert not b.take(1050.0)  # only half a token back
+    assert b.take(1101.0)      # one full token refilled
+
+
+# -- reboot-window guard -----------------------------------------------------
+
+def test_reboot_window_guard_blocks_second_reboot(tmp_db, clock):
+    comp = FakeComp("c1")
+    eng = make_engine(
+        tmp_db, clock, [comp],
+        enforce_actions=[ACTION_REBOOT],
+        max_reboots=1, reboot_window_seconds=3600.0,
+        cooldown_seconds=60.0, rate_capacity=10,
+    )
+    assert eng.scan_once()[0]["outcome"] == "executed"
+    clock.advance(120)  # past cooldown, inside the reboot window
+    rows = eng.scan_once()
+    assert rows[0]["outcome"] == "blocked_reboot_window"
+    assert eng.calls["reboot"] == 1
+    # outside the window the guard releases
+    clock.advance(3700)
+    assert eng.scan_once()[0]["outcome"] == "executed"
+    assert eng.calls["reboot"] == 2
+
+
+def test_reboot_window_counts_completed_reboots_from_event_store(
+    tmp_db, clock
+):
+    store = FakeRebootStore()
+    store.events = [clock() - 60]  # the node just booted
+    comp = FakeComp("c1")
+    eng = make_engine(
+        tmp_db, clock, [comp], reboot_store=store,
+        enforce_actions=[ACTION_REBOOT], max_reboots=1,
+    )
+    rows = eng.scan_once()
+    assert rows[0]["outcome"] == "blocked_reboot_window"
+    assert eng.calls["reboot"] == 0
+
+
+# -- escalation --------------------------------------------------------------
+
+def test_failed_soft_repairs_escalate_and_stop_retrying(tmp_db, clock):
+    comp = FakeComp("accelerator-tpu-runtime")
+    eng = make_engine(
+        tmp_db, clock, [comp], run_ok=False,
+        soft_repairs={"accelerator-tpu-runtime": ACTION_RESTART_RUNTIME},
+        enforce_actions=[ACTION_RESTART_RUNTIME],
+        escalation_threshold=3, escalation_window_seconds=3600.0,
+        cooldown_seconds=60.0, rate_capacity=100,
+    )
+    outs = []
+    for _ in range(3):
+        rows = eng.scan_once()
+        outs.append(rows[0]["outcome"])
+        clock.advance(120)
+    assert outs == ["failed", "failed", "escalated"]
+    assert "accelerator-tpu-runtime" in eng.status()["escalated"]
+    # escalated: no more attempts, no more audit rows
+    assert eng.scan_once() == []
+    clock.advance(600)
+    assert eng.scan_once() == []
+    assert eng.calls["reboot"] == 0  # never fell through to the hard tier
+
+
+def test_escalation_clears_when_component_recovers(tmp_db, clock):
+    comp = FakeComp("accelerator-tpu-runtime")
+    eng = make_engine(
+        tmp_db, clock, [comp], run_ok=False,
+        soft_repairs={"accelerator-tpu-runtime": ACTION_RESTART_RUNTIME},
+        enforce_actions=[ACTION_RESTART_RUNTIME],
+        escalation_threshold=1, cooldown_seconds=60.0,
+    )
+    assert eng.scan_once()[0]["outcome"] == "escalated"
+    # recovery clears the latch; a new episode gets fresh attempts
+    comp.set_state(HealthStateType.HEALTHY, (), "recovered")
+    eng.scan_once()
+    assert eng.status()["escalated"] == []
+    comp.set_state(
+        HealthStateType.UNHEALTHY, (RepairActionType.REBOOT_SYSTEM,), "again"
+    )
+    clock.advance(7200)  # outside the escalation window: counter reset
+    rows = eng.scan_once()
+    assert len(rows) == 1
+
+
+# -- audit persistence -------------------------------------------------------
+
+def test_audit_rows_survive_restart(tmp_path, clock):
+    from gpud_tpu.sqlite import DB
+
+    path = str(tmp_path / "state.db")
+    db = DB(path)
+    comp = FakeComp("c1")
+    eng = make_engine(db, clock, [comp])
+    eng.scan_once()
+    db.close()
+    # a fresh store over the same state file sees the same ledger — the
+    # restart/offline-CLI read path
+    db2 = DB(path)
+    store = AuditStore(db2)
+    rows = store.read()
+    assert len(rows) == 1
+    assert rows[0]["component"] == "c1"
+    assert rows[0]["outcome"] == "dry_run"
+    assert store.summary() == {
+        "attempts_total": 1, "by_outcome": {"dry_run": 1}
+    }
+    db2.close()
+
+
+def test_audit_filters_and_retention(tmp_db, clock):
+    store = AuditStore(tmp_db, retention_seconds=3600)
+    store.time_now_fn = clock
+    for i, outcome in enumerate(["dry_run", "executed", "failed"]):
+        store.record(
+            component=f"c{i % 2}", action="reboot_system",
+            suggested="REBOOT_SYSTEM", trigger_health="Unhealthy",
+            trigger_reason="r", decision="d", outcome=outcome,
+            ts=clock() + i,
+        )
+    assert len(store.read()) == 3
+    assert len(store.read(component="c0")) == 2
+    assert len(store.read(outcome="executed")) == 1
+    assert store.count(outcomes=["failed", "executed"]) == 2
+    assert store.read(limit=1)[0]["outcome"] == "failed"  # newest first
+    clock.advance(7200)
+    store._purge_tick()
+    assert store.read() == []
+
+
+# -- policy update contract --------------------------------------------------
+
+def test_policy_update_field_by_field():
+    pol = Policy()
+    updated, errors = pol.update(
+        {
+            "enforce_actions": ["reboot_system"],
+            "cooldown_seconds": 30,
+            "max_reboots": "nope",
+        }
+    )
+    assert "enforce_actions" in updated and "cooldown_seconds" in updated
+    assert pol.enforce_actions == ["reboot_system"]
+    assert pol.cooldown_seconds == 30.0
+    assert any("max_reboots" in e for e in errors)
+    assert pol.max_reboots == 2  # bad value did not land
+
+
+def test_policy_update_rejects_unknown_actions_and_nan():
+    pol = Policy()
+    updated, errors = pol.update({"enforce_actions": ["rm_rf_slash"]})
+    assert updated == [] and any("unknown action" in e for e in errors)
+    updated, errors = pol.update({"cooldown_seconds": float("nan")})
+    assert updated == [] and errors
+
+
+def test_policy_update_non_object():
+    assert Policy().update([1, 2]) == ([], ["policy update must be an object"])

@@ -1,0 +1,167 @@
+"""Remediation end-to-end: injected fault → policy decision → audit ledger,
+over the HTTP surface (/v1/remediation/*), the session dispatcher, the
+Prometheus exposition, and the offline CLI view."""
+
+import time
+
+import pytest
+
+from gpud_tpu.client.v1 import Client, ClientError
+from gpud_tpu.config import default_config
+from gpud_tpu.server.server import Server
+from gpud_tpu.session.dispatch import Dispatcher
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("remediation-e2e")
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp / "data"), port=0, tls=False, kmsg_path=str(kmsg)
+    )
+    cfg.components_disabled = ["network-latency"]
+    # long interval: tests drive scan_once() deterministically
+    cfg.remediation_interval_seconds = 3600.0
+    cfg.remediation_cooldown_seconds = 0.0
+    s = Server(config=cfg)
+    s.start()
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def client(srv):
+    return Client(base_url=srv.base_url())
+
+
+def _inject_and_wait_unhealthy(srv, client):
+    comp = "accelerator-tpu-error-kmsg"
+    client.inject_fault(tpu_error_name="tpu_hbm_ecc_uncorrectable", chip_id=1)
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        st = client.get_health_states(components=[comp])[0].states[0]
+        if st.health == "Unhealthy":
+            return comp
+        time.sleep(0.1)
+    raise AssertionError("injected fault never went unhealthy")
+
+
+def test_policy_endpoint_shows_dry_run_default(srv, client):
+    pol = client.get_remediation_policy()
+    assert pol["policy"]["enforce_actions"] == []
+    assert pol["escalated"] == []
+    assert pol["interval_seconds"] == 3600.0
+
+
+def test_injected_fault_dry_run_audit_flow(srv, client):
+    """Acceptance path: fault → unhealthy + REBOOT_SYSTEM suggestion →
+    scan → dry_run audit row (no host mutation) → ledger + metric
+    visible over HTTP."""
+    comp = _inject_and_wait_unhealthy(srv, client)
+    rows = srv.remediation.scan_once()
+    mine = [r for r in rows if r["component"] == comp]
+    assert mine and mine[0]["outcome"] == "dry_run"
+    assert mine[0]["action"] == "reboot_system"
+
+    out = client.get_remediation_audit(component=comp)
+    assert out["count"] >= 1
+    att = out["attempts"][0]
+    assert att["outcome"] == "dry_run"
+    assert att["suggested"] == "REBOOT_SYSTEM"
+    assert att["trigger_health"] == "Unhealthy"
+    assert out["status"]["policy"]["enforce_actions"] == []
+
+    text = client.get_prometheus_metrics()
+    assert 'tpud_remediation_attempts_total{' in text
+    assert 'outcome="dry_run"' in text
+
+    # filters work over HTTP
+    assert client.get_remediation_audit(outcome="executed")["count"] == 0
+    assert client.get_remediation_audit(action="reboot_system")["count"] >= 1
+
+
+def test_allowlisted_set_healthy_executes_end_to_end(srv, client):
+    """Graduating an action out of dry-run over the API leads to a real,
+    audited, metric-counted repair."""
+    comp = _inject_and_wait_unhealthy(srv, client)
+    # set_healthy soft repair for this component, allowlisted at runtime
+    srv.remediation.soft_repairs[comp] = "set_healthy"
+    try:
+        res = client.set_remediation_policy(
+            {"enforce_actions": ["set_healthy"]}
+        )
+        assert res["status"] == "ok"
+        assert "enforce_actions" in res["updated"]
+
+        rows = srv.remediation.scan_once()
+        mine = [r for r in rows if r["component"] == comp]
+        assert mine and mine[0]["outcome"] == "executed"
+        assert mine[0]["action"] == "set_healthy"
+        st = client.get_health_states(components=[comp])[0].states[0]
+        assert st.health == "Healthy"
+
+        text = client.get_prometheus_metrics()
+        assert (
+            'tpud_remediation_attempts_total{action="set_healthy"'
+            ',outcome="executed"}' in text
+        )
+        executed = client.get_remediation_audit(outcome="executed")
+        assert executed["count"] >= 1
+    finally:
+        srv.remediation.soft_repairs.pop(comp, None)
+        client.set_remediation_policy({"enforce_actions": []})
+
+
+def test_policy_post_validation(client):
+    res = client.set_remediation_policy(
+        {"cooldown_seconds": 1.0, "max_reboots": -3}
+    )
+    assert res["status"] == "partial"
+    assert any("max_reboots" in e for e in res["errors"])
+    with pytest.raises(ClientError) as ei:
+        client.set_remediation_policy({"enforce_actions": ["bogus"]})
+    assert ei.value.status == 400
+    # restore
+    client.set_remediation_policy({"cooldown_seconds": 0.0})
+
+
+def test_dispatch_remediation_status_and_policy(srv):
+    dispatch = Dispatcher(srv)
+    out = dispatch({"method": "remediationStatus"})
+    assert "remediation" in out and "attempts" in out
+    assert out["remediation"]["policy"]["cooldown_seconds"] == 0.0
+    out = dispatch(
+        {"method": "remediationPolicy", "policy": {"rate_capacity": 9}}
+    )
+    assert "rate_capacity" in out["updated"]
+    assert srv.remediation.policy.rate_capacity == 9
+
+
+def test_cli_remediation_reads_state_db_offline(srv, client, capsys):
+    """`tpud remediation` reads the same ledger straight from SQLite."""
+    from gpud_tpu.cli import main
+
+    rc = main([
+        "remediation", "--data-dir", srv.config.data_dir, "--json"
+    ])
+    assert rc == 0
+    import json
+
+    out = json.loads(capsys.readouterr().out)
+    assert out["summary"]["attempts_total"] >= 1
+    assert any(a["outcome"] == "dry_run" for a in out["attempts"])
+
+
+def test_cli_remediation_without_state_db(tmp_path, capsys):
+    from gpud_tpu.cli import main
+
+    rc = main(["remediation", "--data-dir", str(tmp_path / "nothing")])
+    assert rc == 1
+
+
+def test_openapi_documents_remediation_routes(client):
+    doc = client._req("GET", "/openapi.json")
+    assert "get" in doc["paths"]["/v1/remediation/audit"]
+    assert "get" in doc["paths"]["/v1/remediation/policy"]
+    assert "post" in doc["paths"]["/v1/remediation/policy"]

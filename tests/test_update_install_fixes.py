@@ -1,0 +1,264 @@
+"""Regressions for the update-pipeline hardening: aside-rename reinstall,
+the Python 3.10.0–3.10.11 tarfile filter= fallback, distsign exceptions
+surfacing as error strings, target-version whitelisting, and the watcher's
+failed-target backoff."""
+
+import io
+import os
+import tarfile
+
+import pytest
+
+import gpud_tpu.update_install as ui
+from gpud_tpu.update import BACKOFF_INITIAL, VersionFileWatcher, write_target_version
+from gpud_tpu.update_install import (
+    _safe_extract,
+    install_tree,
+    perform_update,
+    resolve_signing_pub,
+)
+
+
+def _tree(tmp_path, name, marker):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "VERSION").write_text(marker)
+    return str(d)
+
+
+def _make_tar(tmp_path, files):
+    pkg = str(tmp_path / "pkg.tar.gz")
+    with tarfile.open(pkg, "w:gz") as tf:
+        for name, content in files.items():
+            data = content.encode()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return pkg
+
+
+# -- install_tree: reinstall must never destroy the installed tree -----------
+
+def test_reinstall_same_version_succeeds_and_swaps(tmp_path):
+    inst = tmp_path / "install"
+    assert install_tree(_tree(tmp_path, "a", "one"), str(inst), "1.0") is None
+    assert install_tree(_tree(tmp_path, "b", "two"), str(inst), "1.0") is None
+    final = inst / "versions" / "1.0"
+    assert (final / "VERSION").read_text() == "two"
+    # no staging or aside leftovers
+    assert sorted(os.listdir(inst / "versions")) == ["1.0"]
+    assert os.readlink(inst / "current") == os.path.join("versions", "1.0")
+
+
+def test_reinstall_rolls_back_when_swap_fails(tmp_path, monkeypatch):
+    inst = tmp_path / "install"
+    assert install_tree(_tree(tmp_path, "a", "one"), str(inst), "1.0") is None
+    final = str(inst / "versions" / "1.0")
+
+    real_rename = os.rename
+
+    def failing_rename(src, dst):
+        # fail only the staging → final swap; the aside and rollback
+        # renames must still work
+        if src.endswith(f".staging-{os.getpid()}"):
+            raise OSError("simulated rename failure")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(ui.os, "rename", failing_rename)
+    err = install_tree(_tree(tmp_path, "b", "two"), str(inst), "1.0")
+    assert err is not None and "install failed" in err
+    # the previously installed tree survived, restored under its real name
+    assert open(os.path.join(final, "VERSION")).read() == "one"
+    assert sorted(os.listdir(inst / "versions")) == ["1.0"]
+
+
+def test_failed_rollback_leaves_aside_tree_on_disk(tmp_path, monkeypatch):
+    inst = tmp_path / "install"
+    assert install_tree(_tree(tmp_path, "a", "one"), str(inst), "1.0") is None
+
+    real_rename = os.rename
+
+    def failing_rename(src, dst):
+        if src.endswith(f".staging-{os.getpid()}"):
+            raise OSError("simulated swap failure")
+        if src.endswith(f".old-{os.getpid()}"):
+            raise OSError("simulated rollback failure")
+        return real_rename(src, dst)
+
+    monkeypatch.setattr(ui.os, "rename", failing_rename)
+    err = install_tree(_tree(tmp_path, "b", "two"), str(inst), "1.0")
+    assert err is not None
+    # worst case: rollback also failed — the old tree must still exist
+    # somewhere recoverable, never rmtree'd by cleanup
+    aside = inst / "versions" / f"1.0.old-{os.getpid()}"
+    assert (aside / "VERSION").read_text() == "one"
+
+
+# -- tarfile filter= fallback (Python 3.10.0–3.10.11) ------------------------
+
+def test_safe_extract_falls_back_when_filter_unsupported(
+    tmp_path, monkeypatch
+):
+    pkg = _make_tar(tmp_path, {"bin/tpud": "x", "VERSION": "9"})
+    real_extract = tarfile.TarFile.extract
+
+    def old_extract(self, member, path="", set_attrs=True, **kw):
+        if "filter" in kw:
+            raise TypeError(
+                "extract() got an unexpected keyword argument 'filter'"
+            )
+        return real_extract(self, member, path, set_attrs=set_attrs)
+
+    monkeypatch.setattr(tarfile.TarFile, "extract", old_extract)
+    dest = tmp_path / "out"
+    dest.mkdir()
+    assert _safe_extract(pkg, str(dest)) is None
+    assert (dest / "VERSION").read_text() == "9"
+    assert (dest / "bin" / "tpud").exists()
+
+
+def test_safe_extract_still_rejects_traversal_without_filter(
+    tmp_path, monkeypatch
+):
+    pkg = _make_tar(tmp_path, {"../escape": "x"})
+    dest = tmp_path / "out"
+    dest.mkdir()
+    err = _safe_extract(pkg, str(dest))
+    assert err is not None and "unsafe member path" in err
+    assert not (tmp_path / "escape").exists()
+
+
+# -- version whitelist -------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "bad",
+    ["", "1.0?x", "1.0#frag", "1 0", "../../etc", ".hidden", "-flag",
+     "v1/../../x", "a\nb"],
+)
+def test_perform_update_rejects_hostile_versions(tmp_path, bad):
+    err = perform_update(
+        bad, base_url="http://127.0.0.1:9", install_dir=str(tmp_path)
+    )
+    assert err is not None and "invalid target version" in err
+
+
+@pytest.mark.parametrize("good", ["1.2.3", "v2.0.0-rc1", "2024.01_hotfix"])
+def test_version_whitelist_accepts_normal_versions(tmp_path, good):
+    # passes the whitelist; fails later (no trust anchor), proving the
+    # version check is not what rejected it
+    err = perform_update(
+        good, base_url="http://127.0.0.1:9", install_dir=str(tmp_path)
+    )
+    assert err is not None and "invalid target version" not in err
+
+
+# -- distsign exceptions become error strings --------------------------------
+
+def test_verify_key_exception_becomes_error_string(tmp_path, monkeypatch):
+    root = tmp_path / "root.pub"
+    root.write_text("not a real key")
+    monkeypatch.setattr(
+        ui, "_download", lambda url, dest, max_bytes=0: (
+            open(dest, "w").write("x") and None
+        )
+    )
+
+    def boom(*a, **kw):
+        raise ValueError("Unable to load PEM")
+
+    monkeypatch.setattr(ui.distsign, "verify_key", boom)
+    path, err = resolve_signing_pub(
+        "http://127.0.0.1:9", str(tmp_path), root_pub=str(root)
+    )
+    assert path == ""
+    assert "signing key verification failed" in err
+    assert "Unable to load PEM" in err
+
+
+def test_verify_package_exception_becomes_error_string(tmp_path, monkeypatch):
+    pub = tmp_path / "sign.pub"
+    pub.write_text("pinned")
+    written = []
+
+    def fake_download(url, dest, max_bytes=0):
+        open(dest, "w").write("x")
+        written.append(url)
+        return None
+
+    monkeypatch.setattr(ui, "_download", fake_download)
+
+    def boom(*a, **kw):
+        raise RuntimeError("cryptography backend unavailable")
+
+    monkeypatch.setattr(ui.distsign, "verify_package", boom)
+    err = perform_update(
+        "1.0.0",
+        base_url="http://127.0.0.1:9",
+        install_dir=str(tmp_path / "inst"),
+        signing_pub=str(pub),
+    )
+    assert err is not None
+    assert "package signature rejected" in err
+    assert "cryptography backend unavailable" in err
+
+
+# -- watcher failed-target backoff -------------------------------------------
+
+def _watcher(tmp_path, installer):
+    vf = str(tmp_path / "target")
+    w = VersionFileWatcher(
+        vf, current_version="1.0.0", installer=installer, interval=3600
+    )
+    state = {"now": 1000.0}
+    w._now = lambda: state["now"]
+    w.clock = state
+    return w, vf
+
+
+def test_failing_target_backs_off_instead_of_retrying_every_poll(tmp_path):
+    calls = []
+
+    def installer(target):
+        calls.append(target)
+        return "simulated install failure"
+
+    w, vf = _watcher(tmp_path, installer)
+    write_target_version(vf, "2.0.0")
+    assert w.check_once() is True       # first attempt runs the installer
+    assert w.check_once() is False      # in backoff: no re-download
+    assert calls == ["2.0.0"]
+    w.clock["now"] += BACKOFF_INITIAL + 1
+    assert w.check_once() is True       # backoff lapsed: retried
+    assert calls == ["2.0.0", "2.0.0"]
+    # consecutive failure doubled the backoff
+    w.clock["now"] += BACKOFF_INITIAL + 1
+    assert w.check_once() is False
+    w.clock["now"] += BACKOFF_INITIAL + 1
+    assert w.check_once() is True
+
+
+def test_new_target_resets_the_failure_memo(tmp_path):
+    calls = []
+
+    def installer(target):
+        calls.append(target)
+        return "simulated install failure"
+
+    w, vf = _watcher(tmp_path, installer)
+    write_target_version(vf, "2.0.0")
+    assert w.check_once() is True
+    assert w.check_once() is False
+    write_target_version(vf, "2.0.1")   # operator pushed a fixed build
+    assert w.check_once() is True       # no waiting out the old backoff
+    assert calls == ["2.0.0", "2.0.1"]
+
+
+def test_successful_install_does_not_engage_backoff(tmp_path):
+    exits = []
+
+    w, vf = _watcher(tmp_path, lambda target: None)
+    w._exit = exits.append
+    write_target_version(vf, "2.0.0")
+    assert w.check_once() is True
+    assert exits == [244]
+    assert w._failed_target == ""
