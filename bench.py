@@ -47,6 +47,10 @@ def bench_fault_detection() -> dict:
     )
     srv = Server(config=cfg)
     srv.start()
+    # startup readiness: time from scheduler start to every component's
+    # first check completing — first checks run in parallel on the pool,
+    # off the boot path (docs/scheduler.md)
+    startup_ready = srv.scheduler.wait_first_runs(timeout=30.0)
     err_comp = srv.registry.get(TPUErrorKmsgComponent.NAME)
 
     latencies_ms = []
@@ -77,6 +81,7 @@ def bench_fault_detection() -> dict:
             # clear state between injections so dedupe never skips the next
             err_comp.set_healthy()
 
+        sched_stats = srv.scheduler.stats()
     finally:
         srv.stop()
 
@@ -86,6 +91,13 @@ def bench_fault_detection() -> dict:
         f"[bench] injected={len(errors)} detected={detected} "
         f"rate={rate:.3f} p50={p50:.1f}ms "
         f"p95={sorted(latencies_ms)[int(0.95 * (len(latencies_ms) - 1))] if latencies_ms else float('nan'):.1f}ms",
+        file=sys.stderr,
+    )
+    print(
+        f"[bench] scheduler: startup time-to-all-components-first-checked="
+        f"{startup_ready * 1000.0 if startup_ready is not None else float('nan'):.1f}ms "
+        f"dispatch-lag p95={sched_stats['dispatch_lag_p95_seconds'] * 1000.0:.2f}ms "
+        f"(jobs={sched_stats['jobs']} workers={sched_stats['workers']})",
         file=sys.stderr,
     )
     return {"p50_ms": p50, "rate": rate}
@@ -264,9 +276,16 @@ def _bench_tpu_scan_inner() -> None:
         print(f"[bench] tpu scan skipped: {e}", file=sys.stderr)
 
 
-def bench_footprint(measure_seconds: float = 185.0) -> None:
+THREAD_TARGET = 12  # steady-state daemon threads (was ~26 pre-scheduler)
+
+
+def bench_footprint(measure_seconds: float = 185.0):
     """Steady-state CPU%/RSS of a dedicated daemon subprocess (the
-    BASELINE.json targets: <1% CPU, <150 MB RSS). stderr report only.
+    BASELINE.json targets: <1% CPU, <150 MB RSS), plus the thread-count
+    gate: the unified scheduler collapsed the per-component poller
+    threads into one heap + a bounded pool, and the daemon must hold
+    <= THREAD_TARGET steady-state threads. Returns False when the thread
+    gate fails, None when the bench was skipped, True otherwise.
 
     The window spans >= 3 of the 60s poll cadences so it contains real
     check work — a sub-cadence window can sample zero poll ticks and
@@ -278,7 +297,7 @@ def bench_footprint(measure_seconds: float = 185.0) -> None:
     try:
         import psutil
     except ImportError:
-        return
+        return None
     tmp = tempfile.mkdtemp(prefix="tpud-footprint-")
     kmsg = os.path.join(tmp, "kmsg.fixture")
     open(kmsg, "w").close()
@@ -317,7 +336,7 @@ def bench_footprint(measure_seconds: float = 185.0) -> None:
                 f"(code {proc.returncode}); skipping measurement",
                 file=sys.stderr,
             )
-            return
+            return None
         p = psutil.Process(proc.pid)
         p.cpu_percent()
         t_start = p.cpu_times()
@@ -329,7 +348,7 @@ def bench_footprint(measure_seconds: float = 185.0) -> None:
                 f"(code {proc.returncode})",
                 file=sys.stderr,
             )
-            return
+            return None
         cpu = p.cpu_percent()
         t_end = p.cpu_times()
         # cpu burned INSIDE the window (cumulative-since-spawn would count
@@ -339,17 +358,22 @@ def bench_footprint(measure_seconds: float = 185.0) -> None:
         # >= 3 poll cadences ran, so the daemon must have burned SOME cpu;
         # 0.00 here would mean the measurement missed the work again
         suspect = " (SUSPECT: no cpu sampled in window)" if busy_s <= 0 else ""
+        threads = p.num_threads()
+        thread_ok = threads <= THREAD_TARGET
         print(
             f"[bench] daemon steady-state over {measure_seconds:.0f}s "
             f"(>=3 poll cadences): cpu={cpu:.2f}% "
             f"(window busy {busy_s:.2f}s{suspect}) "
             f"rss={rss_start:.1f}->{rss_end:.1f}MB "
-            f"(creep {rss_end - rss_start:+.1f}MB) threads={p.num_threads()} "
-            f"(targets: <1% cpu, <150MB rss)",
+            f"(creep {rss_end - rss_start:+.1f}MB) threads={threads} "
+            f"(targets: <1% cpu, <150MB rss, <={THREAD_TARGET} threads"
+            f"{'' if thread_ok else ' — THREAD TARGET EXCEEDED'})",
             file=sys.stderr,
         )
+        return thread_ok
     except Exception as e:  # noqa: BLE001
         print(f"[bench] footprint measure skipped: {e}", file=sys.stderr)
+        return None
     finally:
         proc.terminate()
         try:
@@ -361,10 +385,14 @@ def bench_footprint(measure_seconds: float = 185.0) -> None:
 def main() -> int:
     res = bench_fault_detection()
     # the secondary benches are stderr-only color; none may take down the
-    # primary JSON line
+    # primary JSON line. The footprint bench additionally gates on the
+    # steady-state thread target (None = skipped, counts as pass).
+    thread_ok = True
     for secondary in (bench_sysfs_ici_detection, bench_footprint, bench_tpu_scan):
         try:
-            secondary()
+            r = secondary()
+            if secondary is bench_footprint and r is False:
+                thread_ok = False
         except Exception as e:  # noqa: BLE001
             print(f"[bench] {secondary.__name__} failed: {e}", file=sys.stderr)
     p50 = res["p50_ms"]
@@ -379,7 +407,7 @@ def main() -> int:
         "vs_baseline": round(60000.0 / p50, 1) if finite and p50 > 0 else 0.0,
     }
     print(json.dumps(out))
-    return 0 if res["rate"] >= 1.0 else 1
+    return 0 if (res["rate"] >= 1.0 and thread_ok) else 1
 
 
 if __name__ == "__main__":

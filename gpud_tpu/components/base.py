@@ -115,6 +115,7 @@ class TpudInstance:
         failure_injector: Optional[FailureInjector] = None,
         config=None,
         health_ledger: Optional["HealthLedger"] = None,
+        scheduler=None,
     ) -> None:
         self.machine_id = machine_id
         self.tpu_instance = tpu_instance
@@ -131,6 +132,11 @@ class TpudInstance:
         # health-transition ledger (None in scan mode — like event_store,
         # one-shot scans record no persistent timeline)
         self.health_ledger = health_ledger
+        # unified check scheduler (gpud_tpu/scheduler): when present,
+        # PollingComponent.start() registers a heap job instead of
+        # spawning a dedicated poller thread. None (standalone/test/scan
+        # use) keeps the legacy thread-per-poller path.
+        self.scheduler = scheduler
         # cross-component fast path: the kmsg pipeline (inotify, ~ms) calls
         # these on fabric-class catalog matches so pollers can open an
         # adaptive fast-poll window instead of waiting out their cadence
@@ -313,8 +319,16 @@ class Component:
 
 
 class PollingComponent(Component):
-    """Component with the shared periodic-check goroutine pattern
+    """Component with the shared periodic-check pattern
     (reference: components/accelerator/nvidia/temperature/component.go:81-97).
+
+    With a scheduler on the instance (the daemon path), ``start()``
+    registers a deadline-heap job on the shared bounded pool — no thread
+    is spawned, the first check runs on the pool off the startup path,
+    and a hung check is watchdogged into a Degraded-stale cached result
+    while the pool keeps draining. Without one (standalone components in
+    tests/benches, scan mode), the legacy dedicated ``tpud-poll-<name>``
+    thread is kept.
 
     ``time_now_fn`` / ``sleep interval`` are injectable for tests.
     """
@@ -331,10 +345,22 @@ class PollingComponent(Component):
         self._stop_event = threading.Event()
         self._poke_event = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._job = None  # scheduler Job when scheduler-driven
         self._last_slow_event_at = 0.0
         self.time_now_fn: Callable[[], float] = time.time
 
     def start(self) -> None:
+        scheduler = getattr(self.instance, "scheduler", None)
+        if scheduler is not None:
+            if self._job is not None:
+                return
+            self._job = scheduler.add_job(
+                f"component:{self.NAME}",
+                self._scheduled_run,
+                interval_fn=self.poll_interval,
+                on_hang=self._mark_check_stale,
+            )
+            return
         if self._thread is not None:
             return
         self._stop_event.clear()
@@ -345,13 +371,39 @@ class PollingComponent(Component):
 
     def poll_interval(self) -> float:
         """Next sleep; override for adaptive cadences (e.g. the ICI
-        component's fast-poll-on-suspicion window)."""
+        component's fast-poll-on-suspicion window). Re-read by the
+        scheduler after every run."""
         return self.POLL_INTERVAL
 
     def poke(self) -> None:
         """Wake the poller now (event-triggered check instead of waiting
         out the cadence)."""
+        if self._job is not None:
+            self._job.poke()
+            return
         self._poke_event.set()
+
+    def _scheduled_run(self) -> None:
+        """One scheduler-dispatched cycle: the body of one loop turn."""
+        self.check()
+        self._report_if_slow()
+
+    def _mark_check_stale(self, elapsed: float) -> None:
+        """Watchdog callback: the in-flight check blew its hang budget.
+        Publish a Degraded-stale cached state (the staleness is the
+        finding — the data source is wedged) without waiting for the
+        stuck call; when the real check eventually returns, its result
+        overwrites this marker."""
+        cr = CheckResult(
+            component_name=self.NAME,
+            health=HealthStateType.DEGRADED,
+            reason=(
+                f"check stale: still running after {elapsed:.0f}s "
+                "(watchdog fired; data source presumed wedged)"
+            ),
+        )
+        with self._last_mu:
+            self._last_check_result = cr
 
     def _loop(self) -> None:
         # first check runs inside the poller thread so a hung data source
@@ -403,6 +455,9 @@ class PollingComponent(Component):
             logger.exception("slow-check event emit failed for %s", self.NAME)
 
     def close(self) -> None:
+        if self._job is not None:
+            self._job.cancel()
+            self._job = None
         self._stop_event.set()
         self._poke_event.set()
         if self._thread is not None:

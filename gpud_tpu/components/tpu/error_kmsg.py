@@ -63,6 +63,7 @@ class TPUErrorKmsgComponent(Component):
         self.time_now_fn = time.time
         self._stop = threading.Event()
         self._ticker: Optional[threading.Thread] = None
+        self._job = None  # scheduler Job when scheduler-driven
         self.syncer: Optional[Syncer] = None
         if self._event_bucket is not None:
             self.syncer = Syncer(
@@ -92,7 +93,15 @@ class TPUErrorKmsgComponent(Component):
     def start(self) -> None:
         # the SharedWatcher (server-owned) feeds self.syncer; here we only
         # run the periodic re-evaluation ticker (reference: component.go
-        # updateCurrentState every 30s)
+        # updateCurrentState every 30s) — a scheduler job in the daemon,
+        # a dedicated thread only in scheduler-less standalone use
+        scheduler = getattr(self.instance, "scheduler", None)
+        if scheduler is not None:
+            if self._job is None:
+                self._job = scheduler.add_job(
+                    f"component:{NAME}", self.check, interval=UPDATE_INTERVAL
+                )
+            return
         if self._ticker is not None:
             return
         self._stop.clear()
@@ -107,6 +116,9 @@ class TPUErrorKmsgComponent(Component):
             self.check()
 
     def close(self) -> None:
+        if self._job is not None:
+            self._job.cancel()
+            self._job = None
         self._stop.set()
         if self._ticker is not None:
             self._ticker.join(timeout=2.0)

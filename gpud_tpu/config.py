@@ -30,6 +30,11 @@ DEFAULT_REMEDIATION_MAX_REBOOTS = 2      # reboots allowed inside the window
 DEFAULT_REMEDIATION_REBOOT_WINDOW = 3600
 DEFAULT_REMEDIATION_ESCALATION_THRESHOLD = 3  # failed soft repairs => escalate
 DEFAULT_REMEDIATION_ESCALATION_WINDOW = 3600
+# unified check scheduler (docs/scheduler.md): bounded worker pool +
+# deadline heap replacing per-component poller threads
+DEFAULT_SCHEDULER_WORKERS = 4
+DEFAULT_SCHEDULER_WATCHDOG = 120         # hang budget per check run (s)
+DEFAULT_SCHEDULER_JITTER = 0.05          # ±5% deterministic cadence jitter
 
 STATE_FILE = "tpud.state"                # reference: default.go:137-157 (gpud.state)
 FIFO_FILE = "tpud.fifo"
@@ -81,6 +86,10 @@ class Config:
         DEFAULT_REMEDIATION_ESCALATION_WINDOW
     )
     remediation_runtime_unit: str = ""   # empty = tpu-runtime.service
+    # unified check scheduler (docs/scheduler.md)
+    scheduler_workers: int = DEFAULT_SCHEDULER_WORKERS
+    scheduler_watchdog_seconds: int = DEFAULT_SCHEDULER_WATCHDOG
+    scheduler_jitter_fraction: float = DEFAULT_SCHEDULER_JITTER
     poll_interval_seconds: int = DEFAULT_POLL_INTERVAL
     scrape_interval_seconds: int = DEFAULT_SCRAPE_INTERVAL
     compact_period_seconds: int = 0      # 0 = disabled (reference default)
@@ -158,6 +167,12 @@ class Config:
             return "remediation escalation threshold must be >= 1"
         if self.remediation_escalation_window_seconds < 60:
             return "remediation escalation window must be >= 60s"
+        if self.scheduler_workers < 1:
+            return "scheduler workers must be >= 1"
+        if self.scheduler_watchdog_seconds < 0:
+            return "scheduler watchdog must be >= 0s (0 disables)"
+        if not (0.0 <= self.scheduler_jitter_fraction <= 0.5):
+            return "scheduler jitter fraction must be in [0, 0.5]"
         from gpud_tpu.remediation.policy import EXECUTABLE_ACTIONS
 
         unknown = sorted(

@@ -112,6 +112,14 @@ class EventStore:
         db.execute(
             f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_comp_ts ON {TABLE} (component, timestamp)"
         )
+        # covering index for the cross-component since-scan
+        # (latest_events / the bench's 2ms detect loop): without it the
+        # (component, timestamp) index is useless for a bare
+        # ``timestamp>=?`` predicate and the query table-scans — a cost
+        # that grows with retention (14d of events)
+        db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_ts ON {TABLE} (timestamp)"
+        )
 
     def bucket(self, component: str) -> Bucket:
         with self._mu:
@@ -171,8 +179,14 @@ class EventStore:
         return out
 
     # -- retention ---------------------------------------------------------
-    def start_purger(self) -> None:
-        self._purger.start()
+    def start_purger(self, scheduler=None) -> None:
+        self._purger.start(scheduler)
+
+    def purge_once(self) -> None:
+        """One retention pass now — the daemon's consolidated
+        ``retention-purge`` scheduler job calls this instead of running a
+        dedicated purger (docs/scheduler.md)."""
+        self._purge_tick()
 
     def _purge_tick(self) -> None:
         """One purge pass, per component so the purge counter attributes

@@ -134,6 +134,13 @@ class HealthLedger:
             f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_comp_ts "
             f"ON {TABLE} (component, timestamp)"
         )
+        # /v1/states/history with no component filter is a bare
+        # ``timestamp>=? ORDER BY timestamp DESC`` — this index serves
+        # both the predicate and the sort, so the endpoint stays flat as
+        # the transition table grows toward its 14d retention
+        db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_{TABLE}_ts ON {TABLE} (timestamp)"
+        )
         db.execute(
             f"""CREATE TABLE IF NOT EXISTS {LAST_TABLE} (
                 component TEXT PRIMARY KEY,
@@ -451,8 +458,12 @@ class HealthLedger:
         }
 
     # -- retention ---------------------------------------------------------
-    def start_purger(self) -> None:
-        self._purger.start()
+    def start_purger(self, scheduler=None) -> None:
+        self._purger.start(scheduler)
+
+    def purge_once(self) -> None:
+        """One retention pass now (consolidated scheduler job hook)."""
+        self._purge_tick()
 
     def _purge_tick(self) -> None:
         cutoff = self.time_now_fn() - self.retention_seconds

@@ -142,6 +142,12 @@ class Histogram(_Metric):
         if not bounds or any(math.isnan(b) for b in bounds):
             raise ValueError(f"histogram {name}: invalid buckets {bounds!r}")
         self.buckets: Tuple[float, ...] = tuple(bounds)
+        # bucket bounds never change after creation: render the ``le``
+        # label values once here instead of per-sample on every scrape
+        # (the exposition path runs while check threads are observing)
+        self._le_strs: Tuple[str, ...] = tuple(
+            _format_value(b) for b in self.buckets
+        )
         super().__init__(name, help_text, registry)
         # LabelKey -> [bucket_counts, sum, count]
         self._series: Dict[LabelKey, list] = {}
@@ -179,18 +185,21 @@ class Histogram(_Metric):
             return [(k, float(s[2])) for k, s in self._series.items()]
 
     def samples(self) -> List[Sample]:
+        # hold the lock ONLY for the raw state copy; sorting and series
+        # expansion run outside it so observe() on the check/HTTP hot
+        # paths is never blocked behind exposition formatting
         with self._mu:
-            snap = sorted(
-                (k, (list(s[0]), s[1], s[2])) for k, s in self._series.items()
-            )
+            snap = [
+                (k, list(s[0]), s[1], s[2]) for k, s in self._series.items()
+            ]
+        snap.sort(key=lambda item: item[0])
         out: List[Sample] = []
-        for key, (counts, total, n) in snap:
+        for key, counts, total, n in snap:
             cum = 0
-            for bound, c in zip(self.buckets, counts):
+            for le, c in zip(self._le_strs, counts):
                 cum += c
                 out.append(
-                    (self.name + "_bucket",
-                     key + (("le", _format_value(bound)),), float(cum))
+                    (self.name + "_bucket", key + (("le", le),), float(cum))
                 )
             out.append((self.name + "_bucket", key + (("le", "+Inf"),), float(n)))
             out.append((self.name + "_sum", key, float(total)))

@@ -109,6 +109,7 @@ class Syncer:
         self.interval = interval_seconds
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._job = None
         self.time_now_fn = time.time
 
     def sync_once(self) -> int:
@@ -117,7 +118,18 @@ class Syncer:
         self.store.purge(self.time_now_fn() - self.store.retention_seconds)
         return len(rows)
 
-    def start(self) -> None:
+    def start(self, scheduler=None) -> None:
+        """On the unified scheduler when given (the daemon path; zero
+        threads), else the legacy dedicated thread."""
+        if scheduler is not None:
+            if self._job is None:
+                self._job = scheduler.add_job(
+                    "metrics-syncer",
+                    self.sync_once,
+                    interval=self.interval,
+                    initial_delay=self.interval,  # scrape-at-boot is noise
+                )
+            return
         if self._thread is not None:
             return
         self._thread = threading.Thread(
@@ -133,6 +145,9 @@ class Syncer:
                 logger.exception("metrics sync failed")
 
     def close(self) -> None:
+        if self._job is not None:
+            self._job.cancel()
+            self._job = None
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)
@@ -153,6 +168,7 @@ class SelfMetricsRecorder:
         self.interval = interval_seconds
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._job = None
         self.g_db_size = registry.gauge(
             "tpud_sqlite_db_size_bytes", "state DB size in bytes"
         )
@@ -179,7 +195,16 @@ class SelfMetricsRecorder:
         self.g_write_secs.set(s["insert_update_delete_seconds"])
         self.g_vacuum_secs.set(s["vacuum_seconds"])
 
-    def start(self) -> None:
+    def start(self, scheduler=None) -> None:
+        if scheduler is not None:
+            if self._job is None:
+                # first record runs on the pool (part of startup
+                # readiness), then every 15m
+                self._job = scheduler.add_job(
+                    "self-metrics-recorder", self.record_once,
+                    interval=self.interval,
+                )
+            return
         if self._thread is not None:
             return
         self.record_once()
@@ -196,6 +221,9 @@ class SelfMetricsRecorder:
                 logger.exception("self-metrics record failed")
 
     def close(self) -> None:
+        if self._job is not None:
+            self._job.cancel()
+            self._job = None
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)

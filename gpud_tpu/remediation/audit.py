@@ -180,8 +180,12 @@ class AuditStore:
         }
 
     # -- retention ---------------------------------------------------------
-    def start_purger(self) -> None:
-        self._purger.start()
+    def start_purger(self, scheduler=None) -> None:
+        self._purger.start(scheduler)
+
+    def purge_once(self) -> None:
+        """One retention pass now (consolidated scheduler job hook)."""
+        self._purge_tick()
 
     def _purge_tick(self) -> None:
         cutoff = self.time_now_fn() - self.retention_seconds

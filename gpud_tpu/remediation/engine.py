@@ -127,9 +127,25 @@ class RemediationEngine:
         self._stop = threading.Event()
         self._poke = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._job = None  # scheduler Job when scheduler-driven
 
     # -- scan loop ---------------------------------------------------------
-    def start(self) -> None:
+    def start(self, scheduler=None) -> None:
+        """With a scheduler (the daemon path), the scan cadence is a heap
+        job and the audit purger rides the server's consolidated
+        ``retention-purge`` job — zero engine-owned threads. Without one,
+        the legacy dedicated thread + per-store purger thread."""
+        if scheduler is not None:
+            if self._job is None and self._thread is None:
+                # first scan waits out one interval like the legacy loop:
+                # component first-checks must land before acting on states
+                self._job = scheduler.add_job(
+                    "remediation-scan",
+                    self.scan_once,
+                    interval=self.interval,
+                    initial_delay=self.interval,
+                )
+            return
         if self._thread is not None:
             return
         self.audit.start_purger()
@@ -139,6 +155,9 @@ class RemediationEngine:
         self._thread.start()
 
     def poke(self) -> None:
+        if self._job is not None:
+            self._job.poke()
+            return
         self._poke.set()
 
     def _loop(self) -> None:
@@ -153,6 +172,9 @@ class RemediationEngine:
                 logger.exception("remediation scan failed")
 
     def close(self) -> None:
+        if self._job is not None:
+            self._job.cancel()
+            self._job = None
         self._stop.set()
         self._poke.set()
         if self._thread is not None:

@@ -20,10 +20,16 @@ MIN_INTERVAL = 60.0
 
 
 class RetentionPurger:
-    """Run ``purge_fn`` every ``interval_seconds`` (floored at 60 s) on a
-    named daemon thread. ``start`` is idempotent; ``close`` stops and joins.
-    A purge callback that raises is logged and retried next tick — a
-    transient DB error must not end retention for the process's life."""
+    """Run ``purge_fn`` every ``interval_seconds`` (floored at 60 s).
+
+    With a scheduler (the daemon path), ``start(scheduler)`` registers a
+    heap job on the shared pool — no thread. Without one, a named daemon
+    thread is spawned (stores opened standalone by the CLI/tests).
+    ``start`` is idempotent; ``close`` stops and joins/cancels. A purge
+    callback that raises is logged and retried next tick — a transient DB
+    error must not end retention for the process's life. (The daemon
+    itself goes one step further and consolidates all its purgers into a
+    single ``retention-purge`` scheduler job — see server.Server.)"""
 
     def __init__(
         self, name: str, interval_seconds: float, purge_fn: Callable[[], None]
@@ -33,8 +39,26 @@ class RetentionPurger:
         self._purge_fn = purge_fn
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._job = None
 
-    def start(self) -> None:
+    def purge_once(self) -> None:
+        """One purge pass now (what each tick runs) — public so a
+        consolidated scheduler job can drive several purgers on one
+        cadence without each costing a thread or a job."""
+        self._purge_fn()
+
+    def start(self, scheduler=None) -> None:
+        if scheduler is not None:
+            if self._job is None and self._thread is None:
+                # the scheduler traps + counts exceptions itself, matching
+                # the legacy loop's log-and-retry contract
+                self._job = scheduler.add_job(
+                    self.name,
+                    self._purge_fn,
+                    interval=self.interval,
+                    initial_delay=self.interval,
+                )
+            return
         if self._thread is not None:
             return
         self._thread = threading.Thread(
@@ -50,6 +74,9 @@ class RetentionPurger:
                 logger.exception("%s purge failed", self.name)
 
     def close(self) -> None:
+        if self._job is not None:
+            self._job.cancel()
+            self._job = None
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)

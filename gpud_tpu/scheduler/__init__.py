@@ -1,0 +1,6 @@
+"""Unified check scheduler: one deadline-min-heap thread + a bounded
+worker pool owning every periodic job in the daemon (docs/scheduler.md)."""
+
+from gpud_tpu.scheduler.core import Job, Scheduler
+
+__all__ = ["Job", "Scheduler"]

@@ -139,6 +139,17 @@ class Server:
             accelerator_type=self.config.accelerator_type_override,
         )
 
+        # unified check scheduler: one deadline heap + bounded worker pool
+        # owns every periodic job (docs/scheduler.md) — components, metrics
+        # scrape/record, retention, remediation scan, update watcher
+        from gpud_tpu.scheduler import Scheduler
+
+        self.scheduler = Scheduler(
+            workers=self.config.scheduler_workers,
+            hang_timeout=float(self.config.scheduler_watchdog_seconds),
+            jitter_fraction=self.config.scheduler_jitter_fraction,
+        )
+
         # DI + registry (reference: server.go:298-340)
         self.tpud_instance = TpudInstance(
             machine_id=self.machine_id,
@@ -154,6 +165,7 @@ class Server:
             failure_injector=failure_injector,
             config=self.config,
             health_ledger=self.health_ledger,
+            scheduler=self.scheduler,
         )
         self.registry = Registry(self.tpud_instance)
         enabled = set(self.config.components_enabled)
@@ -282,19 +294,44 @@ class Server:
         self._start_error = None
         if not getattr(self, "_assembled", False):
             self._assembled = True
+            # register every periodic job BEFORE scheduler.start(): jobs
+            # known at start form the startup-readiness set, and their
+            # first checks run in parallel on the pool instead of
+            # serially on this (boot) thread
             for comp in self.registry.all():
                 if comp.name() in self.supported_names:
                     comp.start()
             self.kmsg_watcher.start()
-            self.event_store.start_purger()
-            self.health_ledger.start_purger()
+            # consolidated retention: the three purger threads
+            # (eventstore, health ledger, remediation audit) collapse
+            # into ONE scheduler job on a shared cadence — each store's
+            # pass is independent, one failing table must not starve
+            # the others
+            self._retention_targets = [
+                ("events", self.event_store.purge_once),
+                ("health", self.health_ledger.purge_once),
+            ]
             if self.remediation is not None:
-                self.remediation.start()
-            self.metrics_syncer.start()
-            self.self_metrics.start()
+                self._retention_targets.append(
+                    ("remediation-audit", self.remediation.audit.purge_once)
+                )
+            retention_interval = max(
+                60.0, self.config.events_retention_seconds / 5.0
+            )
+            self.scheduler.add_job(
+                "retention-purge",
+                self._purge_retention,
+                interval=retention_interval,
+                initial_delay=retention_interval,
+            )
+            if self.remediation is not None:
+                self.remediation.start(self.scheduler)
+            self.metrics_syncer.start(self.scheduler)
+            self.self_metrics.start(self.scheduler)
             self.package_manager.start()
             if self.update_watcher is not None:
-                self.update_watcher.start()
+                self.update_watcher.start(self.scheduler)
+            self.scheduler.start()
             self._reapply_config_overrides()
             self._maybe_start_session()
             self._start_token_fifo()
@@ -312,6 +349,16 @@ class Server:
         from gpud_tpu import sdnotify
 
         sdnotify.ready()
+
+    def _purge_retention(self) -> None:
+        """One consolidated retention pass over every store (scheduler
+        job "retention-purge"); per-store isolation so one failing table
+        doesn't starve the others."""
+        for name, purge in self._retention_targets:
+            try:
+                purge()
+            except Exception:  # noqa: BLE001
+                logger.exception("retention purge failed for %s", name)
 
     def _serve(self) -> None:
         loop = asyncio.new_event_loop()
@@ -384,6 +431,9 @@ class Server:
                 logger.exception("component %s close failed", comp.name())
         if self.remediation is not None:
             self.remediation.close()
+        # after every job owner cancelled its jobs; before the stores the
+        # retention job writes through are closed
+        self.scheduler.close()
         self.health_ledger.close()
         self.event_store.close()
 

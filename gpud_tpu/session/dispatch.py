@@ -66,6 +66,16 @@ class Dispatcher:
         self._gossip_inflight = threading.Event()
         self._diagnostic_inflight = threading.Event()
 
+    def _spawn(self, name: str, fn: Callable[[], None]) -> None:
+        """Async session work (gossip/diagnostic can hang on NFS stat)
+        runs as a one-shot on the unified scheduler pool — the watchdog
+        reclaims a wedged slot and ad-hoc threads stop accumulating. A
+        scheduler-less server (older tests) falls back to a thread."""
+        scheduler = getattr(self.server, "scheduler", None)
+        if scheduler is not None and scheduler.submit(f"session:{name}", fn):
+            return
+        threading.Thread(target=fn, name=f"tpud-{name}", daemon=True).start()
+
     def __call__(self, req: Dict) -> Dict:
         if not isinstance(req, dict):
             return {"error": "request must be an object"}
@@ -205,7 +215,7 @@ class Dispatcher:
         # must not stack additional stuck threads
         if not self._gossip_inflight.is_set():
             self._gossip_inflight.set()
-            threading.Thread(target=work, daemon=True).start()
+            self._spawn("gossip", work)
         if getattr(self.server, "last_gossip", None):
             result["machine_info"] = self.server.last_gossip
             result["status"] = "ok"
@@ -285,7 +295,7 @@ class Dispatcher:
                 self._diagnostic_inflight.clear()
 
         self._diagnostic_inflight.set()
-        threading.Thread(target=work, daemon=True).start()
+        self._spawn("diagnostic", work)
         return {"status": "started"}
 
     # -- actions -----------------------------------------------------------
@@ -300,7 +310,9 @@ class Dispatcher:
             if err:
                 logger.error("reboot failed: %s", err)
 
-        threading.Thread(target=work, daemon=True).start()
+        # NOT pooled: a delayed reboot sleeping on a worker would idle a
+        # pool slot for the whole delay
+        threading.Thread(target=work, name="tpud-reboot", daemon=True).start()
         return {"status": "rebooting"}
 
     def _m_setHealthy(self, req: Dict) -> Dict:
@@ -327,7 +339,14 @@ class Dispatcher:
         elif tag:
             comps = [c for c in self.server.registry.all() if tag in c.tags()]
         for c in comps:
-            threading.Thread(target=c.check, daemon=True).start()
+            # a scheduler-driven poller is poked to the front of the heap
+            # (keeps the no-overlapping-runs invariant); anything else
+            # gets a one-shot on the pool
+            job = getattr(c, "_job", None)
+            if job is not None:
+                job.poke()
+            else:
+                self._spawn(f"trigger:{c.name()}", c.check)
         return {"status": "triggered", "components": [c.name() for c in comps]}
 
     def _m_deregisterComponent(self, req: Dict) -> Dict:

@@ -44,6 +44,7 @@ _METRIC_MODULES = (
     "gpud_tpu.components.base",
     "gpud_tpu.eventstore",
     "gpud_tpu.health_history",
+    "gpud_tpu.scheduler.core",
     "gpud_tpu.server.app",
     "gpud_tpu.session.dispatch",
     "gpud_tpu.sqlite",

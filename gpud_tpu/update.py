@@ -86,6 +86,7 @@ class VersionFileWatcher:
                 pass
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._job = None  # scheduler Job when scheduler-driven
         # failed-target memo: (target, failed_at, current_backoff)
         import time as _time
 
@@ -168,13 +169,28 @@ class VersionFileWatcher:
         self.on_update(target)
         return True
 
-    def start(self) -> None:
+    def start(self, scheduler=None) -> None:
+        if scheduler is not None:
+            if self._job is None and self._thread is None:
+                self._job = scheduler.add_job(
+                    "update-watcher",
+                    self._scheduled_check,
+                    interval=self.interval,
+                    initial_delay=self.interval,
+                )
+            return
         if self._thread is not None:
             return
         self._thread = threading.Thread(
             target=self._loop, name="tpud-update-watcher", daemon=True
         )
         self._thread.start()
+
+    def _scheduled_check(self) -> None:
+        # the legacy loop exits once an update is triggered (the daemon is
+        # about to restart-exec); the job equivalent is self-cancellation
+        if self.check_once() and self._job is not None:
+            self._job.cancel()
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
@@ -185,6 +201,9 @@ class VersionFileWatcher:
                 logger.exception("update check failed")
 
     def close(self) -> None:
+        if self._job is not None:
+            self._job.cancel()
+            self._job = None
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=2.0)

@@ -1,0 +1,314 @@
+"""Unified check scheduler: deadline heap + bounded pool semantics.
+
+Covers the behaviors the per-thread pollers guaranteed (poke priority,
+adaptive interval re-read, no-overlap) plus the new ones only the
+scheduler provides (pool saturation accounting, hung-check watchdog with
+a sacrificial thread, deterministic jitter, startup readiness), and the
+covering indexes the since-scan queries rely on (EXPLAIN QUERY PLAN).
+"""
+
+import threading
+import time
+
+import pytest
+
+from gpud_tpu.api.v1.types import HealthStateType
+from gpud_tpu.components.base import (
+    CheckResult,
+    PollingComponent,
+    TpudInstance,
+)
+from gpud_tpu.scheduler import Scheduler
+from gpud_tpu.scheduler.core import _c_saturation, _c_watchdog
+
+
+def _wait_for(pred, timeout=5.0, step=0.01):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+@pytest.fixture
+def sched():
+    s = Scheduler(workers=2, hang_timeout=60.0)
+    yield s
+    s.close()
+
+
+# -- basic dispatch ---------------------------------------------------------
+def test_first_runs_happen_on_pool_and_readiness_records(sched):
+    ran = threading.Event()
+    sched.add_job("a", ran.set, interval=3600.0)
+    sched.add_job("deferred", lambda: None, interval=3600.0,
+                  initial_delay=3600.0)
+    sched.start()
+    assert ran.wait(5.0)
+    # the deferred job is NOT part of the readiness set: readiness means
+    # "every immediate first check completed", and it completes fast
+    ready = sched.wait_first_runs(timeout=5.0)
+    assert ready is not None and ready < 5.0
+    assert sched.startup_ready_seconds == ready
+
+
+def test_submit_one_shot_runs_once_and_unregisters(sched):
+    sched.start()
+    hits = []
+    sched.submit("oneshot", lambda: hits.append(1))
+    assert _wait_for(lambda: hits == [1])
+    assert _wait_for(lambda: "oneshot" not in sched.job_names())
+    time.sleep(0.1)
+    assert hits == [1]
+
+
+def test_cancel_stops_future_runs(sched):
+    runs = []
+    job = sched.add_job("c", lambda: runs.append(1), interval=0.02)
+    sched.start()
+    assert _wait_for(lambda: len(runs) >= 2)
+    job.cancel()
+    assert _wait_for(lambda: "c" not in sched.job_names())
+    n = len(runs)
+    time.sleep(0.15)
+    assert len(runs) == n
+
+
+def test_poke_jumps_job_to_front(sched):
+    runs = []
+    sched.add_job("poked", lambda: runs.append(time.monotonic()),
+                  interval=3600.0)
+    sched.start()
+    assert _wait_for(lambda: len(runs) == 1)
+    # the next natural deadline is an hour away; poke must beat it
+    sched.poke("poked")
+    assert _wait_for(lambda: len(runs) == 2)
+    assert runs[1] - runs[0] < 5.0
+
+
+def test_poke_during_run_queues_immediate_rerun(sched):
+    gate = threading.Event()
+    runs = []
+
+    def fn():
+        runs.append(1)
+        if len(runs) == 1:
+            gate.wait(5.0)
+
+    job = sched.add_job("busy", fn, interval=3600.0)
+    sched.start()
+    assert _wait_for(lambda: len(runs) == 1)
+    job.poke()  # lands while the first run is still in flight
+    gate.set()
+    assert _wait_for(lambda: len(runs) == 2)
+
+
+def test_adaptive_interval_reread_after_every_run(sched):
+    interval = [3600.0]
+    runs = []
+    sched.add_job("adaptive", lambda: runs.append(1),
+                  interval_fn=lambda: interval[0], jitter=False)
+    sched.start()
+    assert _wait_for(lambda: len(runs) == 1)
+    # fast-poll window opens (the ICI pattern): the NEXT deadline must
+    # use the new value — re-read after the poked run, no restart needed
+    interval[0] = 0.01
+    sched.poke("adaptive")
+    assert _wait_for(lambda: len(runs) >= 4)
+
+
+def test_failing_job_is_rescheduled(sched):
+    runs = []
+
+    def fn():
+        runs.append(1)
+        raise RuntimeError("boom")
+
+    sched.add_job("crashy", fn, interval=0.02)
+    sched.start()
+    assert _wait_for(lambda: len(runs) >= 3)
+    assert sched.get_job("crashy").failures >= 3
+
+
+# -- pool saturation --------------------------------------------------------
+def test_pool_saturation_counts_and_all_jobs_complete():
+    s = Scheduler(workers=1, hang_timeout=60.0)
+    try:
+        before = _c_saturation.get()
+        gate = threading.Event()
+        done = []
+        for i in range(3):
+            s.add_job(f"slow-{i}",
+                      lambda i=i: (gate.wait(5.0), done.append(i)),
+                      interval=3600.0)
+        s.start()
+        # one worker, three due jobs: at least two dispatches saw a full
+        # pool and had to queue
+        assert _wait_for(lambda: _c_saturation.get() >= before + 2)
+        gate.set()
+        assert s.wait_first_runs(timeout=5.0) is not None
+        assert sorted(done) == [0, 1, 2]
+    finally:
+        s.close()
+
+
+# -- watchdog ---------------------------------------------------------------
+def test_watchdog_sacrifices_worker_and_keeps_cadence():
+    s = Scheduler(workers=1, hang_timeout=0.15)
+    try:
+        release = threading.Event()
+        hangs = []
+        fast_runs = []
+        s.add_job("wedged", lambda: release.wait(10.0), interval=3600.0,
+                  on_hang=lambda e: hangs.append(e))
+        s.add_job("fast", lambda: fast_runs.append(1), interval=0.03)
+        before = _c_watchdog.get(labels={"job": "wedged"})
+        s.start()
+        # the wedged job occupies the single worker; the watchdog must
+        # fire, spawn a replacement, and the fast job must keep cadence
+        assert _wait_for(lambda: hangs)
+        assert hangs[0] >= 0.15
+        assert _c_watchdog.get(labels={"job": "wedged"}) == before + 1
+        n0 = len(fast_runs)
+        assert _wait_for(lambda: len(fast_runs) >= n0 + 3)
+        assert s.stats()["workers"] == 2  # sacrificial + replacement
+        # release: the sacrificial thread finishes its job and retires,
+        # the pool shrinks back to its configured size
+        release.set()
+        assert _wait_for(lambda: s.stats()["workers"] == 1)
+        # the formerly-hung job reschedules normally afterwards
+        assert s.get_job("wedged").runs >= 1
+    finally:
+        s.close()
+
+
+def test_hung_component_marked_degraded_stale():
+    class WedgedComp(PollingComponent):
+        NAME = "wedged-comp"
+
+        def __init__(self, inst):
+            super().__init__(inst)
+            self.release = threading.Event()
+
+        def check_once(self):
+            self.release.wait(10.0)
+            return CheckResult(self.NAME, reason="finally fine")
+
+    s = Scheduler(workers=2, hang_timeout=0.15)
+    inst = TpudInstance(scheduler=s)
+    comp = WedgedComp(inst)
+    try:
+        comp.start()
+        assert comp._job is not None  # scheduler path, no thread
+        assert comp._thread is None
+        s.start()
+        assert _wait_for(
+            lambda: comp.last_health_states()[0].health
+            == HealthStateType.DEGRADED
+        )
+        state = comp.last_health_states()[0]
+        assert "check stale" in state.reason
+        # the real check eventually returning overwrites the stale marker
+        comp.release.set()
+        assert _wait_for(
+            lambda: comp.last_health_states()[0].health
+            == HealthStateType.HEALTHY
+        )
+    finally:
+        comp.close()
+        s.close()
+
+
+# -- jitter -----------------------------------------------------------------
+def test_jitter_is_deterministic_and_bounded():
+    s1 = Scheduler(jitter_fraction=0.05)
+    s2 = Scheduler(jitter_fraction=0.05)
+    from gpud_tpu.scheduler.core import Job
+
+    for name in ("component:cpu", "component:disk", "metrics-syncer"):
+        j = Job(name, lambda: None, lambda: 60.0)
+        v1 = s1._jittered(j, 60.0)
+        v2 = s2._jittered(j, 60.0)
+        assert v1 == v2  # stable across instances (and restarts)
+        assert 57.0 <= v1 <= 63.0  # within ±5%
+    # distinct names spread out (the whole point of jitter)
+    vals = {
+        s1._jittered(Job(n, lambda: None, lambda: 60.0), 60.0)
+        for n in ("component:cpu", "component:disk", "component:memory",
+                  "component:os", "component:pci")
+    }
+    assert len(vals) > 1
+    # jitter=False pins the exact cadence
+    j = Job("exact", lambda: None, lambda: 60.0, jitter_fraction=0.0)
+    assert s1._jittered(j, 60.0) == 60.0
+
+
+# -- covering indexes (satellite: since-scan query plans) -------------------
+def test_eventstore_since_scan_uses_timestamp_index():
+    from gpud_tpu import eventstore
+    from gpud_tpu.sqlite import DB
+
+    db = DB(":memory:")
+    try:
+        es = eventstore.EventStore(db)
+        es.bucket("cpu").insert(
+            eventstore.Event(component="cpu", time=1.0, name="ev",
+                             type="Warning", message="m")
+        )
+        plan = " ".join(
+            str(r[-1]) for r in db.query(
+                "EXPLAIN QUERY PLAN "
+                f"SELECT component, timestamp FROM {eventstore.TABLE} "
+                "WHERE timestamp>=? ORDER BY timestamp DESC",
+                (0.0,),
+            )
+        )
+        assert f"idx_{eventstore.TABLE}_ts" in plan
+        assert "SCAN" not in plan.replace(
+            f"USING INDEX idx_{eventstore.TABLE}_ts", ""
+        ) or "USING INDEX" in plan
+        es.close()
+    finally:
+        db.close()
+
+
+def test_health_history_since_scan_uses_timestamp_index():
+    from gpud_tpu import health_history
+    from gpud_tpu.sqlite import DB
+
+    db = DB(":memory:")
+    try:
+        ledger = health_history.HealthLedger(db)
+        plan = " ".join(
+            str(r[-1]) for r in db.query(
+                "EXPLAIN QUERY PLAN "
+                f"SELECT component, timestamp FROM {health_history.TABLE} "
+                "WHERE timestamp>=? ORDER BY timestamp DESC",
+                (0.0,),
+            )
+        )
+        assert f"idx_{health_history.TABLE}_ts" in plan
+        ledger.close()
+    finally:
+        db.close()
+
+
+# -- lifecycle --------------------------------------------------------------
+def test_close_without_start_is_safe():
+    s = Scheduler()
+    s.add_job("never", lambda: None, interval=1.0)
+    s.close()
+    assert s.submit("late", lambda: None) is None  # refused after close
+
+
+def test_stats_shape(sched):
+    sched.add_job("s", lambda: None, interval=3600.0)
+    sched.start()
+    sched.wait_first_runs(timeout=5.0)
+    st = sched.stats()
+    assert st["jobs"] == 1
+    assert st["workers"] == 2
+    assert st["workers_busy"] == 0
+    assert st["dispatch_lag_p95_seconds"] >= 0.0
+    assert st["startup_ready_seconds"] is not None
