@@ -382,7 +382,116 @@ def bench_footprint(measure_seconds: float = 185.0):
             proc.kill()
 
 
-def main() -> int:
+def bench_chaos(scenario: str) -> int:
+    """``--chaos`` mode: boot a daemon + fake control plane, run one (or
+    ``all``) shipped chaos scenario(s) synchronously, report per-fault
+    detection p50/p95 and the expectation pass-rate on stderr, and print
+    one JSON line. Exit code gates on EVERY expectation passing."""
+    os.environ["TPUD_TPU_MOCK_ALL_SUCCESS"] = "1"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    from gpud_tpu.chaos.fake_plane import FakeControlPlane
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    tmp = tempfile.mkdtemp(prefix="tpud-chaos-bench-")
+    kmsg = os.path.join(tmp, "kmsg.fixture")
+    open(kmsg, "w").close()
+    cp = FakeControlPlane()
+    cp.start()
+    cfg = default_config(
+        data_dir=os.path.join(tmp, "data"),
+        port=0,
+        tls=False,
+        kmsg_path=kmsg,
+        endpoint=f"http://127.0.0.1:{cp.port}",
+        token="chaos-bench-token",
+        machine_id="chaos-bench-1",
+    )
+    srv = Server(config=cfg)
+    srv.start()
+    results = []
+    try:
+        if not cp.connected.wait(15):
+            print("[chaos] WARNING: session never connected to the fake "
+                  "control plane; plane expectations will fail",
+                  file=sys.stderr)
+        srv.chaos.plane = cp
+        names = (
+            sorted(srv.chaos.list_scenarios())
+            if scenario == "all"
+            else [scenario]
+        )
+        for name in names:
+            res, err = srv.chaos.run_campaign(name, wait=True)
+            if err:
+                print(f"[chaos] {name}: ERROR {err}", file=sys.stderr)
+                results.append(
+                    {"scenario": name, "passed": False,
+                     "error": err, "phases": []}
+                )
+            else:
+                results.append(res)
+    finally:
+        srv.stop()
+        cp.stop()
+
+    detect_ms = []
+    expect_total = expect_passed = 0
+    for res in results:
+        for ph in res.get("phases", []):
+            for exp in ph.get("expectations", []):
+                expect_total += 1
+                expect_passed += 1 if exp.get("ok") else 0
+                if exp.get("latency_seconds") is not None:
+                    detect_ms.append(exp["latency_seconds"] * 1000.0)
+        verdict = "PASS" if res.get("passed") else "FAIL"
+        print(
+            f"[chaos] {res.get('scenario', '?')}: {verdict} "
+            f"({len(res.get('phases', []))} phase(s), "
+            f"{res.get('duration_seconds', 0):g}s"
+            f"{', error: ' + res['error'] if res.get('error') else ''})",
+            file=sys.stderr,
+        )
+    if detect_ms:
+        detect_ms.sort()
+        p50 = statistics.median(detect_ms)
+        p95 = detect_ms[int(0.95 * (len(detect_ms) - 1))]
+        print(
+            f"[chaos] fault-detect across campaigns: n={len(detect_ms)} "
+            f"p50={p50:.1f}ms p95={p95:.1f}ms",
+            file=sys.stderr,
+        )
+    rate = (expect_passed / expect_total) if expect_total else 0.0
+    print(
+        f"[chaos] expectations: {expect_passed}/{expect_total} passed "
+        f"(rate={rate:.3f})",
+        file=sys.stderr,
+    )
+    all_passed = bool(results) and all(r.get("passed") for r in results)
+    print(json.dumps({
+        "metric": "chaos expectation pass-rate",
+        "value": round(rate, 3),
+        "unit": "ratio",
+        "vs_baseline": 1.0 if all_passed else 0.0,
+    }))
+    return 0 if all_passed else 1
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="tpud benchmark (one JSON line on stdout)"
+    )
+    ap.add_argument(
+        "--chaos", default="", metavar="SCENARIO",
+        help="run a chaos campaign against a live daemon instead of the "
+             "standard bench; a shipped scenario name, or 'all'",
+    )
+    args = ap.parse_args(argv)
+    if args.chaos:
+        return bench_chaos(args.chaos)
     res = bench_fault_detection()
     # the secondary benches are stderr-only color; none may take down the
     # primary JSON line. The footprint bench additionally gates on the

@@ -1,0 +1,27 @@
+"""Chaos campaign harness (docs/chaos.md).
+
+A campaign is a declarative scenario file — a timeline of timed fault
+steps grouped into phases, each phase closed by an expectation block —
+executed against a *live* daemon through the unified scheduler. The fault
+injector has always been both product feature and test harness (SURVEY
+§4.7); this package extends that stance from one-shot kmsg writes to
+compound failure storms: bursts/flaps, slow-ramp metric faults, runtime
+crashes mid-remediation, clock skew, and control-plane disconnect storms.
+
+Surface:
+  - :mod:`gpud_tpu.chaos.scenario` — schema, loading, timeline expansion
+  - :mod:`gpud_tpu.chaos.faults` — the injectable fault actions
+  - :mod:`gpud_tpu.chaos.expectations` — per-phase assertion evaluation
+  - :mod:`gpud_tpu.chaos.runner` — CampaignRunner + ChaosManager (wired
+    into the server, HTTP, session, SDK, CLI)
+  - :mod:`gpud_tpu.chaos.fake_plane` — reusable fake control plane
+  - ``gpud_tpu/chaos/scenarios/`` — shipped canonical campaigns
+"""
+
+from gpud_tpu.chaos.runner import CampaignRunner, ChaosManager  # noqa: F401
+from gpud_tpu.chaos.scenario import (  # noqa: F401
+    Scenario,
+    expand_steps,
+    load_scenario,
+    shipped_scenarios,
+)

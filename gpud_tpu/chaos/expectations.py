@@ -1,0 +1,343 @@
+"""Per-phase chaos expectations: did the whole chain actually hold?
+
+After a phase's timeline drains, the runner evaluates the phase's
+``expect`` block against the live daemon. Each kind asserts one link of
+the detect→ledger→remediate→audit chain, plus the graceful-degradation
+invariants that hold the daemon itself to account:
+
+  detect:       an event (eventstore) or a ledger transition appears
+                within the latency bound — detection latency is measured
+                from the phase's first fault step and histogrammed
+  ledger:       health_history.py recorded the expected transitions
+  remediation:  the engine's policy decided as expected and the audit
+                ledger has the rows to prove it
+  events:       eventstore contents (name/message/count)
+  plane:        the agent's control-plane session reconnected
+  invariants:   zero unhandled worker exceptions (scheduler failure +
+                watchdog counters flat), un-faulted job cadence within
+                slack, thread-count and RSS gates
+
+Everything polls on the campaign context's injectable clock
+(``ctx.time_fn`` / ``ctx.sleep_fn``) so the evaluation logic itself is
+unit-testable under a fake clock (tests/test_chaos.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+POLL_INTERVAL = 0.02
+
+# how far before the phase start the evidence queries reach: kmsg event
+# times are reconstructed from boot-relative stamps (writer and watcher
+# each read /proc/uptime at centisecond resolution), so an event for a
+# phase-offset-0 fault can carry a timestamp a few tens of ms before the
+# runner's phase_start
+SINCE_SLACK = 0.25
+
+
+@dataclass
+class ExpectationResult:
+    kind: str
+    ok: bool
+    detail: str = ""
+    latency_seconds: Optional[float] = None
+    timed_out: bool = False
+
+    def to_dict(self) -> Dict:
+        out = {"kind": self.kind, "ok": self.ok, "detail": self.detail}
+        if self.latency_seconds is not None:
+            out["latency_seconds"] = round(self.latency_seconds, 6)
+        if self.timed_out:
+            out["timed_out"] = True
+        return out
+
+
+def _poll(pred, deadline: float, ctx):
+    """Run ``pred`` until it returns a truthy value or ``deadline``
+    passes; returns the value or None."""
+    while True:
+        got = pred()
+        if got:
+            return got
+        if ctx.time_fn() >= deadline:
+            return None
+        ctx.sleep_fn(POLL_INTERVAL)
+
+
+def counter_total(registry, name: str) -> float:
+    """Sum of a counter across all label sets (0.0 when unregistered)."""
+    for m in registry.all_metrics():
+        if m.name == name:
+            return sum(v for _k, v in m.labels_values())
+    return 0.0
+
+
+def rss_mb() -> Optional[float]:
+    try:
+        with open("/proc/self/status", encoding="ascii", errors="replace") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return None
+
+
+def _eval_detect(server, spec: Dict, ctx) -> ExpectationResult:
+    component = spec.get("component", "")
+    want_event = spec.get("event", "")
+    want_state = spec.get("to", "")
+    contains = spec.get("contains", "")
+    within = float(spec.get("within", ctx.detect_timeout))
+    since = ctx.phase_start - SINCE_SLACK
+    ref = ctx.fault_t0 if ctx.fault_t0 is not None else ctx.phase_start
+    deadline = ref + within
+
+    def find():
+        if want_event:
+            bucket = server.event_store.bucket(component)
+            for e in bucket.get(since):
+                if e.name == want_event and (not contains or contains in e.message):
+                    return e.time or ctx.time_fn()
+        if want_state:
+            for t in server.health_ledger.history(component=component, since=since):
+                if t["to"] == want_state:
+                    return t["time"] or ctx.time_fn()
+        return None
+
+    hit = _poll(find, deadline, ctx)
+    what = want_event or f"transition→{want_state}"
+    if hit is None:
+        return ExpectationResult(
+            "detect", False, timed_out=True,
+            detail=f"{component}: {what} not detected within {within:g}s",
+        )
+    latency = max(0.0, float(hit) - ref)
+    return ExpectationResult(
+        "detect", True, latency_seconds=latency,
+        detail=f"{component}: {what} detected in {latency * 1000.0:.1f}ms",
+    )
+
+
+def _eval_ledger(server, specs: List[Dict], ctx) -> List[ExpectationResult]:
+    out = []
+    since = ctx.phase_start - SINCE_SLACK
+    for spec in specs:
+        component = spec.get("component", "")
+        to = spec.get("to", "")
+        frm = spec.get("from", "")
+        min_count = int(spec.get("min_count", 1))
+        deadline = ctx.time_fn() + float(spec.get("within", ctx.detect_timeout))
+
+        def matches(spec_c=component, spec_to=to, spec_from=frm, n=min_count):
+            rows = [
+                t
+                for t in server.health_ledger.history(component=spec_c, since=since)
+                if (not spec_to or t["to"] == spec_to)
+                and (not spec_from or t["from"] == spec_from)
+            ]
+            return rows if len(rows) >= n else None
+
+        rows = _poll(matches, deadline, ctx)
+        desc = f"{component}: {frm or '*'}→{to or '*'} x{min_count}"
+        if rows is None:
+            out.append(ExpectationResult(
+                "ledger", False, timed_out=True,
+                detail=f"{desc} — not recorded",
+            ))
+        else:
+            out.append(ExpectationResult(
+                "ledger", True, detail=f"{desc} — {len(rows)} recorded",
+            ))
+    return out
+
+
+def _eval_remediation(server, specs: List[Dict], ctx) -> List[ExpectationResult]:
+    eng = server.remediation
+    if eng is None:
+        return [ExpectationResult(
+            "remediation", False, detail="remediation engine disabled",
+        )]
+    eng.poke()  # the scan cadence (30s default) must not gate a campaign
+    out = []
+    since = ctx.phase_start - SINCE_SLACK
+    for spec in specs:
+        component = spec.get("component", "")
+        decision = spec.get("decision", "")
+        outcome = spec.get("outcome", "")
+        action = spec.get("action", "")
+        min_count = int(spec.get("min_count", 1))
+        deadline = ctx.time_fn() + float(spec.get("within", ctx.detect_timeout))
+
+        def matches(c=component, d=decision, o=outcome, a=action, n=min_count):
+            rows = [
+                r
+                for r in eng.audit.read(component=c or None, since=since)
+                if (not d or r["decision"] == d)
+                and (not o or r["outcome"] == o)
+                and (not a or r["action"] == a)
+            ]
+            return rows if len(rows) >= n else None
+
+        rows = _poll(matches, deadline, ctx)
+        desc = (
+            f"{component or '*'}: decision={decision or '*'} "
+            f"outcome={outcome or '*'} action={action or '*'} x{min_count}"
+        )
+        if rows is None:
+            out.append(ExpectationResult(
+                "remediation", False, timed_out=True,
+                detail=f"{desc} — no matching audit row",
+            ))
+        else:
+            out.append(ExpectationResult(
+                "remediation", True,
+                detail=f"{desc} — {len(rows)} audit row(s)",
+            ))
+    return out
+
+
+def _eval_events(server, specs: List[Dict], ctx) -> List[ExpectationResult]:
+    out = []
+    since = ctx.phase_start - SINCE_SLACK
+    for spec in specs:
+        component = spec.get("component", "")
+        name = spec.get("name", "")
+        contains = spec.get("contains", "")
+        count_min = int(spec.get("count_min", 1))
+        deadline = ctx.time_fn() + float(spec.get("within", ctx.detect_timeout))
+
+        def matches(c=component, nm=name, sub=contains, n=count_min):
+            evs = [
+                e
+                for e in server.event_store.bucket(c).get(since)
+                if (not nm or e.name == nm) and (not sub or sub in e.message)
+            ]
+            return evs if len(evs) >= n else None
+
+        evs = _poll(matches, deadline, ctx)
+        desc = f"{component} events name={name or '*'} >= {count_min}"
+        if evs is None:
+            out.append(ExpectationResult(
+                "events", False, timed_out=True, detail=f"{desc} — absent",
+            ))
+        else:
+            out.append(ExpectationResult(
+                "events", True, detail=f"{desc} — {len(evs)} present",
+            ))
+    return out
+
+
+def _eval_plane(server, spec: Dict, ctx) -> ExpectationResult:
+    if ctx.plane is None:
+        return ExpectationResult(
+            "plane", False, detail="no fake control plane attached",
+        )
+    within = float(spec.get("within", ctx.detect_timeout))
+    deadline = ctx.time_fn() + within
+    if spec.get("reconnected", True):
+        ok = _poll(lambda: ctx.plane.connected.is_set() or None, deadline, ctx)
+        if ok is None:
+            return ExpectationResult(
+                "plane", False, timed_out=True,
+                detail=f"session did not reconnect within {within:g}s",
+            )
+        return ExpectationResult("plane", True, detail="session reconnected")
+    return ExpectationResult("plane", True, detail="no plane assertion")
+
+
+def _eval_invariants(server, spec: Dict, ctx) -> List[ExpectationResult]:
+    out = []
+    reg = server.metrics_registry
+    if spec.get("no_worker_exceptions", True):
+        failures = counter_total(reg, "tpud_scheduler_job_failures_total")
+        watchdog = counter_total(reg, "tpud_scheduler_watchdog_fires_total")
+        df = failures - ctx.baseline.get("failures", 0.0)
+        dw = watchdog - ctx.baseline.get("watchdog", 0.0)
+        ok = df <= 0 and dw <= 0
+        out.append(ExpectationResult(
+            "invariants", ok,
+            detail=(
+                "no unhandled worker exceptions"
+                if ok
+                else f"{df:g} job failure(s), {dw:g} watchdog fire(s) during campaign"
+            ),
+        ))
+    # un-faulted periodic jobs must still be keeping cadence: a job whose
+    # deadline is further in the past than the slack means the scheduler
+    # fell over or the pool starved — graceful degradation failed
+    if spec.get("cadence", True):
+        scheduler = getattr(server, "scheduler", None)
+        late = []
+        if scheduler is not None:
+            now = scheduler.time_fn()
+            for jname in scheduler.job_names():
+                if jname.startswith("chaos"):
+                    continue
+                job = scheduler.get_job(jname)
+                if job is None or job.one_shot or job.running:
+                    continue
+                try:
+                    interval = float(job.interval_fn())
+                except Exception:  # noqa: BLE001
+                    continue
+                if interval <= 0:
+                    continue
+                slack = float(
+                    spec.get("cadence_slack_seconds", max(2.0, interval))
+                )
+                if now - job.due > slack:
+                    late.append(f"{jname} ({now - job.due:.1f}s late)")
+        out.append(ExpectationResult(
+            "invariants", not late,
+            detail=(
+                "un-faulted job cadence within slack"
+                if not late
+                else "cadence broken: " + ", ".join(late)
+            ),
+        ))
+    max_threads = spec.get("max_threads")
+    if max_threads is not None:
+        n = threading.active_count()
+        out.append(ExpectationResult(
+            "invariants", n <= int(max_threads),
+            detail=f"threads {n} (gate <= {int(max_threads)})",
+        ))
+    max_rss = spec.get("max_rss_mb")
+    if max_rss is not None:
+        mb = rss_mb()
+        if mb is None:
+            out.append(ExpectationResult(
+                "invariants", True, detail="RSS unreadable; gate skipped",
+            ))
+        else:
+            out.append(ExpectationResult(
+                "invariants", mb <= float(max_rss),
+                detail=f"RSS {mb:.1f}MB (gate <= {float(max_rss):g}MB)",
+            ))
+    return out
+
+
+def evaluate_phase(server, expect: Dict, ctx) -> List[ExpectationResult]:
+    """Evaluate a phase's full expectation block, in chain order."""
+    results: List[ExpectationResult] = []
+    if "detect" in expect:
+        results.append(_eval_detect(server, expect["detect"] or {}, ctx))
+    if "ledger" in expect:
+        results.extend(_eval_ledger(server, expect["ledger"] or [], ctx))
+    if "remediation" in expect:
+        results.extend(_eval_remediation(server, expect["remediation"] or [], ctx))
+    if "events" in expect:
+        results.extend(_eval_events(server, expect["events"] or [], ctx))
+    if "plane" in expect:
+        results.append(_eval_plane(server, expect["plane"] or {}, ctx))
+    if "invariants" in expect:
+        results.extend(_eval_invariants(server, expect["invariants"] or {}, ctx))
+    return results

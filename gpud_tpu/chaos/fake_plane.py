@@ -1,0 +1,238 @@
+"""Reusable fake control plane: tpud session protocol + chaos knobs.
+
+A minimal control plane implementing the dual chunked-ndjson session
+streams and ``/api/v1/login``, shared by the e2e tests
+(``tests/fake_control_plane.py`` re-exports this class) and the chaos
+campaign runner (``plane_disconnect`` steps). Beyond the protocol it
+carries the fault knobs a disconnect/latency campaign needs:
+
+  - ``reject_auth`` / ``accept_token``: 401 storms and token rotation
+  - ``latency_seconds``: injected delay before a session stream starts
+    serving and before each pushed frame (slow-control-plane modelling)
+  - ``drop_session`` / ``drop_all`` / ``disconnect_storm``: scripted
+    disconnect/reconnect churn against the agent's session loop
+
+Run standalone: ``python -m gpud_tpu.chaos.fake_plane <port>``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+from aiohttp import web
+
+
+class FakeControlPlane:
+    def __init__(self, port: int = 0) -> None:
+        self.port = port
+        self.sessions: Dict[str, asyncio.Queue] = {}   # machine_id → outbound q
+        self.responses: List[dict] = []
+        self.logins: List[dict] = []
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.connected = threading.Event()
+        self.reject_auth = False   # return 401 on session streams
+        self.accept_token: Optional[str] = None  # 401 any other bearer token
+        self.auth_rejects = 0
+        # chaos knobs
+        self.latency_seconds = 0.0  # injected delay per stream-start/frame
+        self.connects = 0           # read-stream accepts (reconnect counting)
+        self.drops = 0              # sessions dropped via drop_session/drop_all
+
+    # -- server ------------------------------------------------------------
+    async def _login(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        self.logins.append(body)
+        return web.json_response(
+            {
+                "machine_id": body.get("machine_id") or "cp-assigned-1",
+                "token": "cp-session-token",
+                "machine_proof": "cp-proof",
+            }
+        )
+
+    async def _session(self, req: web.Request) -> web.StreamResponse:
+        if self.reject_auth:
+            self.auth_rejects += 1
+            return web.Response(status=401, text="unauthorized")
+        if self.accept_token is not None:
+            bearer = req.headers.get("Authorization", "")
+            if bearer.removeprefix("Bearer ").strip() != self.accept_token:
+                self.auth_rejects += 1
+                return web.Response(status=401, text="unauthorized")
+        if self.latency_seconds > 0:
+            await asyncio.sleep(self.latency_seconds)
+        stype = req.headers.get("X-TPUD-Session-Type", "")
+        machine = req.headers.get("X-TPUD-Machine-ID", "")
+        if stype == "read":
+            resp = web.StreamResponse()
+            resp.headers["Content-Type"] = "application/x-ndjson"
+            await resp.prepare(req)
+            q: asyncio.Queue = asyncio.Queue()
+            self.sessions[machine] = q
+            self.connects += 1
+            self.connected.set()
+            try:
+                while True:
+                    frame = await q.get()
+                    if frame is None:
+                        break
+                    if self.latency_seconds > 0:
+                        await asyncio.sleep(self.latency_seconds)
+                    if isinstance(frame, bytes):
+                        # raw bytes (hostile-manager tests): sent verbatim
+                        await resp.write(frame)
+                    else:
+                        await resp.write((json.dumps(frame) + "\n").encode())
+            except (ConnectionResetError, asyncio.CancelledError):
+                pass
+            return resp
+        if stype == "write":
+            async for line in req.content:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    self.responses.append(json.loads(line))
+                except ValueError:
+                    pass
+            return web.json_response({"ok": True})
+        return web.json_response({"error": "bad session type"}, status=400)
+
+    # -- control API for tests / campaigns -----------------------------------
+    def send_request(self, machine_id: str, req_id: str, data: dict) -> None:
+        q = self.sessions.get(machine_id)
+        if q is None:
+            raise RuntimeError(f"no session for {machine_id}")
+        asyncio.run_coroutine_threadsafe(
+            q.put({"req_id": req_id, "data": data}), self._loop
+        ).result(timeout=5)
+
+    def send_raw(self, machine_id: str, payload: bytes) -> None:
+        """Push raw bytes down the read stream (malformed-frame tests)."""
+        q = self.sessions.get(machine_id)
+        if q is None:
+            raise RuntimeError(f"no session for {machine_id}")
+        asyncio.run_coroutine_threadsafe(q.put(payload), self._loop).result(
+            timeout=5
+        )
+
+    def drop_session(self, machine_id: str) -> None:
+        """End the read stream, forcing the agent to reconnect (used with
+        accept_token changes to model a mid-stream revocation)."""
+        q = self.sessions.pop(machine_id, None)
+        if q is None:
+            raise RuntimeError(f"no session for {machine_id}")
+        self.connected.clear()
+        self.drops += 1
+        asyncio.run_coroutine_threadsafe(q.put(None), self._loop).result(
+            timeout=5
+        )
+
+    def drop_all(self) -> int:
+        """Drop every live session (chaos ``plane_disconnect`` step);
+        returns how many were dropped."""
+        n = 0
+        for machine in list(self.sessions):
+            try:
+                self.drop_session(machine)
+                n += 1
+            except RuntimeError:
+                continue
+        return n
+
+    def disconnect_storm(self, count: int, interval: float = 0.5) -> int:
+        """Scripted churn: drop all sessions ``count`` times, waiting out
+        ``interval`` between rounds (and for the agent to reconnect, up
+        to the same interval). Returns total sessions dropped."""
+        total = 0
+        for i in range(count):
+            total += self.drop_all()
+            if i < count - 1:
+                self.connected.wait(timeout=max(interval, 0.05))
+                time.sleep(interval)
+        return total
+
+    def wait_response(self, req_id: str, timeout: float = 10.0) -> Optional[dict]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            for r in self.responses:
+                if r.get("req_id") == req_id:
+                    return r
+            time.sleep(0.02)
+        return None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError("fake control plane failed to start")
+
+    def _run(self) -> None:
+        loop = asyncio.new_event_loop()
+        self._loop = loop
+        asyncio.set_event_loop(loop)
+        app = web.Application()
+        app.router.add_post("/api/v1/login", self._login)
+        app.router.add_post("/api/v1/session", self._session)
+        runner = web.AppRunner(app)
+
+        async def go():
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", self.port)
+            await site.start()
+            for s in site._server.sockets:  # noqa: SLF001
+                self.port = s.getsockname()[1]
+            self._started.set()
+
+        try:
+            loop.run_until_complete(go())
+            loop.run_forever()
+        finally:
+            # Tear down in-loop so no aiohttp object outlives its loop
+            # (otherwise GC-time __del__ raises "Event loop is closed").
+            try:
+                loop.run_until_complete(runner.cleanup())
+                loop.run_until_complete(loop.shutdown_asyncgens())
+            except Exception:  # noqa: BLE001
+                pass
+            loop.close()
+
+    def stop(self) -> None:
+        if self._loop is not None and self._loop.is_running():
+            # End open read-stream handlers first: they park on q.get(),
+            # and runner.cleanup() would otherwise wait out its shutdown
+            # timeout on them (leaving the loop thread alive for a minute)
+            async def _drain() -> None:
+                for q in self.sessions.values():
+                    q.put_nowait(None)
+                self.sessions.clear()
+
+            try:
+                asyncio.run_coroutine_threadsafe(_drain(), self._loop).result(
+                    timeout=2
+                )
+            except Exception:  # noqa: BLE001 — loop may be stopping already
+                pass
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+
+if __name__ == "__main__":
+    import sys
+
+    cp = FakeControlPlane(port=int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+    cp.start()
+    print(f"fake control plane on http://127.0.0.1:{cp.port}", flush=True)
+    try:
+        while True:
+            time.sleep(0.5)
+    except KeyboardInterrupt:
+        cp.stop()

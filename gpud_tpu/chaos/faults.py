@@ -1,0 +1,240 @@
+"""Chaos fault actions: what a scenario step does to the live daemon.
+
+Every action is ``fn(server, step, ctx) -> Optional[str]`` (error string
+or None). ``ctx`` is the runner's campaign context: it carries the
+injectable clock (``ctx.time_fn``), the optional fake control plane
+handle (``ctx.plane``) and a ``ctx.cleanups`` list — every action that
+mutates daemon state MUST register an undo there so a campaign always
+leaves the daemon as it found it, pass or fail.
+
+Fault classes beyond the classic one-shot kmsg write:
+
+  - ``inject``       — kmsg write, with burst/flap via ``repeat`` +
+                       ``interval_seconds`` (fault_injector.Request)
+  - ``metric_ramp``  — slow-ramp telemetry fault through the
+                       ``telemetry_fn`` override hook on the hbm /
+                       temperature components (gradual HBM temp climb)
+  - ``runtime_crash``— the runtime component reports its unit failed for
+                       ``duration`` seconds (kill/restart race against
+                       the remediation engine)
+  - ``clock_skew``   — shifts a component's (or the remediation
+                       engine's) injectable clock by ``offset`` seconds
+  - ``plane_disconnect`` — drops control-plane sessions on the fake
+                       plane harness (disconnect/reconnect storms)
+
+plus campaign helpers: ``trigger`` (poke a check), ``set_healthy``,
+``remediation_scan`` (poke the engine), ``purge`` (retention pass now).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional
+
+from gpud_tpu.fault_injector import Request as InjectRequest
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+
+def _component(server, step: Dict):
+    name = step.get("component", "")
+    comp = server.registry.get(name)
+    if comp is None:
+        return None, f"component {name!r} not registered"
+    return comp, None
+
+
+def act_inject(server, step: Dict, ctx) -> Optional[str]:
+    req = InjectRequest(
+        tpu_error_name=step.get("name", ""),
+        chip_id=int(step.get("chip_id", 0)),
+        detail=str(step.get("detail", "")),
+        kernel_message=step.get("kernel_message", ""),
+        repeat=int(step.get("repeat", 1)),
+        interval_seconds=float(step.get("interval_seconds", 0.0)),
+    )
+    res = server.fault_injector.inject(req)
+    return None if res.ok else res.error
+
+
+def act_metric_ramp(server, step: Dict, ctx) -> Optional[str]:
+    """Gradual metric climb: wraps the component's ``telemetry_fn`` hook
+    so every chip's ``field`` reads as a start→end interpolation over
+    ``ramp_seconds`` (then holds at ``end`` until cleared). Telemetry
+    objects are copied per call — the sampler's cache is never mutated."""
+    comp, err = _component(server, step)
+    if err:
+        return err
+    if not hasattr(comp, "telemetry_fn"):
+        return f"component {step.get('component')!r} has no telemetry hook"
+    prev_fn = comp.telemetry_fn  # may be None = "read the live sampler"
+    base_fn = prev_fn or comp.sampler.telemetry
+    fld = step.get("field", "temperature_c")
+    start = float(step.get("start", 0.0))
+    end = float(step.get("end", 0.0))
+    ramp = float(step.get("ramp_seconds", 0.0))
+    chip = step.get("chip_id")  # None = every chip
+    t0 = ctx.time_fn()
+    time_fn = ctx.time_fn
+
+    def ramped():
+        tel = base_fn()
+        frac = 1.0 if ramp <= 0 else min(1.0, (time_fn() - t0) / ramp)
+        val = start + (end - start) * frac
+        out = {}
+        for cid, t in tel.items():
+            if chip is None or cid == int(chip):
+                if not hasattr(t, fld):
+                    out[cid] = t
+                    continue
+                out[cid] = dataclasses.replace(t, **{fld: val})
+            else:
+                out[cid] = t
+        return out
+
+    comp.telemetry_fn = ramped
+    ctx.cleanups.append(lambda: setattr(comp, "telemetry_fn", prev_fn))
+    _poke(comp, server)
+    return None
+
+
+def act_metric_clear(server, step: Dict, ctx) -> Optional[str]:
+    comp, err = _component(server, step)
+    if err:
+        return err
+    if not hasattr(comp, "telemetry_fn"):
+        return f"component {step.get('component')!r} has no telemetry hook"
+    comp.telemetry_fn = None  # back to the live sampler read
+    _poke(comp, server)
+    return None
+
+
+def act_runtime_crash(server, step: Dict, ctx) -> Optional[str]:
+    """The runtime component reports its unit failed until ``duration``
+    elapses — the mid-remediation race: the engine's scan sees the
+    failure, decides (dry-run by default) a restart, and the 'crash'
+    clears underneath it."""
+    name = step.get("component", "accelerator-tpu-runtime")
+    comp = server.registry.get(name)
+    if comp is None:
+        return f"component {name!r} not registered"
+    if not hasattr(comp, "chaos_fail_until"):
+        return f"component {name!r} has no crash hook"
+    duration = float(step.get("duration", 2.0))
+    prev = comp.chaos_fail_until
+    comp.chaos_fail_until = ctx.time_fn() + duration
+    ctx.cleanups.append(lambda: setattr(comp, "chaos_fail_until", prev))
+    _poke(comp, server)
+    return None
+
+
+def act_clock_skew(server, step: Dict, ctx) -> Optional[str]:
+    """Shift an injectable clock by ``offset`` seconds. ``target`` is a
+    component name or ``remediation``. The daemon must keep its cadence
+    and never crash under skew — that is what the invariants assert."""
+    offset = float(step.get("offset", 0.0))
+    target = step.get("target", "") or step.get("component", "")
+    if target == "remediation":
+        eng = server.remediation
+        if eng is None:
+            return "remediation engine disabled"
+        holder = eng
+    else:
+        holder = server.registry.get(target)
+        if holder is None:
+            return f"clock_skew target {target!r} not found"
+    base: Callable[[], float] = getattr(holder, "time_now_fn", None)
+    if base is None:
+        return f"clock_skew target {target!r} has no injectable clock"
+    holder.time_now_fn = lambda: base() + offset
+    ctx.cleanups.append(lambda: setattr(holder, "time_now_fn", base))
+    return None
+
+
+def act_plane_disconnect(server, step: Dict, ctx) -> Optional[str]:
+    """Drop every live control-plane session on the fake plane harness
+    (the agent's session loop must reconnect). Requires the campaign to
+    be driven with a ``FakeControlPlane`` handle (bench --chaos or the
+    e2e tests); a daemon with no plane attached reports the gap."""
+    plane = ctx.plane
+    if plane is None:
+        return "no fake control plane attached to this campaign"
+    dropped = plane.drop_all()
+    logger.info("chaos: dropped %d control-plane session(s)", dropped)
+    return None
+
+
+def act_trigger(server, step: Dict, ctx) -> Optional[str]:
+    comp, err = _component(server, step)
+    if err:
+        return err
+    _poke(comp, server, block=bool(step.get("block", False)))
+    return None
+
+
+def act_set_healthy(server, step: Dict, ctx) -> Optional[str]:
+    comp, err = _component(server, step)
+    if err:
+        return err
+    fn = getattr(comp, "set_healthy", None)
+    if fn is None:
+        return f"component {step.get('component')!r} has no set_healthy"
+    fn()
+    _poke(comp, server)
+    return None
+
+
+def act_remediation_scan(server, step: Dict, ctx) -> Optional[str]:
+    eng = server.remediation
+    if eng is None:
+        return "remediation engine disabled"
+    eng.poke()
+    return None
+
+
+def act_purge(server, step: Dict, ctx) -> Optional[str]:
+    fn = getattr(server, "_purge_retention", None)
+    if fn is None:
+        return "server has no retention purge"
+    scheduler = getattr(server, "scheduler", None)
+    if scheduler is not None and scheduler.submit("chaos:purge", fn):
+        return None
+    fn()
+    return None
+
+
+def _poke(comp, server, block: bool = False) -> None:
+    """Run the component's check now: poked to the front of the heap when
+    scheduler-driven, else a direct (or one-shot) check."""
+    job = getattr(comp, "_job", None)
+    if job is not None and not block:
+        job.poke()
+        return
+    if block:
+        try:
+            comp.check()
+        except Exception:  # noqa: BLE001 — a failing check is the campaign's finding
+            logger.exception("chaos trigger check failed for %s", comp.name())
+        return
+    scheduler = getattr(server, "scheduler", None)
+    if scheduler is not None and scheduler.submit(f"chaos:check:{comp.name()}", comp.check):
+        return
+    try:
+        comp.check()
+    except Exception:  # noqa: BLE001
+        logger.exception("chaos trigger check failed for %s", comp.name())
+
+
+ACTIONS: Dict[str, Callable] = {
+    "inject": act_inject,
+    "metric_ramp": act_metric_ramp,
+    "metric_clear": act_metric_clear,
+    "runtime_crash": act_runtime_crash,
+    "clock_skew": act_clock_skew,
+    "plane_disconnect": act_plane_disconnect,
+    "trigger": act_trigger,
+    "set_healthy": act_set_healthy,
+    "remediation_scan": act_remediation_scan,
+    "purge": act_purge,
+}

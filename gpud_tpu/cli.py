@@ -179,13 +179,15 @@ def cmd_inject_fault(args) -> int:
         chip_id=args.chip_id,
         detail=args.detail or "",
         kernel_message=args.kernel_message or "",
+        repeat=getattr(args, "repeat", 1),
+        interval_seconds=getattr(args, "interval_seconds", 0.0),
     )
     inj = Injector(kmsg_path=args.kmsg_path)
-    err = inj.inject(req)
-    if err:
-        print(f"error: {err}", file=sys.stderr)
+    res = inj.inject(req)
+    if not res.ok:
+        print(f"error: {res.error}", file=sys.stderr)
         return 1
-    print("fault injected")
+    print(f"fault injected ({res.writes} write(s)): {res.line or res.entry}")
     return 0
 
 
@@ -378,6 +380,70 @@ def cmd_remediation(args) -> int:
         )
         print(f"  total {summary['attempts_total']}  ({parts})")
     return 0
+
+
+def cmd_chaos(args) -> int:
+    """Drive the running daemon's chaos campaign runner (docs/chaos.md):
+    ``chaos list`` shows scenarios + past results, ``chaos run`` executes
+    one and exits nonzero unless every expectation passed."""
+    from gpud_tpu.client.v1 import Client, ClientError
+
+    scheme = "http" if getattr(args, "no_tls", False) else "https"
+    # a waited campaign holds the HTTP request for its whole duration
+    c = Client(
+        base_url=f"{scheme}://localhost:{args.port}",
+        timeout=float(args.timeout),
+    )
+    try:
+        if args.chaos_cmd == "list":
+            out = c.get_chaos_campaigns(limit=args.limit)
+            if getattr(args, "as_json", False):
+                print(json.dumps(out, indent=2, sort_keys=True))
+                return 0
+            print("scenarios:")
+            for name in out.get("scenarios", []):
+                print(f"  {name}")
+            running = out.get("running")
+            if running:
+                print(f"running: {running['scenario']} (id {running['id']})")
+            for res in out.get("campaigns", []):
+                verdict = "PASS" if res.get("passed") else "FAIL"
+                print(
+                    f"  #{res.get('id', '?')} {res.get('scenario', '?')}: "
+                    f"{verdict} ({len(res.get('phases', []))} phase(s), "
+                    f"{res.get('duration_seconds', 0):g}s)"
+                )
+            return 0
+        out = c.run_chaos(args.scenario, wait=not args.no_wait)
+    except ClientError as e:
+        print(f"error: {e.body[:500]}", file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001
+        print(f"tpud unreachable on port {args.port}: {e}", file=sys.stderr)
+        return 1
+    if getattr(args, "as_json", False):
+        print(json.dumps(out, indent=2, sort_keys=True))
+    elif args.no_wait:
+        print(f"campaign {out.get('scenario', '?')} launched (id {out.get('id', '?')})")
+    else:
+        for ph in out.get("phases", []):
+            mark = "✔" if ph.get("passed") else "✘"
+            print(f"{mark} phase {ph['name']}")
+            for exp in ph.get("expectations", []):
+                emark = "✔" if exp.get("ok") else "✘"
+                print(f"    {emark} [{exp['kind']}] {exp.get('detail', '')}")
+            for err in ph.get("step_errors", []):
+                print(f"    ✘ step error: {err}")
+        verdict = "PASS" if out.get("passed") else "FAIL"
+        print(
+            f"{verdict}: {out.get('scenario', '?')} "
+            f"({out.get('duration_seconds', 0):g}s)"
+        )
+        if out.get("error"):
+            print(f"campaign error: {out['error']}", file=sys.stderr)
+    if args.no_wait:
+        return 0
+    return 0 if out.get("passed") else 1
 
 
 def cmd_machine_info(args) -> int:
@@ -760,6 +826,10 @@ def build_parser() -> argparse.ArgumentParser:
     pi.add_argument("--chip-id", type=int, default=0)
     pi.add_argument("--detail", default="")
     pi.add_argument("--kernel-message", default="", help="raw kernel message instead of --name")
+    pi.add_argument("--repeat", type=int, default=1,
+                    help="burst: write the fault N times (flap modelling)")
+    pi.add_argument("--interval-seconds", type=float, default=0.0,
+                    help="spacing between burst writes")
     pi.set_defaults(fn=cmd_inject_fault, audited=True)
 
     pst = sub.add_parser("status", help="query the running daemon")
@@ -814,6 +884,30 @@ def build_parser() -> argparse.ArgumentParser:
     prm.add_argument("--json", action="store_true", dest="as_json",
                      help="machine-readable attempts + summary")
     prm.set_defaults(fn=cmd_remediation)
+
+    pch = sub.add_parser(
+        "chaos", help="run declarative chaos campaigns against the daemon"
+    )
+    csub = pch.add_subparsers(dest="chaos_cmd", required=True)
+    cr = csub.add_parser("run", help="execute a scenario; nonzero exit on FAIL")
+    cr.add_argument("scenario",
+                    help="shipped scenario name or a scenario file path")
+    cr.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
+    cr.add_argument("--no-tls", action="store_true")
+    cr.add_argument("--no-wait", action="store_true",
+                    help="launch on the daemon's pool and return immediately")
+    cr.add_argument("--timeout", type=float, default=330.0,
+                    help="HTTP timeout for the waited campaign (seconds)")
+    cr.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable campaign result")
+    cr.set_defaults(fn=cmd_chaos, audited=True)
+    cl = csub.add_parser("list", help="list scenarios and past campaign results")
+    cl.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
+    cl.add_argument("--no-tls", action="store_true")
+    cl.add_argument("--limit", type=int, default=10)
+    cl.add_argument("--timeout", type=float, default=30.0)
+    cl.add_argument("--json", action="store_true", dest="as_json")
+    cl.set_defaults(fn=cmd_chaos)
 
     pmi = sub.add_parser("machine-info", help="print machine info JSON")
     pmi.add_argument("--accelerator-type", default="")

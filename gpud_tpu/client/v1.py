@@ -193,7 +193,12 @@ class Client:
         chip_id: int = 0,
         detail: str = "",
         kernel_message: str = "",
+        repeat: int = 1,
+        interval_seconds: float = 0.0,
     ) -> Dict:
+        """One kmsg fault write — or a burst/flap of ``repeat`` writes
+        spaced ``interval_seconds`` apart. Returns the structured
+        injection result (line, writes, timestamp)."""
         return self._req(
             "POST",
             "/inject-fault",
@@ -202,5 +207,22 @@ class Client:
                 "chip_id": chip_id,
                 "detail": detail,
                 "kernel_message": kernel_message,
+                "repeat": repeat,
+                "interval_seconds": interval_seconds,
             },
         )
+
+    def run_chaos(self, scenario, wait: bool = True) -> Dict:
+        """Run a chaos campaign (``POST /v1/chaos/run``). ``scenario`` is
+        a shipped scenario name, a file path on the daemon host, or an
+        inline scenario mapping; ``wait=False`` launches it and returns
+        the running-campaign status immediately."""
+        return self._req(
+            "POST", "/v1/chaos/run", body={"scenario": scenario, "wait": wait}
+        )
+
+    def get_chaos_campaigns(self, limit: Optional[int] = None) -> Dict:
+        """Chaos campaign history + available scenarios
+        (``/v1/chaos/campaigns``)."""
+        params = {"limit": limit} if limit is not None else None
+        return self._req("GET", "/v1/chaos/campaigns", params=params)

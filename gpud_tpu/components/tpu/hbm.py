@@ -38,6 +38,10 @@ class TPUHbmComponent(PollingComponent):
         super().__init__(instance)
         self.tpu = instance.tpu_instance
         self.sampler = sampler_for(self.tpu)
+        # indirection so chaos campaigns can overlay slow-ramp faults on
+        # the telemetry read without touching the shared sampler cache;
+        # None means "read the live sampler" so late sampler swaps stick
+        self.telemetry_fn = None
         self._event_bucket = (
             instance.event_store.bucket(NAME) if instance.event_store else None
         )
@@ -56,7 +60,7 @@ class TPUHbmComponent(PollingComponent):
                 health=HealthStateType.HEALTHY,
                 reason="no TPU telemetry on this host",
             )
-        tel = self.sampler.telemetry()
+        tel = (self.telemetry_fn or self.sampler.telemetry)()
         ecc_pending = []
         extra = {"telemetry_source": telemetry_source(self.tpu)}
         for cid, t in sorted(tel.items()):

@@ -47,6 +47,10 @@ class TPURuntimeComponent(PollingComponent):
         self.tpu = instance.tpu_instance
         self.units = list(RUNTIME_UNITS)
         self.is_active_fn = self._systemd_is_active
+        # chaos hook: while time_now_fn() < chaos_fail_until the component
+        # reports its unit failed, even on mock backends (runtime-crash-
+        # mid-remediation campaigns race this against the engine's scan)
+        self.chaos_fail_until = 0.0
 
     def is_supported(self) -> bool:
         return self.tpu is not None and self.tpu.tpu_lib_exists()
@@ -63,6 +67,18 @@ class TPURuntimeComponent(PollingComponent):
         return out or "inactive"
 
     def check_once(self) -> CheckResult:
+        if self.time_now_fn() < self.chaos_fail_until:
+            failed = list(self.units[:1])
+            return CheckResult(
+                self.NAME,
+                health=HealthStateType.UNHEALTHY,
+                reason=f"TPU runtime unit(s) failed: {failed} (chaos)",
+                suggested_actions=SuggestedActions(
+                    description="TPU runtime service failed — restart/reboot",
+                    repair_actions=[RepairActionType.REBOOT_SYSTEM],
+                ),
+                extra_info={u: "failed" for u in failed},
+            )
         if self.tpu is not None and self.tpu.is_mock():
             return CheckResult(self.NAME, reason="mock backend; runtime assumed healthy")
         statuses: Dict[str, str] = {u: self.is_active_fn(u) for u in self.units}

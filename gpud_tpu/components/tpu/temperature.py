@@ -34,6 +34,10 @@ class TPUTemperatureComponent(PollingComponent):
         super().__init__(instance)
         self.tpu = instance.tpu_instance
         self.sampler = sampler_for(self.tpu)
+        # indirection so chaos campaigns can overlay slow-ramp faults on
+        # the telemetry read without touching the shared sampler cache;
+        # None means "read the live sampler" so late sampler swaps stick
+        self.telemetry_fn = None
         self.degraded_c = DEFAULT_DEGRADED_C
         self.unhealthy_c = DEFAULT_UNHEALTHY_C
 
@@ -51,7 +55,7 @@ class TPUTemperatureComponent(PollingComponent):
                 health=HealthStateType.HEALTHY,
                 reason="no TPU telemetry on this host",
             )
-        tel = self.sampler.telemetry()
+        tel = (self.telemetry_fn or self.sampler.telemetry)()
         worst = -1.0
         slowdown_chips = []
         extra = {"telemetry_source": telemetry_source(self.tpu)}

@@ -35,6 +35,12 @@ DEFAULT_REMEDIATION_ESCALATION_WINDOW = 3600
 DEFAULT_SCHEDULER_WORKERS = 4
 DEFAULT_SCHEDULER_WATCHDOG = 120         # hang budget per check run (s)
 DEFAULT_SCHEDULER_JITTER = 0.05          # ±5% deterministic cadence jitter
+# chaos campaign runner (docs/chaos.md)
+DEFAULT_CHAOS_MAX_CAMPAIGN_SECONDS = 300
+DEFAULT_CHAOS_HISTORY_LIMIT = 32
+# session-path fault injection rate limit (injectFault token bucket)
+DEFAULT_INJECT_RATE_CAPACITY = 10
+DEFAULT_INJECT_RATE_REFILL = 6.0         # one injection token back per 6s
 
 STATE_FILE = "tpud.state"                # reference: default.go:137-157 (gpud.state)
 FIFO_FILE = "tpud.fifo"
@@ -86,6 +92,16 @@ class Config:
         DEFAULT_REMEDIATION_ESCALATION_WINDOW
     )
     remediation_runtime_unit: str = ""   # empty = tpu-runtime.service
+    # chaos campaign runner (docs/chaos.md): enabled by default — running
+    # a campaign still takes an explicit API/CLI call, and every fault is
+    # software-injected and undone on campaign exit
+    chaos_enabled: bool = True
+    chaos_max_campaign_seconds: int = DEFAULT_CHAOS_MAX_CAMPAIGN_SECONDS
+    chaos_history_limit: int = DEFAULT_CHAOS_HISTORY_LIMIT
+    # token bucket on the session injectFault path (a hostile/buggy
+    # control plane must not be able to spam kmsg writes)
+    inject_rate_capacity: int = DEFAULT_INJECT_RATE_CAPACITY
+    inject_rate_refill_seconds: float = DEFAULT_INJECT_RATE_REFILL
     # unified check scheduler (docs/scheduler.md)
     scheduler_workers: int = DEFAULT_SCHEDULER_WORKERS
     scheduler_watchdog_seconds: int = DEFAULT_SCHEDULER_WATCHDOG
@@ -167,6 +183,14 @@ class Config:
             return "remediation escalation threshold must be >= 1"
         if self.remediation_escalation_window_seconds < 60:
             return "remediation escalation window must be >= 60s"
+        if self.chaos_max_campaign_seconds < 1:
+            return "chaos max campaign seconds must be >= 1"
+        if self.chaos_history_limit < 1:
+            return "chaos history limit must be >= 1"
+        if self.inject_rate_capacity < 1:
+            return "inject rate capacity must be >= 1"
+        if self.inject_rate_refill_seconds <= 0:
+            return "inject rate refill must be > 0s"
         if self.scheduler_workers < 1:
             return "scheduler workers must be >= 1"
         if self.scheduler_watchdog_seconds < 0:

@@ -191,16 +191,43 @@ class RemediationEngine:
         with self._mu:
             self._last_scan = now
             for comp in self.registry.all():
-                name = comp.name()
+                # a component may be deregistered / mid-close while this
+                # scan holds a reference to it (chaos campaigns, dynamic
+                # registries): any failure is that component's problem,
+                # recorded as a Warning event — the scan itself never dies
+                name = ""
                 try:
+                    name = comp.name()
                     states = comp.last_health_states()
-                except Exception:  # noqa: BLE001
-                    logger.exception("reading states of %s failed", name)
+                    row = self._scan_component(name, states, now)
+                except Exception as e:  # noqa: BLE001
+                    name = name or comp.__class__.__name__
+                    logger.exception("remediation scan of %s failed", name)
+                    self._emit_scan_warning(name, e, now)
                     continue
-                row = self._scan_component(name, states, now)
                 if row is not None:
                     written.append(row)
         return written
+
+    def _emit_scan_warning(self, name: str, exc: Exception, now: float) -> None:
+        es = self.event_store
+        if es is None:
+            return
+        try:
+            es.bucket(name).insert(
+                Event(
+                    component=name,
+                    time=now,
+                    name="remediation_scan_error",
+                    type=EventType.WARNING,
+                    message=(
+                        f"component unavailable during remediation scan: "
+                        f"{type(exc).__name__}: {exc}"
+                    ),
+                )
+            )
+        except Exception:  # noqa: BLE001 — accounting must not kill the scan
+            logger.exception("scan-warning event emit failed for %s", name)
 
     def _scan_component(
         self, name: str, states, now: float

@@ -403,10 +403,38 @@ def build_app(srv: "Server") -> web.Application:
             ir = InjectRequest.from_dict(body)
         except (TypeError, ValueError) as e:
             return _json({"error": f"invalid inject request: {e}"}, 400)
-        err = await _run_blocking(srv, lambda: srv.fault_injector.inject(ir))
+        res = await _run_blocking(srv, lambda: srv.fault_injector.inject(ir))
+        if not res.ok:
+            return _json({"error": res.error, **res.to_dict()}, 400)
+        return _json({"injected": True, **res.to_dict()})
+
+    async def chaos_run(req: web.Request) -> web.Response:
+        """Run a chaos campaign (body: scenario name or inline mapping;
+        wait=false launches it on the pool and returns immediately)."""
+        if srv.chaos is None:
+            return _json({"error": "chaos is disabled (chaos_enabled)"}, 400)
+        try:
+            body = await req.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            return _json({"error": "invalid JSON body"}, 400)
+        if not isinstance(body, dict):
+            return _json({"error": "body must be a JSON object"}, 400)
+        spec = body.get("scenario")
+        wait = bool(body.get("wait", True))
+        out, err = await _run_blocking(
+            srv, lambda: srv.chaos.run_campaign(spec, wait=wait)
+        )
         if err:
             return _json({"error": err}, 400)
-        return _json({"injected": True})
+        return _json(out)
+
+    async def chaos_campaigns(req: web.Request) -> web.Response:
+        """Chaos campaign results (newest first) + available scenarios
+        (?limit= caps the history returned)."""
+        if srv.chaos is None:
+            return _json({"error": "chaos is disabled (chaos_enabled)"}, 400)
+        limit = int(_qfloat(req, "limit", 0.0))
+        return _json(srv.chaos.campaigns(limit=max(0, limit)))
 
     async def admin_config(_req: web.Request) -> web.Response:
         cfg = srv.config
@@ -539,6 +567,8 @@ def build_app(srv: "Server") -> web.Application:
     r.add_get("/v1/remediation/audit", remediation_audit)
     r.add_get("/v1/remediation/policy", remediation_policy_get)
     r.add_post("/v1/remediation/policy", remediation_policy_post)
+    r.add_post("/v1/chaos/run", chaos_run)
+    r.add_get("/v1/chaos/campaigns", chaos_campaigns)
     r.add_get("/v1/events", events)
     r.add_get("/v1/metrics", metrics_v1)
     r.add_get("/v1/info", info)

@@ -139,6 +139,21 @@ class Server:
             accelerator_type=self.config.accelerator_type_override,
         )
 
+        # chaos campaign runner (docs/chaos.md): loads declarative
+        # scenarios and executes them against this live daemon; running
+        # one always takes an explicit API/CLI call
+        self.chaos = None
+        if self.config.chaos_enabled:
+            from gpud_tpu.chaos import ChaosManager
+
+            self.chaos = ChaosManager(
+                self,
+                history_limit=self.config.chaos_history_limit,
+                max_campaign_seconds=float(
+                    self.config.chaos_max_campaign_seconds
+                ),
+            )
+
         # unified check scheduler: one deadline heap + bounded worker pool
         # owns every periodic job (docs/scheduler.md) — components, metrics
         # scrape/record, retention, remediation scan, update watcher
@@ -431,6 +446,10 @@ class Server:
                 logger.exception("component %s close failed", comp.name())
         if self.remediation is not None:
             self.remediation.close()
+        if self.chaos is not None:
+            # aborts any in-flight campaign's sleeps before the pool the
+            # campaign runs on is drained
+            self.chaos.close()
         # after every job owner cancelled its jobs; before the stores the
         # retention job writes through are closed
         self.scheduler.close()

@@ -65,6 +65,21 @@ class Dispatcher:
         self.exit_fn: Callable[[int], None] = _os._exit  # noqa: SLF001
         self._gossip_inflight = threading.Event()
         self._diagnostic_inflight = threading.Event()
+        # injectFault rate limit — reuses the remediation token bucket
+        # with its own capacity/refill knobs (config inject_rate_*)
+        from gpud_tpu.remediation.policy import Policy as _BucketPolicy
+        from gpud_tpu.remediation.policy import TokenBucket
+
+        cfg = getattr(server, "config", None)
+        self.time_now_fn: Callable[[], float] = time.time
+        self._inject_bucket = TokenBucket(
+            _BucketPolicy(
+                rate_capacity=int(getattr(cfg, "inject_rate_capacity", 10)),
+                rate_refill_seconds=float(
+                    getattr(cfg, "inject_rate_refill_seconds", 6.0)
+                ),
+            )
+        )
 
     def _spawn(self, name: str, fn: Callable[[], None]) -> None:
         """Async session work (gossip/diagnostic can hang on NFS stat)
@@ -361,9 +376,44 @@ class Dispatcher:
         return {"status": "ok"}
 
     def _m_injectFault(self, req: Dict) -> Dict:
+        # token bucket: a hostile or buggy control plane must not be able
+        # to spam kmsg writes through the session (burst requests already
+        # multiply writes server-side via repeat)
+        if not self._inject_bucket.take(self.time_now_fn()):
+            return {
+                "error": "fault injection rate limit exhausted",
+                "retryable": True,
+            }
         ir = InjectRequest.from_dict(req)
-        err = self.server.fault_injector.inject(ir)
-        return {"error": err} if err else {"status": "ok"}
+        res = self.server.fault_injector.inject(ir)
+        out = res.to_dict()
+        if res.ok:
+            out["status"] = "ok"
+        else:
+            out["status"] = "error"
+            out["error"] = res.error
+        return out
+
+    def _m_chaosRun(self, req: Dict) -> Dict:
+        """Launch a chaos campaign (scenario name or inline mapping).
+        Defaults to wait=false: the serve loop is single-threaded per
+        session, so a campaign must not stall queued requests behind it."""
+        chaos = getattr(self.server, "chaos", None)
+        if chaos is None:
+            return {"error": "chaos is disabled (chaos_enabled)"}
+        out, err = chaos.run_campaign(
+            req.get("scenario"), wait=bool(req.get("wait", False))
+        )
+        if err:
+            return {"error": err}
+        return out
+
+    def _m_chaosStatus(self, req: Dict) -> Dict:
+        chaos = getattr(self.server, "chaos", None)
+        if chaos is None:
+            return {"error": "chaos is disabled (chaos_enabled)"}
+        limit = int(req.get("limit") or 0)
+        return chaos.campaigns(limit=max(0, limit))
 
     def _m_bootstrap(self, req: Dict) -> Dict:
         """base64 script exec (reference: session bootstrap)."""

@@ -40,6 +40,7 @@ _RESERVED_LABELS = ("le", "quantile")
 # modules whose import (or cheap construction) registers every metric the
 # daemon can expose — keep in sync with new instrumentation sites
 _METRIC_MODULES = (
+    "gpud_tpu.chaos.runner",
     "gpud_tpu.components.all",
     "gpud_tpu.components.base",
     "gpud_tpu.eventstore",

@@ -1,0 +1,584 @@
+"""Chaos campaign runner (gpud_tpu/chaos/): scenario model, timeline
+expansion + deterministic jitter, fake-clock expectation evaluation,
+injector bursts + the structured result, session-path rate limiting,
+remediation scan tolerance of disappearing components, and a hermetic
+two-fault campaign against a live mock daemon (tier-1)."""
+
+import json
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from gpud_tpu.api.v1.types import Event, EventType, HealthStateType
+from gpud_tpu.chaos.expectations import (
+    ExpectationResult,
+    evaluate_phase,
+)
+from gpud_tpu.chaos.runner import CampaignRunner, _Context
+from gpud_tpu.chaos.scenario import (
+    ScenarioError,
+    expand_steps,
+    load_scenario,
+    shipped_scenarios,
+)
+from gpud_tpu.config import default_config
+from gpud_tpu.fault_injector import Injector
+from gpud_tpu.fault_injector import Request as InjectRequest
+from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
+from gpud_tpu.server.server import Server
+from gpud_tpu.session.dispatch import Dispatcher
+
+
+@pytest.fixture()
+def clock():
+    state = {"now": 1000.0}
+
+    def now():
+        return state["now"]
+
+    now.advance = lambda dt: state.__setitem__("now", state["now"] + dt)
+    return now
+
+
+# -- timeline expansion ------------------------------------------------------
+
+def test_expand_steps_sorted_by_offset():
+    occ = expand_steps([
+        {"action": "trigger", "at": 2.0},
+        {"action": "inject", "at": 0.5},
+        {"action": "purge", "at": 1.0},
+    ])
+    assert [o.action for o in occ] == ["inject", "purge", "trigger"]
+    assert [o.offset for o in occ] == [0.5, 1.0, 2.0]
+
+
+def test_expand_every_count_first_occurrence_exact():
+    occ = expand_steps(
+        [{"action": "trigger", "at": 0.3, "every": 0.6, "count": 4}],
+        key_prefix="sc:p",
+    )
+    assert len(occ) == 4
+    # no jitter configured: exact arithmetic cadence
+    assert [round(o.offset, 6) for o in occ] == [0.3, 0.9, 1.5, 2.1]
+    assert [o.occurrence for o in occ] == [0, 1, 2, 3]
+
+
+def test_expand_jitter_deterministic_and_bounded():
+    steps = [{"action": "trigger", "at": 1.0, "every": 1.0, "count": 8,
+              "jitter": 0.25}]
+    a = expand_steps(steps, key_prefix="scn:phase")
+    b = expand_steps(steps, key_prefix="scn:phase")
+    assert [o.offset for o in a] == [o.offset for o in b]  # crc32-stable
+    assert a[0].offset == 1.0  # first occurrence keeps its exact `at`
+    displaced = False
+    for o in a[1:]:
+        nominal = 1.0 + o.occurrence * 1.0
+        assert abs(o.offset - nominal) <= 0.25 + 1e-9
+        displaced = displaced or abs(o.offset - nominal) > 1e-9
+    assert displaced  # jitter actually moved something
+    # a different key prefix spreads differently
+    c = expand_steps(steps, key_prefix="other:phase")
+    assert [o.offset for o in a] != [o.offset for o in c]
+
+
+def test_expand_occurrence_cap():
+    with pytest.raises(ScenarioError):
+        expand_steps([{"action": "trigger", "every": 0.1, "count": 1001}])
+
+
+# -- scenario validation -----------------------------------------------------
+
+def test_scenario_validation_errors():
+    with pytest.raises(ScenarioError, match="unknown action"):
+        load_scenario({"name": "x", "phases": [
+            {"name": "p", "steps": [{"action": "meteor_strike"}]}]})
+    with pytest.raises(ScenarioError, match="unknown expectation"):
+        load_scenario({"name": "x", "phases": [
+            {"name": "p", "steps": [], "expect": {"vibes": {}}}]})
+    with pytest.raises(ScenarioError, match="needs a name"):
+        load_scenario({"phases": [{"name": "p", "steps": []}]})
+    with pytest.raises(ScenarioError, match="`every` > 0"):
+        load_scenario({"name": "x", "phases": [
+            {"name": "p", "steps": [{"action": "purge", "count": 3}]}]})
+    with pytest.raises(ScenarioError, match="not found"):
+        load_scenario("no-such-scenario")
+
+
+def test_shipped_scenarios_load_and_validate():
+    shipped = shipped_scenarios()
+    assert set(shipped) >= {
+        "thermal-ici-cascade",
+        "runtime-crash-mid-remediation",
+        "flap-storm-retention",
+        "session-disconnect-storm",
+    }
+    for name in shipped:
+        sc = load_scenario(name)  # _parse validates; raises on a bad file
+        assert sc.name == name
+        assert sc.phases
+        # every shipped scenario must fit the default campaign budget
+        budget = sc.duration_estimate() + sc.detect_timeout * len(sc.phases)
+        assert budget <= 300.0
+
+
+# -- fake-clock campaign runner ---------------------------------------------
+
+def test_runner_fake_clock_timeline_order_and_cleanups(clock):
+    calls = []
+    server = SimpleNamespace(
+        metrics_registry=DEFAULT_REGISTRY,
+        scheduler=None,
+        _purge_retention=lambda: calls.append(clock()),
+    )
+    sc = load_scenario({
+        "name": "fake-clock",
+        "phases": [{
+            "name": "p1",
+            "steps": [
+                {"action": "purge", "at": 0.7},
+                {"action": "purge", "at": 0.2},
+            ],
+        }],
+    })
+    runner = CampaignRunner(
+        server, sc, time_fn=clock, sleep_fn=lambda s: clock.advance(s)
+    )
+    res = runner.run()
+    assert res["passed"], res
+    assert res["phases"][0]["steps_executed"] == 2
+    # earlier offset ran first, each no earlier than its due time
+    assert len(calls) == 2 and calls[0] <= calls[1]
+    assert calls[0] >= 1000.2 and calls[1] >= 1000.7
+    assert res["duration_seconds"] >= 0.7
+
+
+def test_runner_step_error_fails_campaign(clock):
+    server = SimpleNamespace(
+        metrics_registry=DEFAULT_REGISTRY,
+        scheduler=None,
+        registry=SimpleNamespace(get=lambda name: None),
+    )
+    sc = load_scenario({
+        "name": "ghost-component",
+        "phases": [{
+            "name": "p1",
+            "steps": [{"action": "trigger", "component": "ghost"}],
+        }],
+    })
+    res = CampaignRunner(
+        server, sc, time_fn=clock, sleep_fn=lambda s: clock.advance(s)
+    ).run()
+    assert not res["passed"]
+    assert "not registered" in res["phases"][0]["step_errors"][0]
+
+
+def test_runner_abort_on_stop_event(clock):
+    stop = threading.Event()
+    stop.set()
+    server = SimpleNamespace(metrics_registry=DEFAULT_REGISTRY, scheduler=None)
+    sc = load_scenario({
+        "name": "aborted",
+        "phases": [{"name": "p1",
+                    "steps": [{"action": "purge", "at": 5.0}]}],
+    })
+    res = CampaignRunner(
+        server, sc, time_fn=clock, sleep_fn=lambda s: clock.advance(s),
+        stop_event=stop,
+    ).run()
+    assert not res["passed"]
+    assert "stopping" in res["error"]
+
+
+# -- fake-clock expectation evaluation ---------------------------------------
+
+class _Bucket:
+    def __init__(self):
+        self.events = []
+
+    def get(self, since):
+        return [e for e in self.events if (e.time or 0.0) >= since]
+
+
+class _EventStore:
+    def __init__(self):
+        self.buckets = {}
+
+    def bucket(self, name):
+        return self.buckets.setdefault(name, _Bucket())
+
+
+class _Ledger:
+    def __init__(self):
+        self.rows = []
+
+    def history(self, component="", since=None):
+        return [
+            r for r in self.rows
+            if r["component"] == component and r["time"] >= (since or 0.0)
+        ]
+
+
+def _fake_server():
+    return SimpleNamespace(
+        event_store=_EventStore(),
+        health_ledger=_Ledger(),
+        metrics_registry=DEFAULT_REGISTRY,
+        scheduler=None,
+        remediation=None,
+    )
+
+
+def _ctx(clock, detect_timeout=2.0):
+    ctx = _Context(
+        time_fn=clock,
+        sleep_fn=lambda s: clock.advance(s),
+        plane=None,
+        detect_timeout=detect_timeout,
+    )
+    ctx.phase_start = clock()
+    return ctx
+
+
+def test_expect_detect_event_pass_with_latency(clock):
+    srv = _fake_server()
+    ctx = _ctx(clock)
+    ctx.fault_t0 = clock()
+    srv.event_store.bucket("c1").events.append(Event(
+        component="c1", time=clock() + 0.4, name="tpu_thermal_trip",
+        type=EventType.CRITICAL, message="boom",
+    ))
+    (r,) = evaluate_phase(
+        srv, {"detect": {"component": "c1", "event": "tpu_thermal_trip"}}, ctx
+    )
+    assert r.ok and r.kind == "detect"
+    assert r.latency_seconds == pytest.approx(0.4, abs=0.01)
+
+
+def test_expect_detect_appears_mid_poll(clock):
+    srv = _fake_server()
+    ctx = _ctx(clock)
+    bucket = srv.event_store.bucket("c1")
+    t_appear = clock() + 0.3
+
+    def sleeping(s):
+        clock.advance(s)
+        if clock() >= t_appear and not bucket.events:
+            bucket.events.append(Event(
+                component="c1", time=clock(), name="late",
+                type=EventType.WARNING, message="",
+            ))
+
+    ctx.sleep_fn = sleeping
+    (r,) = evaluate_phase(
+        srv, {"detect": {"component": "c1", "event": "late"}}, ctx
+    )
+    assert r.ok and not r.timed_out
+
+
+def test_expect_detect_timeout_advances_fake_clock(clock):
+    srv = _fake_server()
+    ctx = _ctx(clock)
+    (r,) = evaluate_phase(
+        srv,
+        {"detect": {"component": "c1", "event": "never", "within": 0.5}},
+        ctx,
+    )
+    assert not r.ok and r.timed_out
+    assert clock() >= 1000.5  # the poll actually waited out the budget
+
+
+def test_expect_ledger_pass_and_fail(clock):
+    srv = _fake_server()
+    ctx = _ctx(clock)
+    srv.health_ledger.rows.append({
+        "component": "c1", "time": clock() + 0.1,
+        "from": HealthStateType.HEALTHY, "to": HealthStateType.UNHEALTHY,
+    })
+    results = evaluate_phase(srv, {"ledger": [
+        {"component": "c1", "to": "Unhealthy"},
+        {"component": "c1", "to": "Unhealthy", "min_count": 2, "within": 0.2},
+    ]}, ctx)
+    assert [r.ok for r in results] == [True, False]
+    assert results[1].timed_out
+
+
+def test_expect_invariants_baseline_and_thread_gate(clock):
+    srv = _fake_server()
+    ctx = _ctx(clock)
+    from gpud_tpu.chaos.expectations import counter_total
+
+    ctx.baseline = {
+        "failures": counter_total(
+            DEFAULT_REGISTRY, "tpud_scheduler_job_failures_total"),
+        "watchdog": counter_total(
+            DEFAULT_REGISTRY, "tpud_scheduler_watchdog_fires_total"),
+    }
+    results = evaluate_phase(srv, {"invariants": {}}, ctx)
+    assert all(r.ok for r in results)  # flat counters + no scheduler
+    # a counter delta vs baseline is an invariant violation
+    ctx.baseline["failures"] -= 1.0
+    results = evaluate_phase(
+        srv, {"invariants": {"cadence": False}}, ctx)
+    assert not results[0].ok and "failure" in results[0].detail
+    # thread gate: any live process exceeds a zero-thread ceiling
+    results = evaluate_phase(srv, {"invariants": {
+        "no_worker_exceptions": False, "cadence": False, "max_threads": 0,
+    }}, ctx)
+    assert not results[0].ok and "threads" in results[0].detail
+
+
+def test_expect_plane_without_harness_fails(clock):
+    (r,) = evaluate_phase(
+        _fake_server(), {"plane": {"reconnected": True}}, _ctx(clock))
+    assert not r.ok and "no fake control plane" in r.detail
+
+
+def test_expectation_result_to_dict():
+    d = ExpectationResult(
+        "detect", True, detail="x", latency_seconds=0.1234567).to_dict()
+    assert d == {"kind": "detect", "ok": True, "detail": "x",
+                 "latency_seconds": 0.123457}
+
+
+# -- injector: structured result + bursts ------------------------------------
+
+def test_injector_structured_result_and_burst(tmp_path):
+    kmsg = tmp_path / "kmsg"
+    kmsg.write_text("")
+    inj = Injector(kmsg_path=str(kmsg))
+    sleeps = []
+    inj.sleep_fn = sleeps.append
+    inj.time_now_fn = lambda: 1234.5
+    res = inj.inject(InjectRequest(
+        tpu_error_name="tpu_ici_link_down", chip_id=3,
+        repeat=3, interval_seconds=0.5,
+    ))
+    assert res.ok and res.error == ""
+    assert res.writes == 3
+    assert res.entry == "tpu_ici_link_down"
+    assert "chip=3" in res.line
+    assert res.timestamp == 1234.5
+    assert sleeps == [0.5, 0.5]  # no pause before the first write
+    assert kmsg.read_text().count("chip=3") == 3
+    d = res.to_dict()
+    assert d["ok"] is True and d["writes"] == 3
+
+
+def test_injector_burst_validation(tmp_path):
+    inj = Injector(kmsg_path=str(tmp_path / "kmsg"))
+    res = inj.inject(InjectRequest(tpu_error_name="tpu_thermal_trip",
+                                   repeat=0))
+    assert not res.ok and "repeat" in res.error
+    res = inj.inject(InjectRequest(tpu_error_name="tpu_thermal_trip",
+                                   repeat=100, interval_seconds=5.0))
+    assert not res.ok and "burst too long" in res.error
+    res = inj.inject(InjectRequest(tpu_error_name="nope"))
+    assert not res.ok and "unknown tpu_error_name" in res.error
+    assert res.writes == 0
+
+
+# -- remediation scan: disappearing components -------------------------------
+
+def test_remediation_scan_survives_vanished_component(tmp_path):
+    from gpud_tpu.eventstore import EventStore
+    from gpud_tpu.remediation.engine import RemediationEngine
+    from gpud_tpu.sqlite import DB
+
+    class _Vanished:
+        def name(self):
+            return "ghost-comp"
+
+        def last_health_states(self):
+            raise RuntimeError("component deregistered mid-scan")
+
+    good_scanned = []
+    good = SimpleNamespace(
+        name=lambda: "ok-comp",
+        last_health_states=lambda: good_scanned.append(1) or [],
+    )
+    registry = SimpleNamespace(all=lambda: [_Vanished(), good])
+    db = DB(":memory:")
+    es = EventStore(DB(":memory:"))
+    eng = RemediationEngine(registry, db, event_store=es)
+    try:
+        rows = eng.scan_once()  # must not raise
+        assert rows == []
+        assert good_scanned  # the scan continued past the bad component
+        evs = es.bucket("ghost-comp").get(0.0)
+        assert evs and evs[0].name == "remediation_scan_error"
+        assert evs[0].type == EventType.WARNING
+        assert "deregistered mid-scan" in evs[0].message
+    finally:
+        eng.close()
+        es.close()
+
+
+# -- live daemon: hermetic campaign + surfaces (tier-1) ----------------------
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("chaos")
+    kmsg = tmp / "kmsg.fixture"
+    kmsg.write_text("")
+    cfg = default_config(
+        data_dir=str(tmp / "data"),
+        port=0,
+        tls=False,
+        kmsg_path=str(kmsg),
+        components_disabled=["network-latency"],
+    )
+    s = Server(config=cfg)
+    s.start()
+    s.scheduler.wait_first_runs(timeout=30.0)
+    yield s
+    s.stop()
+
+
+TWO_FAULT_CAMPAIGN = {
+    "name": "ci-two-fault",
+    "description": "hermetic two-fault drill for tier-1",
+    "defaults": {"detect_timeout": 15.0},
+    "phases": [
+        {
+            "name": "fault",
+            "steps": [
+                {"action": "inject", "name": "tpu_hbm_ecc_uncorrectable",
+                 "chip_id": 1},
+                {"at": 0.1, "action": "inject", "name": "tpu_thermal_trip",
+                 "chip_id": 2, "repeat": 2, "interval_seconds": 0.05},
+            ],
+            "expect": {
+                "detect": {"component": "accelerator-tpu-error-kmsg",
+                           "to": "Unhealthy"},
+                "events": [
+                    {"component": "accelerator-tpu-error-kmsg",
+                     "name": "tpu_hbm_ecc_uncorrectable"},
+                    {"component": "accelerator-tpu-error-kmsg",
+                     "name": "tpu_thermal_trip"},
+                ],
+                "ledger": [
+                    {"component": "accelerator-tpu-error-kmsg",
+                     "to": "Unhealthy"},
+                ],
+                # thermal_trip suggests HARDWARE_INSPECTION, which outranks
+                # the ECC fault's REBOOT_SYSTEM: the policy answers `manual`
+                "remediation": [
+                    {"component": "accelerator-tpu-error-kmsg",
+                     "action": "hardware_inspection", "decision": "manual"},
+                ],
+                "invariants": {"no_worker_exceptions": True, "cadence": True},
+            },
+        },
+        {
+            "name": "recover",
+            "steps": [
+                {"action": "set_healthy",
+                 "component": "accelerator-tpu-error-kmsg"},
+            ],
+            "expect": {
+                "ledger": [
+                    {"component": "accelerator-tpu-error-kmsg",
+                     "from": "Unhealthy", "to": "Healthy"},
+                ],
+                "invariants": {"no_worker_exceptions": True},
+            },
+        },
+    ],
+}
+
+
+def test_campaign_two_faults_end_to_end(srv):
+    # the drill re-runs cleanly, so cooldown must not gate attempt 2
+    srv.remediation.policy.cooldown_seconds = 0.0
+    res, err = srv.chaos.run_campaign(TWO_FAULT_CAMPAIGN, wait=True)
+    assert err is None
+    if not res["passed"]:
+        # one retry absorbs rare watcher/scheduler timing hiccups under
+        # full-suite load; keep the first run's evidence for forensics
+        print("first campaign attempt failed:\n" + json.dumps(res, indent=2))
+        srv.remediation._escalated.clear()
+        res, err = srv.chaos.run_campaign(TWO_FAULT_CAMPAIGN, wait=True)
+        assert err is None
+    assert res["passed"], json.dumps(res, indent=2)
+    assert [p["name"] for p in res["phases"]] == ["fault", "recover"]
+    detect = [e for e in res["phases"][0]["expectations"]
+              if e["kind"] == "detect"]
+    assert detect and detect[0]["latency_seconds"] < 15.0
+    # the run landed in history
+    view = srv.chaos.campaigns()
+    assert view["running"] is None
+    assert view["campaigns"][0]["scenario"] == "ci-two-fault"
+    assert "thermal-ici-cascade" in view["scenarios"]
+
+
+def test_campaign_budget_and_single_flight(srv):
+    _, err = srv.chaos.run_campaign({
+        "name": "too-long",
+        "defaults": {"detect_timeout": 200.0},
+        "phases": [
+            {"name": f"p{i}", "steps": [{"action": "purge"}]}
+            for i in range(3)
+        ],
+    }, wait=True)
+    assert err and "campaign budget" in err
+    _, err = srv.chaos.run_campaign("definitely-not-shipped", wait=True)
+    assert err and "not found" in err
+
+
+def test_chaos_http_surface(srv):
+    from gpud_tpu.client.v1 import Client
+
+    c = Client(base_url=srv.base_url())
+    out = c.run_chaos(
+        {"name": "http-trivial",
+         "phases": [{"name": "p",
+                     "steps": [{"action": "trigger", "component": "cpu"}]}]},
+        wait=True,
+    )
+    assert out["passed"] and out["scenario"] == "http-trivial"
+    view = c.get_chaos_campaigns(limit=5)
+    assert view["count"] >= 1
+    assert set(view["scenarios"]) >= {"flap-storm-retention",
+                                      "session-disconnect-storm"}
+
+
+def test_chaos_dispatch_methods(srv):
+    d = Dispatcher(srv)
+    out = d({"method": "chaosRun", "scenario": {
+        "name": "session-trivial",
+        "phases": [{"name": "p",
+                    "steps": [{"action": "trigger", "component": "cpu"}]}],
+    }, "wait": True})
+    assert out.get("passed") is True
+    out = d({"method": "chaosRun", "scenario": "nope-nope"})
+    assert "not found" in out["error"]
+    out = d({"method": "chaosStatus", "limit": 2})
+    assert out["count"] >= 1 and len(out["campaigns"]) <= 2
+
+
+def test_dispatch_inject_fault_rate_limit(srv):
+    from gpud_tpu.remediation.policy import Policy, TokenBucket
+
+    d = Dispatcher(srv)
+    d._inject_bucket = TokenBucket(
+        Policy(rate_capacity=2, rate_refill_seconds=3600.0))
+    d.time_now_fn = lambda: 5000.0  # frozen: no refill between calls
+    # invalid requests still consume tokens (the limit gates the path,
+    # not just successful writes)
+    for _ in range(2):
+        out = d({"method": "injectFault", "tpu_error_name": "bogus"})
+        assert out["status"] == "error" and "unknown" in out["error"]
+    out = d({"method": "injectFault", "tpu_error_name": "bogus"})
+    assert out.get("retryable") is True
+    assert "rate limit" in out["error"]
+
+
+def test_dispatch_inject_fault_structured_result(srv):
+    d = Dispatcher(srv)
+    out = d({"method": "injectFault",
+             "tpu_error_name": "tpu_ici_link_down", "chip_id": 7})
+    assert out["status"] == "ok" and out["ok"] is True
+    assert out["writes"] == 1 and "chip=7" in out["line"]
+    # leave the module's daemon clean for whoever runs next
+    d({"method": "setHealthy", "component": "accelerator-tpu-error-kmsg"})

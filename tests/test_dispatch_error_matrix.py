@@ -104,6 +104,13 @@ MATRIX = [
     ("remediationPolicy", {"policy": "not-a-dict"}, "no-crash"),
     ("remediationPolicy", {"policy": {"enforce_actions": ["bogus"]}}, "no-crash"),
     ("remediationPolicy", {"policy": {"cooldown_seconds": "forever"}}, "no-crash"),
+    # chaos: missing/unknown/garbage scenarios are clean errors; status
+    # tolerates no filter but rejects a non-numeric limit
+    ("chaosRun", {}, "error"),
+    ("chaosRun", {"scenario": "no-such-scenario"}, "error"),
+    ("chaosRun", {"scenario": 42}, "error"),
+    ("chaosStatus", {}, "ok"),
+    ("chaosStatus", {"limit": "lots"}, "error"),
 ]
 
 

@@ -370,7 +370,7 @@ def test_healthy_unhealthy_healthy_across_restart_is_two_transitions(
         _wait_health(s1, name, HealthStateType.HEALTHY)
         assert s1.fault_injector.inject(
             InjectRequest(tpu_error_name="tpu_hbm_ecc_uncorrectable", chip_id=2)
-        ) is None
+        ).ok
         _wait_health(s1, name, HealthStateType.UNHEALTHY)
     finally:
         s1.stop()

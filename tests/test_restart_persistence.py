@@ -39,10 +39,10 @@ def test_events_and_health_survive_daemon_restart(tmp_path):
     s1 = Server(config=cfg)
     s1.start()
     try:
-        err = s1.fault_injector.inject(
+        res = s1.fault_injector.inject(
             InjectRequest(tpu_error_name="tpu_hbm_ecc_uncorrectable", chip_id=2)
         )
-        assert err is None
+        assert res.ok
         st = _wait_unhealthy(s1, "accelerator-tpu-error-kmsg")
         assert "tpu_hbm_ecc_uncorrectable" in st.reason
     finally:
@@ -86,7 +86,7 @@ def test_db_in_memory_mode_leaves_no_state_file(tmp_path):
     try:
         assert s.fault_injector.inject(
             InjectRequest(tpu_error_name="tpu_power_fault", chip_id=0)
-        ) is None
+        ).ok
         _wait_unhealthy(s, "accelerator-tpu-error-kmsg")
     finally:
         s.stop()

@@ -42,7 +42,7 @@ def test_soak_sustained_injection(tmp_path):
             name = names[injected % len(names)]
             assert srv.fault_injector.inject(
                 InjectRequest(tpu_error_name=name, chip_id=injected % 8)
-            ) is None
+            ).ok
             injected += 1
             if injected % 50 == 0:
                 err_comp.set_healthy()  # keep event history bounded-ish
