@@ -478,6 +478,173 @@ def bench_chaos(scenario: str) -> int:
     return 0 if all_passed else 1
 
 
+INGEST_TARGET_OBS_PER_SEC = 100_000
+
+
+def _rss_mb() -> float:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def bench_ingest(duration: float = 4.0, threads: int = 4) -> int:
+    """``--ingest`` mode: synthetic multi-thread observation firehose
+    through all four stores over the write-behind commit layer
+    (docs/storage.md). Reports sustained obs/sec, flush p95, and RSS
+    delta on stderr; prints one JSON line; exit code gates on the
+    100k obs/sec target. ``vs_baseline`` compares against the same
+    firehose over the synchronous one-commit-per-call path."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import shutil
+    import threading as _threading
+
+    from gpud_tpu.api.v1.types import Event, EventType
+    from gpud_tpu.eventstore import EventStore
+    from gpud_tpu.health_history import HealthLedger
+    from gpud_tpu.metrics.store import MetricsStore
+    from gpud_tpu.remediation.audit import AuditStore
+    from gpud_tpu.scheduler import Scheduler
+    from gpud_tpu.sqlite import DB
+    from gpud_tpu.storage import BatchWriter
+
+    CHUNK = 64        # metric rows per record() call (one scrape's worth)
+    EVENTS_PER = 18   # per chunk → ~70% metrics / 20% events
+    AUDITS_PER = 7    # ~8%
+    OBSERVES_PER = 2  # ~2% health-ledger observes
+    LABELS = '{"component": "bench"}'
+
+    def run(batched: bool, secs: float) -> dict:
+        tmp = tempfile.mkdtemp(prefix="tpud-ingest-")
+        db = DB(os.path.join(tmp, "state.db"))
+        writer = scheduler = None
+        if batched:
+            writer = BatchWriter(
+                db,
+                flush_interval_seconds=0.2,
+                max_pending=200_000,
+                flush_threshold=5_000,
+            )
+            scheduler = Scheduler(workers=2)
+            writer.start(scheduler)
+            scheduler.start()
+        metrics = MetricsStore(db, writer=writer)
+        events = EventStore(db, writer=writer)
+        ledger = HealthLedger(db, writer=writer)
+        audit = AuditStore(db, writer=writer)
+        stop_at = time.monotonic() + secs
+        counts = [0] * threads
+
+        def producer(idx: int) -> None:
+            bucket = events.bucket(f"bench-comp-{idx}")
+            comp = f"bench-comp-{idx}"
+            n = i = 0
+            while time.monotonic() < stop_at:
+                ts = int(time.time())
+                metrics.record([
+                    (ts, f"tpud_bench_m{(i + j) % 512}", LABELS, float(j))
+                    for j in range(CHUNK)
+                ])
+                n += CHUNK
+                now = time.time()
+                for j in range(EVENTS_PER):
+                    bucket.insert(Event(
+                        component=comp, time=now,
+                        name=f"bench_event_{j}", type=EventType.INFO,
+                        message=f"ingest bench {i}/{j}",
+                    ))
+                n += EVENTS_PER
+                for j in range(AUDITS_PER):
+                    audit.record(
+                        comp, "noop", "noop", "Healthy", "bench",
+                        "dry_run", "ok", ts=now,
+                    )
+                n += AUDITS_PER
+                for j in range(OBSERVES_PER):
+                    ledger.observe(
+                        comp,
+                        "Healthy" if (i + j) % 97 else "Degraded",
+                        now=now,
+                    )
+                n += OBSERVES_PER
+                i += CHUNK
+                counts[idx] = n
+
+        rss0 = _rss_mb()
+        t0 = time.monotonic()
+        workers = [
+            _threading.Thread(target=producer, args=(k,), daemon=True)
+            for k in range(threads)
+        ]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        if writer is not None:
+            ok = writer.flush(timeout=30.0)
+            if not ok:
+                print("[ingest] WARNING: final flush barrier timed out",
+                      file=sys.stderr)
+        elapsed = time.monotonic() - t0
+        rss1 = _rss_mb()
+        submitted = sum(counts)
+        wstats = writer.stats() if writer is not None else {}
+        dropped = wstats.get("dropped_ops", 0)
+        if writer is not None:
+            writer.close()
+        if scheduler is not None:
+            scheduler.close()
+        db.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+        return {
+            "obs": submitted - dropped,
+            "dropped": dropped,
+            "elapsed": elapsed,
+            "obs_per_sec": (submitted - dropped) / elapsed if elapsed else 0.0,
+            "flush_p95_ms": wstats.get("flush_p95_seconds", 0.0) * 1000.0,
+            "commits": wstats.get("commits", 0),
+            "committed_ops": wstats.get("committed_ops", 0),
+            "rss_delta_mb": rss1 - rss0,
+        }
+
+    # short synchronous run first: the per-row-commit baseline this layer
+    # replaces (kept deliberately brief — it is slow by construction)
+    base = run(batched=False, secs=min(1.5, duration))
+    res = run(batched=True, secs=duration)
+    ratio = (
+        res["obs_per_sec"] / base["obs_per_sec"] if base["obs_per_sec"] else 0.0
+    )
+    print(
+        f"[ingest] sync baseline: {base['obs_per_sec']:,.0f} obs/sec "
+        f"over {base['elapsed']:.1f}s",
+        file=sys.stderr,
+    )
+    print(
+        f"[ingest] batched: {res['obs_per_sec']:,.0f} obs/sec over "
+        f"{res['elapsed']:.1f}s ({res['obs']:,} obs, "
+        f"{res['commits']} group commits, {res['committed_ops']:,} rows "
+        f"committed, {res['dropped']} dropped) "
+        f"flush p95={res['flush_p95_ms']:.2f}ms "
+        f"rss delta={res['rss_delta_mb']:+.1f}MB "
+        f"[{ratio:.0f}x vs per-row commits; target "
+        f">={INGEST_TARGET_OBS_PER_SEC:,}]",
+        file=sys.stderr,
+    )
+    ok = res["obs_per_sec"] >= INGEST_TARGET_OBS_PER_SEC
+    print(json.dumps({
+        "metric": "batched ingest throughput",
+        "value": round(res["obs_per_sec"], 1),
+        "unit": "obs/sec",
+        "vs_baseline": round(ratio, 1),
+    }))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -489,9 +656,20 @@ def main(argv=None) -> int:
         help="run a chaos campaign against a live daemon instead of the "
              "standard bench; a shipped scenario name, or 'all'",
     )
+    ap.add_argument(
+        "--ingest", action="store_true",
+        help="run the storage-ingest firehose bench (write-behind commit "
+             "layer) instead of the standard bench",
+    )
+    ap.add_argument(
+        "--ingest-seconds", type=float, default=4.0,
+        help="measurement window for --ingest (default 4s)",
+    )
     args = ap.parse_args(argv)
     if args.chaos:
         return bench_chaos(args.chaos)
+    if args.ingest:
+        return bench_ingest(duration=args.ingest_seconds)
     res = bench_fault_detection()
     # the secondary benches are stderr-only color; none may take down the
     # primary JSON line. The footprint bench additionally gates on the

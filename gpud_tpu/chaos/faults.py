@@ -204,6 +204,58 @@ def act_purge(server, step: Dict, ctx) -> Optional[str]:
     return None
 
 
+def act_ingest_burst(server, step: Dict, ctx) -> Optional[str]:
+    """Observation firehose against the live daemon's stores: ``events``
+    events into ``component``'s bucket (default name ``chaos_ingest``)
+    plus one metric row per event — the storm half of the
+    ingest-storm-crash drill. (``count`` is taken by the step-timeline
+    expansion, hence the ``events`` spelling.) Rows ride the write-behind
+    layer when enabled; no cleanup is registered (retention purges them
+    like any other telemetry)."""
+    from gpud_tpu.api.v1.types import Event, EventType
+
+    component = step.get("component", "chaos-ingest")
+    name = step.get("name", "chaos_ingest")
+    count = int(step.get("events", 100))
+    bucket = server.event_store.bucket(component)
+    now = ctx.time_fn()
+    for i in range(count):
+        bucket.insert(Event(
+            component=component, time=now, name=name,
+            type=EventType.INFO, message=f"chaos ingest burst {i}",
+        ))
+        server.metrics_store.record([
+            (int(now), "tpud_chaos_ingest", {"component": component}, float(i))
+        ])
+    return None
+
+
+def act_storage_flush(server, step: Dict, ctx) -> Optional[str]:
+    """Drive the write-behind flush barrier: everything buffered is
+    committed before the step returns (the pre-crash durability line)."""
+    writer = getattr(server, "storage_writer", None)
+    if writer is None:
+        return "storage batching disabled (no write-behind writer)"
+    if not writer.flush(timeout=10.0):
+        return "storage flush barrier timed out"
+    return None
+
+
+def act_storage_crash(server, step: Dict, ctx) -> Optional[str]:
+    """Simulated SIGKILL mid-batch: discard the writer's in-memory buffer
+    WITHOUT committing — exactly the loss window a process kill between
+    group commits costs (the commits themselves are atomic; torn rows are
+    impossible, which tests/test_crash_consistency.py proves with a real
+    SIGKILL). The daemon keeps running so post-crash expectations can
+    assert the stores stay consistent and ingest keeps working."""
+    writer = getattr(server, "storage_writer", None)
+    if writer is None:
+        return "storage batching disabled (no write-behind writer)"
+    n = writer.drop_pending(reason="chaos_crash")
+    logger.info("chaos: storage_crash discarded %d buffered ops", n)
+    return None
+
+
 def _poke(comp, server, block: bool = False) -> None:
     """Run the component's check now: poked to the front of the heap when
     scheduler-driven, else a direct (or one-shot) check."""
@@ -237,4 +289,7 @@ ACTIONS: Dict[str, Callable] = {
     "set_healthy": act_set_healthy,
     "remediation_scan": act_remediation_scan,
     "purge": act_purge,
+    "ingest_burst": act_ingest_burst,
+    "storage_flush": act_storage_flush,
+    "storage_crash": act_storage_crash,
 }

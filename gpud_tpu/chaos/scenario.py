@@ -58,6 +58,9 @@ KNOWN_ACTIONS = (
     "set_healthy",     # clear a component's sticky state
     "remediation_scan",  # poke the remediation engine's scan job
     "purge",           # run the consolidated retention purge now
+    "ingest_burst",    # observation firehose: `count` events + metric rows
+    "storage_flush",   # write-behind flush barrier (pre-crash durability line)
+    "storage_crash",   # discard the write-behind buffer uncommitted (SIGKILL sim)
 )
 
 # expectation kinds evaluated after each phase (gpud_tpu/chaos/expectations.py)

@@ -41,6 +41,12 @@ DEFAULT_CHAOS_HISTORY_LIMIT = 32
 # session-path fault injection rate limit (injectFault token bucket)
 DEFAULT_INJECT_RATE_CAPACITY = 10
 DEFAULT_INJECT_RATE_REFILL = 6.0         # one injection token back per 6s
+# write-behind storage commit layer (docs/storage.md)
+DEFAULT_STORAGE_BATCH_FLUSH_INTERVAL = 0.2   # group-commit cadence (s)
+DEFAULT_STORAGE_BATCH_MAX_PENDING = 100_000  # buffered ops before backpressure
+DEFAULT_STORAGE_BATCH_FLUSH_THRESHOLD = 5_000  # buffered ops that poke a drain
+DEFAULT_STORAGE_BATCH_BACKPRESSURE = 0.05    # bounded wait for room (s)
+DEFAULT_STORAGE_WAL_CHECKPOINT = 300         # wal_checkpoint(TRUNCATE) cadence (s)
 
 STATE_FILE = "tpud.state"                # reference: default.go:137-157 (gpud.state)
 FIFO_FILE = "tpud.fifo"
@@ -102,6 +108,18 @@ class Config:
     # control plane must not be able to spam kmsg writes)
     inject_rate_capacity: int = DEFAULT_INJECT_RATE_CAPACITY
     inject_rate_refill_seconds: float = DEFAULT_INJECT_RATE_REFILL
+    # write-behind storage commit layer (docs/storage.md): all four stores
+    # buffer hot-path writes and group-commit on one scheduler job. Off =
+    # the classic one-transaction-per-row synchronous path everywhere.
+    storage_batch_enabled: bool = True
+    storage_batch_flush_interval_seconds: float = (
+        DEFAULT_STORAGE_BATCH_FLUSH_INTERVAL
+    )
+    storage_batch_max_pending: int = DEFAULT_STORAGE_BATCH_MAX_PENDING
+    storage_batch_flush_threshold: int = DEFAULT_STORAGE_BATCH_FLUSH_THRESHOLD
+    storage_batch_backpressure_seconds: float = DEFAULT_STORAGE_BATCH_BACKPRESSURE
+    storage_batch_fsync: bool = False    # one fsync per group commit when True
+    storage_wal_checkpoint_seconds: int = DEFAULT_STORAGE_WAL_CHECKPOINT
     # unified check scheduler (docs/scheduler.md)
     scheduler_workers: int = DEFAULT_SCHEDULER_WORKERS
     scheduler_watchdog_seconds: int = DEFAULT_SCHEDULER_WATCHDOG
@@ -191,6 +209,18 @@ class Config:
             return "inject rate capacity must be >= 1"
         if self.inject_rate_refill_seconds <= 0:
             return "inject rate refill must be > 0s"
+        if self.storage_batch_flush_interval_seconds <= 0:
+            return "storage batch flush interval must be > 0s"
+        if self.storage_batch_max_pending < 1000:
+            return "storage batch max pending must be >= 1000"
+        if self.storage_batch_flush_threshold < 1:
+            return "storage batch flush threshold must be >= 1"
+        if self.storage_batch_flush_threshold > self.storage_batch_max_pending:
+            return "storage batch flush threshold must be <= max pending"
+        if self.storage_batch_backpressure_seconds < 0:
+            return "storage batch backpressure must be >= 0s"
+        if self.storage_wal_checkpoint_seconds < 0:
+            return "storage wal checkpoint cadence must be >= 0s (0 disables)"
         if self.scheduler_workers < 1:
             return "scheduler workers must be >= 1"
         if self.scheduler_watchdog_seconds < 0:

@@ -45,6 +45,10 @@ TABLE = "tpud_events_v0_1"  # schema version in table name (reference: database.
 
 DEFAULT_RETENTION = 14 * 86400  # 14d (reference: pkg/config/default.go:28)
 
+# write-behind contract (tools/storage_lint.py): these methods must route
+# through the BatchWriter, never commit per-row via db.execute directly
+HOT_WRITE_METHODS = ("_insert",)
+
 
 class Bucket:
     """Per-component view over the shared events table
@@ -88,10 +92,21 @@ class EventStore:
     (reference: database.go:85-90) — implemented as one shared
     ``RetentionPurger`` thread (the pattern the health ledger shares) to
     keep thread count flat, stoppable via ``close()``.
+
+    With a ``writer`` (write-behind BatchWriter), inserts append into the
+    shared group-commit buffer and every read runs the flush barrier first
+    — ``find`` is the kmsg watcher's dedupe-before-insert check, so it must
+    see events inserted a moment ago or every fault would double-record.
     """
 
-    def __init__(self, db: DB, retention_seconds: int = DEFAULT_RETENTION) -> None:
+    def __init__(
+        self,
+        db: DB,
+        retention_seconds: int = DEFAULT_RETENTION,
+        writer=None,
+    ) -> None:
         self.db = db
+        self.writer = writer
         self.retention_seconds = retention_seconds
         self._buckets: Dict[str, Bucket] = {}
         self._mu = threading.Lock()
@@ -129,16 +144,26 @@ class EventStore:
                 self._buckets[component] = b
             return b
 
+    def flush(self) -> None:
+        """Read-after-write barrier (no-op without a writer)."""
+        if self.writer is not None:
+            self.writer.flush()
+
     # -- internal ops ------------------------------------------------------
     def _insert(self, component: str, ev: Event) -> None:
         extra = json.dumps(ev.extra_info, sort_keys=True) if ev.extra_info else ""
-        self.db.execute(
+        sql = (
             f"INSERT INTO {TABLE} (component, timestamp, name, type, message, extra_info) "
-            "VALUES (?, ?, ?, ?, ?, ?)",
-            (component, ev.time, ev.name, ev.type, ev.message, extra),
+            "VALUES (?, ?, ?, ?, ?, ?)"
         )
+        params = (component, ev.time, ev.name, ev.type, ev.message, extra)
+        if self.writer is not None:
+            self.writer.submit("events", sql, params)
+        else:
+            self.db.execute(sql, params)
 
     def _find(self, component: str, ev: Event) -> Optional[Event]:
+        self.flush()
         row = self.db.query_one(
             f"SELECT timestamp, name, type, message, extra_info FROM {TABLE} "
             "WHERE component=? AND timestamp=? AND name=? AND type=? AND message=? LIMIT 1",
@@ -149,6 +174,7 @@ class EventStore:
         return _row_to_event(component, row)
 
     def _get(self, component: str, since: float, limit: int = 0) -> List[Event]:
+        self.flush()
         sql = (
             f"SELECT timestamp, name, type, message, extra_info FROM {TABLE} "
             "WHERE component=? AND timestamp>=? ORDER BY timestamp DESC"
@@ -161,6 +187,7 @@ class EventStore:
         return [_row_to_event(component, r) for r in rows]
 
     def _purge(self, component: str, before: float) -> int:
+        self.flush()
         cur = self.db.execute(
             f"DELETE FROM {TABLE} WHERE component=? AND timestamp<?",
             (component, before),
@@ -168,6 +195,7 @@ class EventStore:
         return cur.rowcount
 
     def latest_events(self, since: float) -> Dict[str, List[Event]]:
+        self.flush()
         rows = self.db.query(
             f"SELECT component, timestamp, name, type, message, extra_info FROM {TABLE} "
             "WHERE timestamp>=? ORDER BY timestamp DESC",
@@ -191,6 +219,7 @@ class EventStore:
     def _purge_tick(self) -> None:
         """One purge pass, per component so the purge counter attributes
         deletions (reference cadence: database.go:85-90)."""
+        self.flush()  # never let a buffered row dodge the purge cutoff
         cutoff = self.time_now_fn() - self.retention_seconds
         comps = [
             r[0]

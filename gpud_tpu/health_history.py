@@ -23,6 +23,7 @@ Two tables, bucket/retention modeled on ``gpud_tpu/eventstore.py``:
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Dict, List, Optional
 
 from gpud_tpu.api.v1.types import Event, EventType, HealthStateType
@@ -86,6 +87,10 @@ _c_purged = counter(
     "transition rows deleted by the retention purger, by component",
 )
 
+# write-behind contract (tools/storage_lint.py): these methods must route
+# through the BatchWriter, never commit per-row via db.execute directly
+HOT_WRITE_METHODS = ("_record_transition", "_persist_last")
+
 
 class HealthLedger:
     """One ledger per daemon, shared by every component's check wrapper.
@@ -93,6 +98,16 @@ class HealthLedger:
     ``observe()`` is the single write path; everything else is read-only
     derivation, so the CLI can open a second ledger over the same state
     file (daemon running or not) and get identical timelines.
+
+    With a ``writer`` (write-behind BatchWriter) the per-observe upsert of
+    the last-state row coalesces by component (one committed row per
+    component per flush window instead of one per check), transitions
+    append into the shared buffer, and public reads run the flush barrier.
+    ``observe()`` itself never takes the barrier: flap counting runs
+    against an in-memory per-component transition window (seeded from the
+    DB at reconcile), and the derived gauges tolerate flush-window
+    staleness — otherwise every check would force a commit and defeat the
+    batching.
     """
 
     def __init__(
@@ -105,8 +120,10 @@ class HealthLedger:
         flap_event_cooldown: float = DEFAULT_FLAP_EVENT_COOLDOWN,
         availability_window_seconds: float = DEFAULT_AVAILABILITY_WINDOW,
         correlation_window_seconds: float = DEFAULT_CORRELATION_WINDOW,
+        writer=None,
     ) -> None:
         self.db = db
+        self.writer = writer
         self.event_store = event_store
         self.retention_seconds = retention_seconds
         self.flap_threshold = flap_threshold
@@ -118,6 +135,10 @@ class HealthLedger:
         # component -> [state, episode_since, last_seen, first_seen]
         self._last: Dict[str, list] = {}
         self._last_flap_event: Dict[str, float] = {}
+        # component -> recent transition timestamps (flap-window cache):
+        # lets observe() count flaps without a read — and therefore
+        # without a flush barrier — on the hot path
+        self._tx_recent: Dict[str, deque] = {}
         import time as _time
 
         self.time_now_fn = _time.time
@@ -188,15 +209,31 @@ class HealthLedger:
             self._refresh_derived(component, ts)
         return ann
 
+    def flush(self) -> None:
+        """Read-after-write barrier (no-op without a writer)."""
+        if self.writer is not None:
+            self.writer.flush()
+
     def _reconcile_boot(
         self, component: str, state: str, ts: float, reason: str
     ) -> list:
         """First observation since process start: continue the persisted
         episode when the state matches, mint exactly one transition when it
         doesn't, and start fresh for a never-seen component."""
+        self.flush()  # once per component per process — not a hot path
         row = self.db.query_one(
             f"SELECT state, since, first_seen FROM {LAST_TABLE} WHERE component=?",
             (component,),
+        )
+        # seed the in-memory flap window from persisted history so a
+        # restart mid-flap still detects it
+        self._tx_recent[component] = deque(
+            r[0]
+            for r in self.db.query(
+                f"SELECT timestamp FROM {TABLE} "
+                "WHERE component=? AND timestamp>? ORDER BY timestamp ASC",
+                (component, ts - self.flap_window),
+            )
         )
         if row is None:
             ep = [state, ts, ts, ts]
@@ -212,24 +249,36 @@ class HealthLedger:
         return ep
 
     def _persist_last(self, component: str, ep: list) -> None:
-        self.db.execute(
+        sql = (
             f"""INSERT INTO {LAST_TABLE} (component, state, since, first_seen, updated)
                 VALUES (?, ?, ?, ?, ?)
                 ON CONFLICT(component) DO UPDATE SET
                     state=excluded.state, since=excluded.since,
-                    first_seen=excluded.first_seen, updated=excluded.updated""",
-            (component, ep[0], ep[1], ep[3], ep[2]),
+                    first_seen=excluded.first_seen, updated=excluded.updated"""
         )
+        params = (component, ep[0], ep[1], ep[3], ep[2])
+        if self.writer is not None:
+            # coalesce by component: only the newest upsert in a flush
+            # window commits — the table holds one row per component anyway
+            self.writer.submit("ledger", sql, params, key=("hl", component))
+        else:
+            self.db.execute(sql, params)
 
     def _record_transition(
         self, component: str, from_state: str, to_state: str,
         ts: float, reason: str,
     ) -> None:
-        self.db.execute(
+        sql = (
             f"INSERT INTO {TABLE} (component, timestamp, from_state, to_state, reason) "
-            "VALUES (?, ?, ?, ?, ?)",
-            (component, ts, from_state, to_state, reason or ""),
+            "VALUES (?, ?, ?, ?, ?)"
         )
+        params = (component, ts, from_state, to_state, reason or "")
+        if self.writer is not None:
+            self.writer.submit("ledger", sql, params)
+        else:
+            self.db.execute(sql, params)
+        recent = self._tx_recent.setdefault(component, deque())
+        recent.append(ts)
         _c_transitions.inc(
             labels={"component": component, "from": from_state, "to": to_state}
         )
@@ -273,17 +322,36 @@ class HealthLedger:
         return ann
 
     def _transitions_in_window(self, component: str, now: float) -> int:
+        cutoff = now - self.flap_window
+        recent = self._tx_recent.get(component)
+        if recent is not None:
+            # in-memory window (seeded at reconcile, appended on every
+            # transition): the observe() hot path never reads the DB, so
+            # it never needs the flush barrier
+            try:
+                while recent and recent[0] <= cutoff:
+                    recent.popleft()
+            except IndexError:  # concurrent prune emptied it under us
+                pass
+            return len(recent)
+        # component never observed by this process (CLI over a shared
+        # state file): fall back to the table, behind the barrier
+        self.flush()
         row = self.db.query_one(
             f"SELECT COUNT(*) FROM {TABLE} WHERE component=? AND timestamp>?",
-            (component, now - self.flap_window),
+            (component, cutoff),
         )
         return int(row[0]) if row else 0
 
     def _refresh_derived(self, component: str, now: float) -> None:
-        av = self.availability(component, now=now)
+        # barrier=False: these run inside every observe(); forcing a
+        # commit here would serialize the hot path on the writer. The
+        # gauges may lag the newest (still-buffered) transition by at most
+        # one flush window — acceptable for 15m-cadence derived series.
+        av = self.availability(component, now=now, barrier=False)
         if av is not None:
             _g_availability.set(av["ratio"], {"component": component})
-        mttr, mtbf = self.mttr_mtbf(component)
+        mttr, mtbf = self.mttr_mtbf(component, barrier=False)
         if mttr is not None:
             _g_mttr.set(mttr, {"component": component})
         if mtbf is not None:
@@ -297,6 +365,7 @@ class HealthLedger:
         limit: int = 0,
     ) -> List[Dict]:
         """Transition timeline, newest first."""
+        self.flush()
         sql = (
             f"SELECT component, timestamp, from_state, to_state, reason "
             f"FROM {TABLE} WHERE timestamp>=?"
@@ -346,12 +415,15 @@ class HealthLedger:
         component: str,
         window_seconds: Optional[float] = None,
         now: Optional[float] = None,
+        barrier: bool = True,
     ) -> Optional[Dict]:
         """Healthy-time ratio over the rolling window, reconstructed from
         the transition timeline plus the current episode. The window is
         clamped to the component's first-seen time so a freshly-registered
         component isn't billed for time before it existed. Returns None
         for unknown components or zero observed time."""
+        if barrier:
+            self.flush()
         w = self.availability_window if window_seconds is None else window_seconds
         ts_now = self.time_now_fn() if now is None else now
         row = self.db.query_one(
@@ -389,11 +461,13 @@ class HealthLedger:
             "state": cur_state,
         }
 
-    def mttr_mtbf(self, component: str):
+    def mttr_mtbf(self, component: str, barrier: bool = True):
         """(MTTR, MTBF) from the persisted timeline: MTTR is the mean
         duration of completed Unhealthy episodes; MTBF the mean gap between
         successive entries into Unhealthy. Either is None without enough
         history."""
+        if barrier:
+            self.flush()
         rows = self.db.query(
             f"SELECT timestamp, from_state, to_state FROM {TABLE} "
             "WHERE component=? ORDER BY timestamp ASC",
@@ -419,6 +493,7 @@ class HealthLedger:
         return mttr, mtbf
 
     def components(self) -> List[str]:
+        self.flush()
         return [
             r[0]
             for r in self.db.query(
@@ -448,6 +523,7 @@ class HealthLedger:
 
     def summary(self, now: Optional[float] = None) -> Dict:
         """Rollup for /v1/info: totals + who is flapping right now."""
+        self.flush()
         ts = self.time_now_fn() if now is None else now
         row = self.db.query_one(f"SELECT COUNT(*) FROM {TABLE}")
         comps = self.components()
@@ -466,6 +542,7 @@ class HealthLedger:
         self._purge_tick()
 
     def _purge_tick(self) -> None:
+        self.flush()  # never let a buffered row dodge the purge cutoff
         cutoff = self.time_now_fn() - self.retention_seconds
         comps = [
             r[0]

@@ -30,13 +30,30 @@ RECORDER_INTERVAL = 15 * 60.0 # 15m (reference: pkg/server/server.go:241)
 # metric-name prefix → component attribution for /v1/metrics grouping
 COMPONENT_LABEL = "component"
 
+# write-behind contract (tools/storage_lint.py): these methods must route
+# through the BatchWriter, never commit per-row via db.execute directly
+HOT_WRITE_METHODS = ("record",)
+
 
 class MetricsStore:
     """SQLite time-series table with Record/Read/Purge
-    (reference: pkg/metrics/store/sqlite.go:64)."""
+    (reference: pkg/metrics/store/sqlite.go:64).
 
-    def __init__(self, db: DB, retention_seconds: int = DEFAULT_RETENTION) -> None:
+    With a ``writer`` (the write-behind BatchWriter), ``record`` buffers
+    rows for the next group commit — same-(timestamp, name, labels)
+    samples coalesce last-write-wins — and every read/purge runs the
+    flush barrier first so history queries always see completed scrapes.
+    Without one (tests, CLI tools) writes stay synchronous.
+    """
+
+    def __init__(
+        self,
+        db: DB,
+        retention_seconds: int = DEFAULT_RETENTION,
+        writer=None,
+    ) -> None:
         self.db = db
+        self.writer = writer
         self.retention_seconds = retention_seconds
         db.execute(
             f"""CREATE TABLE IF NOT EXISTS {TABLE} (
@@ -55,16 +72,37 @@ class MetricsStore:
 
     def record(self, rows: List[tuple]) -> None:
         """rows: (unix_seconds, name, labels_dict, value) — batched insert
-        (footprint discipline: one transaction per scrape)."""
+        (footprint discipline: one transaction per scrape). ``labels`` may
+        also be a pre-encoded JSON string (the firehose fast path skips
+        re-serializing identical labelsets per sample)."""
         if not rows:
             return
-        self.db.executemany(
-            f"INSERT INTO {TABLE} (unix_seconds, name, labels, value) VALUES (?, ?, ?, ?)",
-            [
-                (ts, name, json.dumps(labels, sort_keys=True) if labels else "", value)
-                for ts, name, labels, value in rows
-            ],
-        )
+        sql = f"INSERT INTO {TABLE} (unix_seconds, name, labels, value) VALUES (?, ?, ?, ?)"
+        encoded = [
+            (
+                ts,
+                name,
+                labels if isinstance(labels, str)
+                else (json.dumps(labels, sort_keys=True) if labels else ""),
+                value,
+            )
+            for ts, name, labels, value in rows
+        ]
+        if self.writer is not None:
+            # gauge samples for the same (second, series) coalesce
+            # last-write-wins: an ingest storm re-sampling a gauge within
+            # one flush window commits one row, not thousands
+            self.writer.submit_many(
+                "metrics", sql, encoded,
+                keys=[("m", ts, name, labels) for ts, name, labels, _v in encoded],
+            )
+        else:
+            self.db.executemany(sql, encoded)
+
+    def flush(self) -> None:
+        """Read-after-write barrier (no-op without a writer)."""
+        if self.writer is not None:
+            self.writer.flush()
 
     def read(
         self,
@@ -72,6 +110,7 @@ class MetricsStore:
         name: str = "",
         components: Optional[List[str]] = None,
     ) -> List[Metric]:
+        self.flush()
         sql = f"SELECT unix_seconds, name, labels, value FROM {TABLE} WHERE unix_seconds>=?"
         params: list = [int(since)]
         if name:
@@ -88,6 +127,9 @@ class MetricsStore:
         return out
 
     def purge(self, before: float) -> int:
+        # barrier first: a purge racing buffered rows would let a sample
+        # older than the cutoff commit right after the DELETE
+        self.flush()
         return self.db.execute(
             f"DELETE FROM {TABLE} WHERE unix_seconds<?", (int(before),)
         ).rowcount

@@ -30,14 +30,30 @@ _c_purged = counter(
     "remediation audit rows deleted by the retention purger",
 )
 
+# write-behind contract (tools/storage_lint.py): these methods must route
+# through the BatchWriter, never commit per-row via db.execute directly
+HOT_WRITE_METHODS = ("record",)
+
 
 class AuditStore:
-    """Append-only remediation attempt ledger over the shared state DB."""
+    """Append-only remediation attempt ledger over the shared state DB.
+
+    With a ``writer`` (write-behind BatchWriter), ``record`` appends into
+    the shared group-commit buffer and every read runs the flush barrier
+    first — mandatory here, because reads are decision inputs: the
+    cooldown anchor (``last_attempt_time``) and the rate/escalation
+    counters must see the attempt recorded microseconds ago or the engine
+    would double-fire.
+    """
 
     def __init__(
-        self, db: DB, retention_seconds: int = DEFAULT_RETENTION
+        self,
+        db: DB,
+        retention_seconds: int = DEFAULT_RETENTION,
+        writer=None,
     ) -> None:
         self.db = db
+        self.writer = writer
         self.retention_seconds = retention_seconds
         self.time_now_fn = time.time
         db.execute(
@@ -79,23 +95,32 @@ class AuditStore:
         duration_seconds: float = 0.0,
         ts: Optional[float] = None,
     ) -> None:
-        self.db.execute(
+        sql = (
             f"INSERT INTO {TABLE} (timestamp, component, action, suggested, "
             "trigger_health, trigger_reason, decision, outcome, detail, "
-            "duration_seconds) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
-            (
-                self.time_now_fn() if ts is None else ts,
-                component,
-                action,
-                suggested,
-                trigger_health,
-                trigger_reason or "",
-                decision,
-                outcome,
-                detail or "",
-                duration_seconds,
-            ),
+            "duration_seconds) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)"
         )
+        params = (
+            self.time_now_fn() if ts is None else ts,
+            component,
+            action,
+            suggested,
+            trigger_health,
+            trigger_reason or "",
+            decision,
+            outcome,
+            detail or "",
+            duration_seconds,
+        )
+        if self.writer is not None:
+            self.writer.submit("audit", sql, params)
+        else:
+            self.db.execute(sql, params)
+
+    def flush(self) -> None:
+        """Read-after-write barrier (no-op without a writer)."""
+        if self.writer is not None:
+            self.writer.flush()
 
     # -- read path ---------------------------------------------------------
     def read(
@@ -107,6 +132,7 @@ class AuditStore:
         limit: int = 0,
     ) -> List[Dict]:
         """Attempt rows, newest first."""
+        self.flush()
         sql = (
             f"SELECT timestamp, component, action, suggested, trigger_health, "
             f"trigger_reason, decision, outcome, detail, duration_seconds "
@@ -141,6 +167,7 @@ class AuditStore:
 
     def last_attempt_time(self, component: str) -> Optional[float]:
         """Newest audit row for the component — the cooldown anchor."""
+        self.flush()
         row = self.db.query_one(
             f"SELECT MAX(timestamp) FROM {TABLE} WHERE component=?",
             (component,),
@@ -154,6 +181,7 @@ class AuditStore:
         outcomes: Optional[List[str]] = None,
         since: float = 0.0,
     ) -> int:
+        self.flush()
         sql = f"SELECT COUNT(*) FROM {TABLE} WHERE timestamp>=?"
         params: list = [since]
         if component:
@@ -170,6 +198,7 @@ class AuditStore:
 
     def summary(self) -> Dict:
         """Rollup for status views: total rows + per-outcome counts."""
+        self.flush()
         rows = self.db.query(
             f"SELECT outcome, COUNT(*) FROM {TABLE} GROUP BY outcome"
         )
@@ -188,6 +217,7 @@ class AuditStore:
         self._purge_tick()
 
     def _purge_tick(self) -> None:
+        self.flush()  # never let a buffered row dodge (or outlive) the purge
         cutoff = self.time_now_fn() - self.retention_seconds
         n = self.db.execute(
             f"DELETE FROM {TABLE} WHERE timestamp<?", (cutoff,)

@@ -101,13 +101,16 @@ class RemediationEngine:
         runtime_unit: str = "",
         run_command_fn=None,
         reboot_fn=None,
+        writer=None,
     ) -> None:
         self.registry = registry
         self.policy = policy or Policy()
         self.event_store = event_store
         self.reboot_event_store = reboot_event_store
         self.interval = interval_seconds
-        self.audit = AuditStore(db, retention_seconds=audit_retention_seconds)
+        self.audit = AuditStore(
+            db, retention_seconds=audit_retention_seconds, writer=writer
+        )
         self.soft_repairs = (
             dict(DEFAULT_SOFT_REPAIRS) if soft_repairs is None else dict(soft_repairs)
         )

@@ -56,8 +56,29 @@ class Server:
         # persistence (reference: server.go:132-221)
         self.db_rw, self.db_ro = open_rw_ro(self.config.state_file())
         self.metadata = Metadata(self.db_rw)
+        # write-behind commit layer (docs/storage.md): ONE group-commit
+        # path all four stores share; constructed before any store so
+        # every store takes it at construction
+        self.storage_writer = None
+        if self.config.storage_batch_enabled:
+            from gpud_tpu.storage import BatchWriter
+
+            self.storage_writer = BatchWriter(
+                self.db_rw,
+                flush_interval_seconds=(
+                    self.config.storage_batch_flush_interval_seconds
+                ),
+                max_pending=self.config.storage_batch_max_pending,
+                flush_threshold=self.config.storage_batch_flush_threshold,
+                backpressure_seconds=(
+                    self.config.storage_batch_backpressure_seconds
+                ),
+                fsync=self.config.storage_batch_fsync,
+            )
         self.event_store = EventStore(
-            self.db_rw, retention_seconds=self.config.events_retention_seconds
+            self.db_rw,
+            retention_seconds=self.config.events_retention_seconds,
+            writer=self.storage_writer,
         )
         self.reboot_event_store = pkghost.RebootEventStore(self.event_store)
         self.reboot_event_store.record_reboot()
@@ -74,6 +95,7 @@ class Server:
             availability_window_seconds=(
                 self.config.health_availability_window_seconds
             ),
+            writer=self.storage_writer,
         )
         self.machine_id = (
             self.config.machine_id
@@ -113,6 +135,7 @@ class Server:
                 interval_seconds=float(self.config.remediation_interval_seconds),
                 audit_retention_seconds=self.config.events_retention_seconds,
                 runtime_unit=self.config.remediation_runtime_unit,
+                writer=self.storage_writer,
             )
 
         # metrics pipeline (reference: server.go:223-242)
@@ -122,7 +145,9 @@ class Server:
 
         self.tracer = DEFAULT_TRACER
         self.metrics_store = MetricsStore(
-            self.db_rw, retention_seconds=self.config.metrics_retention_seconds
+            self.db_rw,
+            retention_seconds=self.config.metrics_retention_seconds,
+            writer=self.storage_writer,
         )
         self.metrics_syncer = MetricsSyncer(
             self.metrics_registry,
@@ -339,6 +364,25 @@ class Server:
                 interval=retention_interval,
                 initial_delay=retention_interval,
             )
+            if self.storage_writer is not None:
+                # the periodic group-commit drain ("storage-writer-flush")
+                self.storage_writer.start(self.scheduler)
+                if (
+                    not self.config.db_in_memory
+                    and self.config.storage_wal_checkpoint_seconds > 0
+                ):
+                    # low-cadence WAL maintenance: flush, sample
+                    # tpud_sqlite_wal_bytes, wal_checkpoint(TRUNCATE) so
+                    # the WAL stays bounded under sustained batched ingest
+                    from gpud_tpu.storage import checkpoint_wal
+
+                    interval = float(self.config.storage_wal_checkpoint_seconds)
+                    self.scheduler.add_job(
+                        "wal-checkpoint",
+                        lambda: checkpoint_wal(self.db_rw, self.storage_writer),
+                        interval=interval,
+                        initial_delay=interval,
+                    )
             if self.remediation is not None:
                 self.remediation.start(self.scheduler)
             self.metrics_syncer.start(self.scheduler)
@@ -455,6 +499,10 @@ class Server:
         self.scheduler.close()
         self.health_ledger.close()
         self.event_store.close()
+        if self.storage_writer is not None:
+            # graceful-shutdown barrier: commit everything still buffered
+            # (last of all — every writer above may emit final rows)
+            self.storage_writer.close()
 
     def _reapply_config_overrides(self) -> None:
         """Control-plane config overrides survive restarts (reference:

@@ -23,6 +23,19 @@ from gpud_tpu.tracing import DEFAULT_TRACER
 
 logger = get_logger(__name__)
 
+
+class _NullLock:
+    """No-op context manager for the file-backed (per-thread conn) path."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_LOCK = _NullLock()
+
 # self-observability counters (reference: pkg/metrics/recorder/gpud_metrics.go:14-60)
 _stats_mu = threading.Lock()
 _stats = {
@@ -145,6 +158,43 @@ class DB:
             conn.commit()
         _record("insert_update_delete", time.monotonic() - t0)
 
+    def run_batch(
+        self,
+        groups: Iterable[Tuple[str, list]],
+        fsync: bool = False,
+    ) -> int:
+        """Group commit: every (sql, params_list) group in ONE transaction.
+
+        This is the write-behind layer's drain path — the whole flush
+        window becomes a single WAL append instead of one commit per row.
+        ``fsync=True`` upgrades just this commit to ``synchronous=FULL``
+        (one fsync per batch: group-commit durability without paying a
+        per-row fsync anywhere else). Atomic: on error the transaction
+        rolls back and no group is applied. Returns rows written.
+        """
+        conn = self._connect()
+        t0 = time.monotonic()
+        n = 0
+        lock = self._mem_lock if self._in_memory else _NULL_LOCK
+        with lock:
+            if fsync and not self._in_memory:
+                conn.execute("PRAGMA synchronous=FULL")
+            try:
+                for sql, params_list in groups:
+                    if not params_list:
+                        continue
+                    conn.executemany(sql, params_list)
+                    n += len(params_list)
+                conn.commit()
+            except Exception:
+                conn.rollback()
+                raise
+            finally:
+                if fsync and not self._in_memory:
+                    conn.execute("PRAGMA synchronous=NORMAL")
+        _record("insert_update_delete", time.monotonic() - t0)
+        return n
+
     def query(self, sql: str, params: Iterable[Any] = ()) -> list:
         conn = self._connect()
         t0 = time.monotonic()
@@ -180,6 +230,29 @@ class DB:
             "SELECT page_count * page_size FROM pragma_page_count(), pragma_page_size()"
         )
         return int(row[0]) if row else 0
+
+    def wal_size_bytes(self) -> int:
+        """Size of the sidecar ``-wal`` file (0 when absent / in-memory)."""
+        if self._in_memory:
+            return 0
+        try:
+            return os.stat(self.path + "-wal").st_size
+        except OSError:
+            return 0
+
+    def wal_checkpoint(self, mode: str = "TRUNCATE") -> Tuple[int, int, int]:
+        """Run ``PRAGMA wal_checkpoint(mode)``; returns (busy, log_pages,
+        checkpointed_pages) — SQLite's own result row. No-op (0, -1, -1)
+        for in-memory databases, which have no WAL."""
+        if mode not in ("PASSIVE", "FULL", "RESTART", "TRUNCATE"):
+            raise ValueError(f"bad wal_checkpoint mode: {mode!r}")
+        if self._in_memory:
+            return (0, -1, -1)
+        conn = self._connect()
+        t0 = time.monotonic()
+        row = conn.execute(f"PRAGMA wal_checkpoint({mode})").fetchone()
+        _record("vacuum", time.monotonic() - t0)
+        return (int(row[0]), int(row[1]), int(row[2])) if row else (0, -1, -1)
 
     def close(self) -> None:
         conn = getattr(self._local, "conn", None)

@@ -49,6 +49,7 @@ _METRIC_MODULES = (
     "gpud_tpu.server.app",
     "gpud_tpu.session.dispatch",
     "gpud_tpu.sqlite",
+    "gpud_tpu.storage.writer",
 )
 
 

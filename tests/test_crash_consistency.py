@@ -287,3 +287,81 @@ def test_repeated_sigkill_restart_cycles_stay_healthy(tmp_path):
     finally:
         proc.send_signal(signal.SIGTERM)
         assert proc.wait(timeout=20) == 0
+
+
+_BATCH_CHILD = r"""
+import sys
+from gpud_tpu.api.v1.types import Event, EventType
+from gpud_tpu.eventstore import EventStore
+from gpud_tpu.sqlite import DB
+from gpud_tpu.storage.writer import BatchWriter
+
+db = DB(sys.argv[1])
+# no scheduler: the ONLY drain is the explicit flush barrier, so each
+# ACKed batch maps to exactly one group commit (one transaction)
+writer = BatchWriter(db, fsync=True)
+store = EventStore(db, writer=writer)
+bucket = store.bucket("crash-batch")
+k = 0
+while True:
+    for i in range(50):
+        bucket.insert(Event(
+            component="crash-batch", time=1000.0 + k, name=f"batch-{k}",
+            type=EventType.INFO, message=f"row {i}",
+        ))
+    writer.flush(timeout=30.0)
+    print(f"ACK {k}", flush=True)
+    k += 1
+"""
+
+
+def test_sigkill_mid_group_commit_batches_are_atomic(tmp_path):
+    """SIGKILL a writer mid-ingest through the write-behind layer: every
+    group commit is one transaction, so after the kill each batch is
+    all-or-none (never torn), every flush-ACKed batch survived in full,
+    and the DB passes integrity_check. The unACKed tail — at most one
+    flush window of buffered rows — is the documented loss budget."""
+    state = str(tmp_path / "batch.state")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _BATCH_CHILD, state],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=REPO,
+        env=dict(os.environ, PYTHONUNBUFFERED="1"),
+    )
+    acked = -1
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline and acked < 5:
+            line = child.stdout.readline()
+            assert line, "writer child died before 6 batches ACKed"
+            if line.startswith("ACK "):
+                acked = int(line.split()[1])
+        assert acked >= 5, "never reached 6 ACKed batches"
+    finally:
+        # no drain between ACKs: the kill lands while batch acked+1 is
+        # buffered or mid-commit
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=10)
+
+    _integrity_ok(state)
+    con = sqlite3.connect(state)
+    try:
+        counts = dict(con.execute(
+            "SELECT name, COUNT(*) FROM tpud_events_v0_1 "
+            "WHERE component = 'crash-batch' GROUP BY name"
+        ))
+    finally:
+        con.close()
+    # every ACKed batch is fully present
+    for k in range(acked + 1):
+        assert counts.get(f"batch-{k}") == 50, (
+            f"ACKed batch {k} torn/lost: {counts.get(f'batch-{k}')}"
+        )
+    # NO batch is ever partial — committed whole or lost whole
+    torn = {n: c for n, c in counts.items() if c != 50}
+    assert not torn, f"torn group commits: {torn}"
+    # loss is bounded to the in-flight flush window: at most one
+    # unACKed batch can have committed
+    assert len(counts) <= acked + 2, counts

@@ -1,0 +1,288 @@
+"""Write-behind commit layer: batching semantics, barriers, and lints.
+
+The BatchWriter's contract is narrow but load-bearing: nothing submitted
+is readable until a drain, everything submitted before a flush() barrier
+is readable after it, keyed ops coalesce last-write-wins, the buffer is
+bounded (drops are counted, never silent), and a closed writer falls
+back to synchronous writes instead of losing data. These tests pin each
+clause, plus the storage lint that keeps the four stores on the layer.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gpud_tpu.scheduler import Scheduler
+from gpud_tpu.sqlite import DB
+from gpud_tpu.storage.writer import (
+    BatchWriter,
+    FLUSH_JOB_NAME,
+    checkpoint_wal,
+)
+
+SQL = "INSERT INTO t (k, v) VALUES (?, ?)"
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = DB(str(tmp_path / "w.state"))
+    d.execute("CREATE TABLE t (k TEXT, v TEXT)")
+    yield d
+    d.close()
+
+
+def _rows(db):
+    return db.query("SELECT k, v FROM t ORDER BY k, v")
+
+
+def test_nothing_visible_before_drain_everything_after(db):
+    w = BatchWriter(db)
+    for i in range(10):
+        assert w.submit("events", SQL, (f"k{i}", "v"))
+    assert _rows(db) == []          # buffered, not committed
+    assert w.pending_ops() == 10
+    w.drain()
+    assert len(_rows(db)) == 10
+    assert w.pending_ops() == 0
+    w.close()
+
+
+def test_flush_barrier_gives_read_your_writes(db):
+    w = BatchWriter(db)
+    w.submit("events", SQL, ("a", "1"))
+    assert w.flush(timeout=5.0)
+    assert ("a", "1") in _rows(db)
+    # barrier with nothing pending returns immediately
+    assert w.flush(timeout=5.0)
+    w.close()
+
+
+def test_keyed_ops_coalesce_last_write_wins(db):
+    w = BatchWriter(db)
+    for i in range(100):
+        w.submit("metrics", SQL, ("gauge", f"v{i}"), key=("m", "gauge"))
+    assert w.pending_ops() == 1     # 99 absorbed in place
+    w.drain()
+    assert _rows(db) == [("gauge", "v99")]
+    st = w.stats()
+    assert st["committed_ops"] == 1
+    w.close()
+
+
+def test_distinct_keys_do_not_coalesce(db):
+    w = BatchWriter(db)
+    w.submit("metrics", SQL, ("a", "1"), key=("m", "a"))
+    w.submit("ledger", SQL, ("a", "2"), key=("hl", "a"))  # other namespace
+    w.drain()
+    assert len(_rows(db)) == 2
+    w.close()
+
+
+def test_submit_many_mixed_sql_groups_one_transaction(db):
+    sql2 = "INSERT INTO t (k, v) VALUES (?, 'x')"
+    w = BatchWriter(db)
+    assert w.submit_many("events", SQL, [("a", "1"), ("b", "2")]) == 2
+    w.submit("audit", sql2, ("c",))
+    w.drain()
+    assert len(_rows(db)) == 3
+    assert w.stats()["commits"] == 1  # one group commit for both SQLs
+    w.close()
+
+
+def test_bounded_queue_drops_overflow_and_counts(db):
+    w = BatchWriter(db, max_pending=1000, backpressure_seconds=0.0)
+    accepted = sum(
+        w.submit_many("events", SQL, [(f"k{i}", "v")])
+        for i in range(1500)
+    )
+    assert accepted == 1000
+    st = w.stats()
+    assert st["pending_ops"] == 1000
+    assert st["dropped_ops"] == 500   # loud, never silent
+    w.drain()
+    assert len(_rows(db)) == 1000
+    w.close()
+
+
+def test_backpressure_wait_drains_via_flusher(db):
+    w = BatchWriter(db, max_pending=1000, backpressure_seconds=5.0)
+    sched = Scheduler(workers=2)
+    sched.start()
+    try:
+        w.start(sched)
+        w.submit_many("events", SQL, [(f"k{i}", "v") for i in range(1000)])
+        # buffer is full; this submit must WAIT for the poked flush job
+        # to drain, then land — not drop
+        t0 = time.monotonic()
+        assert w.submit("events", SQL, ("late", "v"))
+        assert time.monotonic() - t0 < 5.0
+        assert w.stats()["dropped_ops"] == 0
+        assert w.flush(timeout=5.0)
+        assert ("late", "v") in _rows(db)
+    finally:
+        w.close()
+        sched.close()
+
+
+def test_scheduler_job_drains_on_interval(db):
+    sched = Scheduler(workers=2)
+    sched.start()
+    w = BatchWriter(db, flush_interval_seconds=0.05)
+    try:
+        w.start(sched)
+        assert FLUSH_JOB_NAME in sched._jobs
+        w.submit("events", SQL, ("tick", "v"))
+        deadline = time.time() + 5
+        while time.time() < deadline and not _rows(db):
+            time.sleep(0.02)
+        assert ("tick", "v") in _rows(db)  # no explicit flush involved
+    finally:
+        w.close()
+        sched.close()
+
+
+def test_flush_threshold_pokes_early_drain(db):
+    sched = Scheduler(workers=2)
+    sched.start()
+    # interval far beyond the test: only the threshold poke can drain
+    w = BatchWriter(db, flush_interval_seconds=60.0, flush_threshold=50)
+    try:
+        w.start(sched)
+        w.submit_many("events", SQL, [(f"k{i}", "v") for i in range(50)])
+        deadline = time.time() + 5
+        while time.time() < deadline and not _rows(db):
+            time.sleep(0.02)
+        assert len(_rows(db)) == 50
+    finally:
+        w.close()
+        sched.close()
+
+
+def test_flush_makes_progress_without_scheduler_workers(db):
+    # all "workers" busy: barrier-waiters must drain inline, not deadlock
+    w = BatchWriter(db)
+    w.submit("events", SQL, ("solo", "v"))
+    done = []
+    th = threading.Thread(target=lambda: done.append(w.flush(timeout=5.0)))
+    th.start()
+    th.join(timeout=6.0)
+    assert not th.is_alive() and done == [True]
+    assert ("solo", "v") in _rows(db)
+    w.close()
+
+
+def test_close_flushes_then_falls_back_to_synchronous(db):
+    w = BatchWriter(db)
+    w.submit("events", SQL, ("pre", "v"))
+    w.close()
+    assert ("pre", "v") in _rows(db)          # final drain on close
+    assert w.submit("events", SQL, ("post", "v"))
+    assert ("post", "v") in _rows(db)         # late submit committed sync
+    assert w.submit_many("events", SQL, [("post2", "v")]) == 1
+    assert ("post2", "v") in _rows(db)
+
+
+def test_drop_pending_discards_uncommitted_and_unblocks_barriers(db):
+    w = BatchWriter(db)
+    w.submit("events", SQL, ("doomed", "v"))
+    assert w.drop_pending(reason="crash") == 1
+    assert w.pending_ops() == 0
+    assert w.stats()["dropped_ops"] == 1
+    assert w.flush(timeout=1.0)               # watermark advanced: no hang
+    assert _rows(db) == []
+    w.close()
+
+
+def test_failed_commit_drops_batch_and_advances_watermark(db):
+    w = BatchWriter(db)
+    w.submit("events", "INSERT INTO missing_table VALUES (?)", ("x",))
+    w.submit("events", SQL, ("ok", "v"))
+    w.drain()                                  # commit fails, batch dropped
+    assert w.stats()["dropped_ops"] >= 1
+    assert w.flush(timeout=1.0)                # readers never hang
+    w.close()
+
+
+def test_concurrent_producers_all_land(db):
+    w = BatchWriter(db, max_pending=100_000)
+    sched = Scheduler(workers=2)
+    sched.start()
+    try:
+        w.start(sched)
+
+        def produce(t):
+            for i in range(200):
+                w.submit("events", SQL, (f"t{t}-{i}", "v"))
+
+        threads = [threading.Thread(target=produce, args=(t,)) for t in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert w.flush(timeout=10.0)
+        assert len(_rows(db)) == 800
+        assert w.stats()["dropped_ops"] == 0
+    finally:
+        w.close()
+        sched.close()
+
+
+def test_checkpoint_wal_truncates_and_samples(db, tmp_path):
+    w = BatchWriter(db)
+    w.submit_many("events", SQL, [(f"k{i}", "v" * 100) for i in range(2000)])
+    info = checkpoint_wal(db, writer=w)
+    assert info["busy"] == 0
+    assert info["wal_bytes"] >= 0
+    # TRUNCATE leaves an empty (or absent) WAL behind
+    assert db.wal_size_bytes() == 0
+    assert len(_rows(db)) == 2000              # checkpoint ran the barrier
+    w.close()
+
+
+def test_fsync_batches_commit_durably(db):
+    w = BatchWriter(db, fsync=True)
+    w.submit("events", SQL, ("durable", "v"))
+    w.drain()
+    assert ("durable", "v") in _rows(db)
+    # synchronous pragma restored to NORMAL after the batch
+    assert db.query("PRAGMA synchronous")[0][0] == 1
+    w.close()
+
+
+def test_storage_lint_repo_is_clean():
+    from gpud_tpu.tools.storage_lint import run_lint
+
+    assert run_lint() == []
+
+
+def test_storage_lint_flags_unguarded_hot_write(tmp_path):
+    bad = tmp_path / "bad_store.py"
+    bad.write_text(
+        "HOT_WRITE_METHODS = ('record', 'ghost')\n"
+        "class S:\n"
+        "    def record(self, row):\n"
+        "        self.db.execute('INSERT', row)\n"
+    )
+    from gpud_tpu.tools.storage_lint import lint_module
+
+    problems = lint_module(str(bad), "bad_store.py")
+    assert any("outside a writer-presence branch" in p for p in problems)
+    assert any("never submits" in p for p in problems)
+    assert any("stale marker" in p for p in problems)
+
+
+def test_storage_lint_accepts_guarded_fallback(tmp_path):
+    good = tmp_path / "good_store.py"
+    good.write_text(
+        "HOT_WRITE_METHODS = ('record',)\n"
+        "class S:\n"
+        "    def record(self, row):\n"
+        "        if self.writer is not None:\n"
+        "            self.writer.submit('s', 'INSERT', row)\n"
+        "        else:\n"
+        "            self.db.execute('INSERT', row)\n"
+    )
+    from gpud_tpu.tools.storage_lint import lint_module
+
+    assert lint_module(str(good), "good_store.py") == []
