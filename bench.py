@@ -407,6 +407,12 @@ def bench_chaos(scenario: str) -> int:
         endpoint=f"http://127.0.0.1:{cp.port}",
         token="chaos-bench-token",
         machine_id="chaos-bench-1",
+        # tightened circuit/replay knobs so the outbox-replay campaign's
+        # open -> half_open -> closed walk fits the expectation windows
+        # (production defaults: 5 failures / 30s cooldown / 1s replay)
+        session_circuit_failure_threshold=3,
+        session_circuit_open_seconds=6.0,
+        outbox_replay_interval_seconds=0.5,
     )
     srv = Server(config=cfg)
     srv.start()
@@ -443,6 +449,13 @@ def bench_chaos(scenario: str) -> int:
             for exp in ph.get("expectations", []):
                 expect_total += 1
                 expect_passed += 1 if exp.get("ok") else 0
+                if not exp.get("ok"):
+                    print(
+                        f"[chaos]   FAIL {res.get('scenario', '?')}/"
+                        f"{ph.get('name', '?')} {exp.get('kind', '?')}: "
+                        f"{exp.get('detail', '')}",
+                        file=sys.stderr,
+                    )
                 if exp.get("latency_seconds") is not None:
                     detect_ms.append(exp["latency_seconds"] * 1000.0)
         verdict = "PASS" if res.get("passed") else "FAIL"
@@ -645,6 +658,123 @@ def bench_ingest(duration: float = 4.0, threads: int = 4) -> int:
     return 0 if ok else 1
 
 
+OUTBOX_TARGET_FRAMES_PER_SEC = 50_000
+OUTBOX_RSS_DELTA_LIMIT_MB = 100.0
+
+
+def bench_outbox(frames: int = 100_000) -> int:
+    """``--outbox`` mode: journal a partition's worth of records into the
+    session outbox through the write-behind layer (no session connected —
+    exactly the partition survival case), then drain the backlog through
+    a loopback session with per-batch acks. Reports journal + drain
+    throughput and the partition RSS delta on stderr; prints one JSON
+    line; exit gates on the 50k frames/sec drain target, zero loss, and
+    the RSS bound."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import shutil
+
+    from gpud_tpu.scheduler import Scheduler
+    from gpud_tpu.session.outbox import SessionOutbox
+    from gpud_tpu.sqlite import DB
+    from gpud_tpu.storage import BatchWriter
+
+    tmp = tempfile.mkdtemp(prefix="tpud-outbox-")
+    db = DB(os.path.join(tmp, "state.db"))
+    writer = BatchWriter(
+        db,
+        flush_interval_seconds=0.2,
+        max_pending=400_000,
+        flush_threshold=5_000,
+    )
+    scheduler = Scheduler(workers=2)
+    writer.start(scheduler)
+    scheduler.start()
+    outbox = SessionOutbox(
+        db, writer=writer, max_rows=frames * 2, replay_batch=2_000
+    )
+
+    rss0 = _rss_mb()
+    t0 = time.monotonic()
+    for i in range(frames):
+        outbox.publish(
+            "event",
+            {"component": "bench", "name": "outbox_bench", "i": i},
+            dedupe_key=f"bench:{i}",
+        )
+    if not writer.flush(timeout=60.0):
+        print("[outbox] WARNING: journal flush barrier timed out",
+              file=sys.stderr)
+    journal_elapsed = time.monotonic() - t0
+    rss1 = _rss_mb()
+
+    class _LoopbackSession:
+        """Transport stand-in: always connected, records delivered seqs."""
+
+        connected = True
+        auth_failed = False
+
+        def __init__(self) -> None:
+            self.seqs = []
+
+        def send(self, frame) -> bool:
+            self.seqs.append(frame.data["outbox_seq"])
+            return True
+
+    sess = _LoopbackSession()
+    t1 = time.monotonic()
+    drained = 0
+    while outbox.backlog() > 0:
+        sent = outbox.replay_once(sess)
+        if not sent:
+            break
+        drained += sent
+        outbox.ack(sess.seqs[-1])  # manager acks the batch watermark
+    drain_elapsed = time.monotonic() - t1
+    stats = outbox.stats()
+
+    writer.close()
+    scheduler.close()
+    db.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    journal_rate = frames / journal_elapsed if journal_elapsed else 0.0
+    drain_rate = drained / drain_elapsed if drain_elapsed else 0.0
+    rss_delta = rss1 - rss0
+    zero_loss = (
+        drained == frames
+        and stats["backlog"] == 0
+        and stats["dropped_journal_full"] == 0
+        and stats["dropped_retention"] == 0
+    )
+    print(
+        f"[outbox] journal: {journal_rate:,.0f} frames/sec "
+        f"({frames:,} frames in {journal_elapsed:.2f}s, "
+        f"partition rss delta={rss_delta:+.1f}MB "
+        f"[gate <= {OUTBOX_RSS_DELTA_LIMIT_MB:g}MB])",
+        file=sys.stderr,
+    )
+    print(
+        f"[outbox] drain: {drain_rate:,.0f} frames/sec "
+        f"({drained:,} delivered in {drain_elapsed:.2f}s, "
+        f"backlog={stats['backlog']}, acked_seq={stats['acked_seq']}) "
+        f"[target >= {OUTBOX_TARGET_FRAMES_PER_SEC:,}]",
+        file=sys.stderr,
+    )
+    ok = (
+        drain_rate >= OUTBOX_TARGET_FRAMES_PER_SEC
+        and zero_loss
+        and rss_delta <= OUTBOX_RSS_DELTA_LIMIT_MB
+    )
+    print(json.dumps({
+        "metric": "outbox replay drain throughput",
+        "value": round(drain_rate, 1),
+        "unit": "frames/sec",
+        "vs_baseline": round(drain_rate / OUTBOX_TARGET_FRAMES_PER_SEC, 2),
+    }))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -665,11 +795,22 @@ def main(argv=None) -> int:
         "--ingest-seconds", type=float, default=4.0,
         help="measurement window for --ingest (default 4s)",
     )
+    ap.add_argument(
+        "--outbox", action="store_true",
+        help="run the session-outbox journal/replay bench (store-and-"
+             "forward layer) instead of the standard bench",
+    )
+    ap.add_argument(
+        "--outbox-frames", type=int, default=100_000,
+        help="frames to journal/drain for --outbox (default 100000)",
+    )
     args = ap.parse_args(argv)
     if args.chaos:
         return bench_chaos(args.chaos)
     if args.ingest:
         return bench_ingest(duration=args.ingest_seconds)
+    if args.outbox:
+        return bench_outbox(frames=args.outbox_frames)
     res = bench_fault_detection()
     # the secondary benches are stderr-only color; none may take down the
     # primary JSON line. The footprint bench additionally gates on the

@@ -13,6 +13,9 @@ invariants that hold the daemon itself to account:
                 ledger has the rows to prove it
   events:       eventstore contents (name/message/count)
   plane:        the agent's control-plane session reconnected
+  outbox:       store-and-forward delivery held — zero loss across the
+                partition, circuit-breaker transitions in order, connect
+                attempts flat while the breaker is open
   invariants:   zero unhandled worker exceptions (scheduler failure +
                 watchdog counters flat), un-faulted job cadence within
                 slack, thread-count and RSS gates
@@ -253,6 +256,167 @@ def _eval_plane(server, spec: Dict, ctx) -> ExpectationResult:
     return ExpectationResult("plane", True, detail="no plane assertion")
 
 
+def _eval_outbox(server, spec: Dict, ctx) -> List[ExpectationResult]:
+    """Store-and-forward delivery assertions (session/outbox.py):
+
+      zero_loss:       every record journaled since the campaign started
+                       is observable at the fake control plane (dedupe
+                       keys ⊆ plane.outbox_keys) and the backlog drains
+                       to the acked watermark
+      circuit_states:  ordered-subsequence check against the breaker's
+                       transition history (e.g. [open, half_open, closed])
+      connects_flat_while_open: while the circuit reads open, the plane's
+                       connect/refusal counters must not move — the
+                       breaker provably suppresses attempts
+    """
+    out: List[ExpectationResult] = []
+    outbox = getattr(server, "outbox", None)
+    if outbox is None:
+        return [ExpectationResult(
+            "outbox", False, detail="outbox disabled (outbox_enabled)",
+        )]
+    within = float(spec.get("within", ctx.detect_timeout))
+
+    if spec.get("zero_loss", False):
+        if ctx.plane is None or not hasattr(ctx.plane, "outbox_keys"):
+            out.append(ExpectationResult(
+                "outbox", False,
+                detail="zero_loss needs an outbox-aware fake control plane",
+            ))
+        else:
+            from gpud_tpu.session.outbox import TABLE as OUTBOX_TABLE
+
+            since = getattr(ctx, "campaign_start", ctx.phase_start) - SINCE_SLACK
+            deadline = ctx.time_fn() + within
+
+            def journaled_keys():
+                outbox.flush()
+                rows = outbox.db.query(
+                    f"SELECT dedupe_key FROM {OUTBOX_TABLE} WHERE ts >= ?",
+                    (since,),
+                )
+                return {r[0] for r in rows}
+
+            def drained():
+                keys = journaled_keys()
+                missing = keys - ctx.plane.outbox_keys
+                if missing or outbox.backlog() > 0:
+                    return None
+                return (len(keys),)
+
+            got = _poll(drained, deadline, ctx)
+            if got is None:
+                keys = journaled_keys()
+                missing = keys - ctx.plane.outbox_keys
+                out.append(ExpectationResult(
+                    "outbox", False, timed_out=True,
+                    detail=(
+                        f"zero_loss: {len(missing)} of {len(keys)} journaled "
+                        f"record(s) undelivered, backlog={outbox.backlog()} "
+                        f"after {within:g}s"
+                    ),
+                ))
+            else:
+                out.append(ExpectationResult(
+                    "outbox", True,
+                    detail=(
+                        f"zero_loss: all {got[0]} journaled record(s) "
+                        "delivered, backlog drained"
+                    ),
+                ))
+
+    want_states = spec.get("circuit_states")
+    if want_states:
+        circuit = getattr(server, "session_circuit", None)
+        if circuit is None:
+            out.append(ExpectationResult(
+                "outbox", False, detail="no session circuit breaker",
+            ))
+        else:
+            deadline = ctx.time_fn() + within
+
+            def seen_in_order():
+                seen = circuit.states_seen()
+                i = 0
+                for s in seen:
+                    if i < len(want_states) and s == want_states[i]:
+                        i += 1
+                return (seen,) if i >= len(want_states) else None
+
+            got = _poll(seen_in_order, deadline, ctx)
+            desc = "circuit " + "→".join(want_states)
+            if got is None:
+                out.append(ExpectationResult(
+                    "outbox", False, timed_out=True,
+                    detail=f"{desc} not observed (saw: "
+                           f"{'→'.join(circuit.states_seen())})",
+                ))
+            else:
+                out.append(ExpectationResult("outbox", True, detail=desc))
+
+    flat_seconds = float(spec.get("connects_flat_while_open", 0.0))
+    if flat_seconds > 0:
+        circuit = getattr(server, "session_circuit", None)
+        if circuit is None or ctx.plane is None:
+            out.append(ExpectationResult(
+                "outbox", False,
+                detail="connects_flat_while_open needs a circuit + plane",
+            ))
+        else:
+            # wait for the breaker to open WITH enough cooldown left to
+            # fit the whole sampling window, then hold it: attempts
+            # reaching the plane (accepted OR refused) must stay flat
+            # while it reads open. Anchoring to seconds_until_probe
+            # keeps the legitimate half-open probe out of the window —
+            # a probe firing mid-sample is recovery, not a leak
+            def window_ready():
+                if circuit.state != "open":
+                    return None
+                if circuit.seconds_until_probe() < flat_seconds + 0.25:
+                    return None
+                return (True,)
+
+            opened = _poll(window_ready, ctx.time_fn() + within, ctx)
+            if opened is None:
+                out.append(ExpectationResult(
+                    "outbox", False, timed_out=True,
+                    detail=(
+                        f"circuit never held an open window >= "
+                        f"{flat_seconds:g}s within {within:g}s "
+                        f"(state now: {circuit.state})"
+                    ),
+                ))
+            else:
+                def attempts():
+                    return (
+                        int(getattr(ctx.plane, "connects", 0))
+                        + int(getattr(ctx.plane, "refused", 0))
+                    )
+
+                before = attempts()
+                end = ctx.time_fn() + flat_seconds
+                moved = 0
+                while ctx.time_fn() < end and circuit.state == "open":
+                    moved = attempts() - before
+                    if moved:
+                        break
+                    ctx.sleep_fn(POLL_INTERVAL)
+                ok = moved == 0
+                out.append(ExpectationResult(
+                    "outbox", ok,
+                    detail=(
+                        f"connect attempts flat for {flat_seconds:g}s while open"
+                        if ok
+                        else f"{moved} connect attempt(s) leaked while circuit open"
+                    ),
+                ))
+    if not out:
+        out.append(ExpectationResult(
+            "outbox", True, detail="no outbox assertion",
+        ))
+    return out
+
+
 def _eval_invariants(server, spec: Dict, ctx) -> List[ExpectationResult]:
     out = []
     reg = server.metrics_registry
@@ -338,6 +502,8 @@ def evaluate_phase(server, expect: Dict, ctx) -> List[ExpectationResult]:
         results.extend(_eval_events(server, expect["events"] or [], ctx))
     if "plane" in expect:
         results.append(_eval_plane(server, expect["plane"] or {}, ctx))
+    if "outbox" in expect:
+        results.extend(_eval_outbox(server, expect["outbox"] or {}, ctx))
     if "invariants" in expect:
         results.extend(_eval_invariants(server, expect["invariants"] or {}, ctx))
     return results

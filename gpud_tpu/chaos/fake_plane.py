@@ -11,6 +11,12 @@ carries the fault knobs a disconnect/latency campaign needs:
     serving and before each pushed frame (slow-control-plane modelling)
   - ``drop_session`` / ``drop_all`` / ``disconnect_storm``: scripted
     disconnect/reconnect churn against the agent's session loop
+  - ``refuse_connects``: 503 every session stream — a hard-down manager
+    for circuit-breaker drills (connect attempts are still counted)
+  - outbox ingest: frames carrying ``outbox_seq`` on the write stream
+    are recorded (``outbox_keys`` / ``outbox_frames``) and auto-acked
+    via an ``outboxAck`` request on the read stream — the manager half
+    of the store-and-forward contract (session/outbox.py)
 
 Run standalone: ``python -m gpud_tpu.chaos.fake_plane <port>``.
 """
@@ -43,6 +49,13 @@ class FakeControlPlane:
         self.latency_seconds = 0.0  # injected delay per stream-start/frame
         self.connects = 0           # read-stream accepts (reconnect counting)
         self.drops = 0              # sessions dropped via drop_session/drop_all
+        self.refuse_connects = False  # 503 every session stream (hard-down)
+        self.refused = 0
+        # store-and-forward outbox ingest (auto-acked; see module docstring)
+        self.outbox_frames: List[dict] = []
+        self.outbox_keys: set = set()
+        self.outbox_acked: Dict[str, int] = {}  # machine_id → highest seq
+        self._ack_seq = 0
 
     # -- server ------------------------------------------------------------
     async def _login(self, req: web.Request) -> web.Response:
@@ -57,6 +70,11 @@ class FakeControlPlane:
         )
 
     async def _session(self, req: web.Request) -> web.StreamResponse:
+        if self.refuse_connects:
+            # hard-down manager: the attempt reached us (counted) but no
+            # stream is served — drives the agent's circuit breaker open
+            self.refused += 1
+            return web.Response(status=503, text="unavailable")
         if self.reject_auth:
             self.auth_rejects += 1
             return web.Response(status=401, text="unauthorized")
@@ -98,11 +116,40 @@ class FakeControlPlane:
                 if not line:
                     continue
                 try:
-                    self.responses.append(json.loads(line))
+                    d = json.loads(line)
                 except ValueError:
-                    pass
+                    continue
+                self.responses.append(d)
+                data = d.get("data") if isinstance(d, dict) else None
+                if isinstance(data, dict) and "outbox_seq" in data:
+                    self._ingest_outbox(machine, data)
             return web.json_response({"ok": True})
         return web.json_response({"error": "bad session type"}, status=400)
+
+    def _ingest_outbox(self, machine: str, data: dict) -> None:
+        """Record one store-and-forward frame and auto-ack its sequence on
+        the machine's read stream (dedupe is by key — at-least-once means
+        redeliveries are normal and must not double-record)."""
+        try:
+            seq = int(data.get("outbox_seq", 0))
+        except (TypeError, ValueError):
+            return
+        key = str(data.get("dedupe_key") or "")
+        if key not in self.outbox_keys:
+            self.outbox_keys.add(key)
+            self.outbox_frames.append(data)
+        if seq > self.outbox_acked.get(machine, 0):
+            self.outbox_acked[machine] = seq
+        q = self.sessions.get(machine)
+        if q is not None:
+            self._ack_seq += 1
+            q.put_nowait(
+                {
+                    "req_id": f"fcp-ack-{self._ack_seq}",
+                    "data": {"method": "outboxAck",
+                             "seq": self.outbox_acked[machine]},
+                }
+            )
 
     # -- control API for tests / campaigns -----------------------------------
     def send_request(self, machine_id: str, req_id: str, data: dict) -> None:

@@ -29,6 +29,7 @@ plus campaign helpers: ``trigger`` (poke a check), ``set_healthy``,
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Callable, Dict, Optional
 
 from gpud_tpu.fault_injector import Request as InjectRequest
@@ -165,6 +166,38 @@ def act_plane_disconnect(server, step: Dict, ctx) -> Optional[str]:
     return None
 
 
+def act_plane_refuse(server, step: Dict, ctx) -> Optional[str]:
+    """Hard-down manager: the fake plane 503s every session connect for
+    ``duration`` seconds (0 = until phase cleanup), then live sessions
+    are dropped so the agent actually re-enters its connect loop and the
+    circuit breaker sees consecutive failures. Cleanup always un-refuses."""
+    plane = ctx.plane
+    if plane is None:
+        return "no fake control plane attached to this campaign"
+    if not hasattr(plane, "refuse_connects"):
+        return "attached control plane has no refuse_connects knob"
+    if step.get("resume"):
+        # scripted recovery mid-campaign (cleanups only run at the end)
+        plane.refuse_connects = False
+        logger.info("chaos: control plane accepting connects again")
+        return None
+    duration = float(step.get("duration", 0.0))
+    plane.refuse_connects = True
+    plane.drop_all()
+
+    def _recover() -> None:
+        plane.refuse_connects = False
+
+    ctx.cleanups.append(_recover)
+    if duration > 0:
+        timer = threading.Timer(duration, _recover)
+        timer.daemon = True
+        timer.start()
+        ctx.cleanups.append(timer.cancel)
+    logger.info("chaos: control plane refusing connects (duration=%gs)", duration)
+    return None
+
+
 def act_trigger(server, step: Dict, ctx) -> Optional[str]:
     comp, err = _component(server, step)
     if err:
@@ -285,6 +318,7 @@ ACTIONS: Dict[str, Callable] = {
     "runtime_crash": act_runtime_crash,
     "clock_skew": act_clock_skew,
     "plane_disconnect": act_plane_disconnect,
+    "plane_refuse": act_plane_refuse,
     "trigger": act_trigger,
     "set_healthy": act_set_healthy,
     "remediation_scan": act_remediation_scan,

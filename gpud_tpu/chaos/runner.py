@@ -44,7 +44,7 @@ logger = get_logger(__name__)
 # actions that *start* a fault: the phase's detection-latency clock is
 # anchored at the first of these to fire
 FAULT_ACTIONS = ("inject", "metric_ramp", "runtime_crash", "clock_skew",
-                 "plane_disconnect")
+                 "plane_disconnect", "plane_refuse")
 
 STEP_WAIT_SECONDS = 60.0  # per-step completion ceiling on the pool
 
@@ -76,6 +76,7 @@ class _Context:
         self.detect_timeout = detect_timeout
         self.cleanups: List = []
         self.baseline: Dict[str, float] = {}
+        self.campaign_start = 0.0
         self.phase_start = 0.0
         self.fault_t0: Optional[float] = None
 
@@ -120,6 +121,7 @@ class CampaignRunner:
             "watchdog": counter_total(reg, "tpud_scheduler_watchdog_fires_total"),
         }
         started = self.time_fn()
+        ctx.campaign_start = started
         audit_log("chaos_campaign", scenario=sc.name)
         result: Dict = {
             "scenario": sc.name,
@@ -256,6 +258,9 @@ class ChaosManager:
         self.max_campaign_seconds = max_campaign_seconds
         self.extra_dirs = list(extra_dirs or [])
         self.plane = None
+        # optional campaign-result observer (the server wires the session
+        # outbox here); must never fail the campaign path
+        self.on_result = None
         self._mu = threading.Lock()
         self._history: deque = deque(maxlen=max(1, history_limit))
         self._running: Optional[Dict] = None
@@ -318,6 +323,12 @@ class ChaosManager:
             with self._mu:
                 self._running = None
                 self._history.appendleft(result)
+            hook = self.on_result
+            if hook is not None:
+                try:
+                    hook(result)
+                except Exception:  # noqa: BLE001
+                    logger.exception("chaos on_result hook failed")
             return result
 
         if wait:

@@ -54,6 +54,7 @@ KNOWN_ACTIONS = (
     "runtime_crash",   # runtime unit reported failed for `duration` seconds
     "clock_skew",      # shift a component's / the engine's clock by `offset`
     "plane_disconnect",  # drop control-plane sessions (fake_plane harness)
+    "plane_refuse",    # hard-down manager: 503 every connect for `duration`
     "trigger",         # poke a component check to the front of the heap
     "set_healthy",     # clear a component's sticky state
     "remediation_scan",  # poke the remediation engine's scan job
@@ -66,6 +67,7 @@ KNOWN_ACTIONS = (
 # expectation kinds evaluated after each phase (gpud_tpu/chaos/expectations.py)
 KNOWN_EXPECTATIONS = (
     "detect", "ledger", "remediation", "events", "invariants", "plane",
+    "outbox",
 )
 
 MAX_STEP_OCCURRENCES = 1000  # per phase — runaway `count` backstop

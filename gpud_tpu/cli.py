@@ -446,6 +446,58 @@ def cmd_chaos(args) -> int:
     return 0 if out.get("passed") else 1
 
 
+def cmd_session(args) -> int:
+    """Show the daemon's control-plane session health: connection state,
+    circuit breaker, and the store-and-forward outbox backlog."""
+    from gpud_tpu.client.v1 import Client, ClientError
+
+    scheme = "http" if getattr(args, "no_tls", False) else "https"
+    c = Client(
+        base_url=f"{scheme}://localhost:{args.port}",
+        timeout=float(args.timeout),
+    )
+    try:
+        out = c.get_session_status()
+    except ClientError as e:
+        print(f"error: {e.body[:500]}", file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001
+        print(f"tpud unreachable on port {args.port}: {e}", file=sys.stderr)
+        return 1
+    if getattr(args, "as_json", False):
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+    if not out.get("configured"):
+        print("session: not configured (no control-plane endpoint/token)")
+        return 0
+    sess = out.get("session") or {}
+    state = "connected" if sess.get("connected") else "disconnected"
+    if sess.get("auth_failed"):
+        state += " (auth failed; replay parked until token rotation)"
+    print(f"session: {state}  endpoint={sess.get('endpoint', '?')}")
+    if sess.get("last_connect_error"):
+        print(f"  last connect error: {sess['last_connect_error']}")
+    circuit = out.get("circuit") or {}
+    if circuit:
+        print(
+            f"circuit: {circuit.get('state', '?')}  "
+            f"failures={circuit.get('consecutive_failures', 0)}/"
+            f"{circuit.get('failure_threshold', '?')}  "
+            f"blocked_attempts={circuit.get('blocked_attempts', 0)}"
+        )
+    outbox = out.get("outbox") or {}
+    if outbox:
+        print(
+            f"outbox: backlog={outbox.get('backlog', 0)}  "
+            f"acked_seq={outbox.get('acked_seq', 0)}/"
+            f"{outbox.get('last_seq', 0)}  "
+            f"dropped(journal_full={outbox.get('dropped_journal_full', 0)}, "
+            f"retention={outbox.get('dropped_retention', 0)})"
+        )
+    print(f"degraded: {str(bool(out.get('degraded'))).lower()}")
+    return 0
+
+
 def cmd_machine_info(args) -> int:
     from gpud_tpu.machine_info import get_machine_info
     from gpud_tpu.tpu.instance import new_instance
@@ -908,6 +960,19 @@ def build_parser() -> argparse.ArgumentParser:
     cl.add_argument("--timeout", type=float, default=30.0)
     cl.add_argument("--json", action="store_true", dest="as_json")
     cl.set_defaults(fn=cmd_chaos)
+
+    pse = sub.add_parser(
+        "session", help="control-plane session / outbox health"
+    )
+    ssub = pse.add_subparsers(dest="session_cmd", required=True)
+    sst = ssub.add_parser(
+        "status", help="connection, circuit-breaker, and outbox state"
+    )
+    sst.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
+    sst.add_argument("--no-tls", action="store_true")
+    sst.add_argument("--timeout", type=float, default=30.0)
+    sst.add_argument("--json", action="store_true", dest="as_json")
+    sst.set_defaults(fn=cmd_session)
 
     pmi = sub.add_parser("machine-info", help="print machine info JSON")
     pmi.add_argument("--accelerator-type", default="")

@@ -226,3 +226,9 @@ class Client:
         (``/v1/chaos/campaigns``)."""
         params = {"limit": limit} if limit is not None else None
         return self._req("GET", "/v1/chaos/campaigns", params=params)
+
+    def get_session_status(self) -> Dict:
+        """Control-plane session health (``/v1/session/status``):
+        connection + auth state, circuit breaker, and the
+        store-and-forward outbox backlog/watermark."""
+        return self._req("GET", "/v1/session/status")

@@ -47,6 +47,15 @@ DEFAULT_STORAGE_BATCH_MAX_PENDING = 100_000  # buffered ops before backpressure
 DEFAULT_STORAGE_BATCH_FLUSH_THRESHOLD = 5_000  # buffered ops that poke a drain
 DEFAULT_STORAGE_BATCH_BACKPRESSURE = 0.05    # bounded wait for room (s)
 DEFAULT_STORAGE_WAL_CHECKPOINT = 300         # wal_checkpoint(TRUNCATE) cadence (s)
+# durable session outbox (docs/session.md): store-and-forward journal
+# between producers and the control-plane session
+DEFAULT_OUTBOX_MAX_ROWS = 100_000            # journal hard cap (rows)
+DEFAULT_OUTBOX_MAX_AGE = 7 * 86400           # journal age cap: a week of partition
+DEFAULT_OUTBOX_REPLAY_BATCH = 500            # frames per replay drain
+DEFAULT_OUTBOX_REPLAY_INTERVAL = 1.0         # replay job cadence (s)
+# control-plane circuit breaker (docs/session.md)
+DEFAULT_SESSION_CIRCUIT_THRESHOLD = 5        # consecutive failures before open
+DEFAULT_SESSION_CIRCUIT_OPEN_SECONDS = 30.0  # open-state cooldown before probe
 
 STATE_FILE = "tpud.state"                # reference: default.go:137-157 (gpud.state)
 FIFO_FILE = "tpud.fifo"
@@ -120,6 +129,18 @@ class Config:
     storage_batch_backpressure_seconds: float = DEFAULT_STORAGE_BATCH_BACKPRESSURE
     storage_batch_fsync: bool = False    # one fsync per group commit when True
     storage_wal_checkpoint_seconds: int = DEFAULT_STORAGE_WAL_CHECKPOINT
+    # durable session outbox (docs/session.md): at-least-once delivery of
+    # events/transitions/audit/chaos results across partitions + restarts.
+    # Off = the classic fire-and-forget in-memory channels only.
+    outbox_enabled: bool = True
+    outbox_max_rows: int = DEFAULT_OUTBOX_MAX_ROWS
+    outbox_max_age_seconds: int = DEFAULT_OUTBOX_MAX_AGE
+    outbox_replay_batch: int = DEFAULT_OUTBOX_REPLAY_BATCH
+    outbox_replay_interval_seconds: float = DEFAULT_OUTBOX_REPLAY_INTERVAL
+    # control-plane circuit breaker: closed → open after N consecutive
+    # connect failures → half-open probe after the cooldown
+    session_circuit_failure_threshold: int = DEFAULT_SESSION_CIRCUIT_THRESHOLD
+    session_circuit_open_seconds: float = DEFAULT_SESSION_CIRCUIT_OPEN_SECONDS
     # unified check scheduler (docs/scheduler.md)
     scheduler_workers: int = DEFAULT_SCHEDULER_WORKERS
     scheduler_watchdog_seconds: int = DEFAULT_SCHEDULER_WATCHDOG
@@ -221,6 +242,18 @@ class Config:
             return "storage batch backpressure must be >= 0s"
         if self.storage_wal_checkpoint_seconds < 0:
             return "storage wal checkpoint cadence must be >= 0s (0 disables)"
+        if self.outbox_max_rows < 1000:
+            return "outbox max rows must be >= 1000"
+        if self.outbox_max_age_seconds < 60:
+            return "outbox max age must be >= 60s"
+        if self.outbox_replay_batch < 1:
+            return "outbox replay batch must be >= 1"
+        if self.outbox_replay_interval_seconds <= 0:
+            return "outbox replay interval must be > 0s"
+        if self.session_circuit_failure_threshold < 1:
+            return "session circuit failure threshold must be >= 1"
+        if self.session_circuit_open_seconds <= 0:
+            return "session circuit open seconds must be > 0s"
         if self.scheduler_workers < 1:
             return "scheduler workers must be >= 1"
         if self.scheduler_watchdog_seconds < 0:

@@ -108,6 +108,10 @@ class EventStore:
         self.db = db
         self.writer = writer
         self.retention_seconds = retention_seconds
+        # optional post-insert observer (the server wires the session
+        # outbox here so every event is journaled for delivery); must
+        # never fail the insert path
+        self.on_insert = None
         self._buckets: Dict[str, Bucket] = {}
         self._mu = threading.Lock()
         self._purger = RetentionPurger(
@@ -161,6 +165,12 @@ class EventStore:
             self.writer.submit("events", sql, params)
         else:
             self.db.execute(sql, params)
+        hook = self.on_insert
+        if hook is not None:
+            try:
+                hook(component, ev)
+            except Exception:  # noqa: BLE001
+                logger.exception("event on_insert hook failed")
 
     def _find(self, component: str, ev: Event) -> Optional[Event]:
         self.flush()

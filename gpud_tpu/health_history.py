@@ -132,6 +132,9 @@ class HealthLedger:
         self.availability_window = availability_window_seconds
         self.correlation_window = correlation_window_seconds
         self._mu = threading.Lock()
+        # optional post-transition observer (the server wires the session
+        # outbox here); must never fail the observe path
+        self.on_transition = None
         # component -> [state, episode_since, last_seen, first_seen]
         self._last: Dict[str, list] = {}
         self._last_flap_event: Dict[str, float] = {}
@@ -282,6 +285,12 @@ class HealthLedger:
         _c_transitions.inc(
             labels={"component": component, "from": from_state, "to": to_state}
         )
+        hook = self.on_transition
+        if hook is not None:
+            try:
+                hook(component, from_state, to_state, ts, reason or "")
+            except Exception:  # noqa: BLE001
+                logger.exception("health on_transition hook failed")
 
     def _flap_check(self, component: str, now: float) -> Dict[str, str]:
         n = self._transitions_in_window(component, now)

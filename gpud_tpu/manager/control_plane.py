@@ -33,6 +33,7 @@ import queue
 import threading
 import time
 import uuid
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from gpud_tpu.log import get_logger
@@ -65,6 +66,17 @@ class AgentHandle:
         self.draining = threading.Event()  # v2: send DrainNotice on teardown
         self.drain_reason = "manager draining"
         self._seq = 0
+        # store-and-forward outbox ingest (session/outbox.py): delivery is
+        # at-least-once, so dedupe by key; the manager acks the highest
+        # sequence seen (frames arrive in seq order on one stream, so the
+        # max IS the contiguous watermark). All bounded — a week-long
+        # backlog replaying through must not grow manager memory
+        self.outbox_keys: "OrderedDict[str, None]" = OrderedDict()
+        self.outbox_keys_max = 8192
+        self.outbox_records: List[dict] = []  # delivered frames, newest last
+        self.outbox_records_max = 2048
+        self.outbox_acked = 0
+        self._ack_req_ids: "OrderedDict[str, bool]" = OrderedDict()
 
     # -- operator side -----------------------------------------------------
     def request(self, data: dict, timeout: float = DEFAULT_REQUEST_TIMEOUT) -> dict:
@@ -91,7 +103,14 @@ class AgentHandle:
     # -- transport side ----------------------------------------------------
     def resolve(self, req_id: str, payload: dict) -> None:
         self.last_seen = time.time()
+        if req_id.startswith("outbox-") or (
+            isinstance(payload, dict) and "outbox_seq" in payload
+        ):
+            self._ingest_outbox(payload)
+            return
         with self._lock:
+            if self._ack_req_ids.pop(req_id, False):
+                return  # agent's response to our outboxAck; nothing to do
             q = self._pending.get(req_id)
         if q is None:
             self.unsolicited.append({"req_id": req_id, "data": payload})
@@ -101,6 +120,40 @@ class AgentHandle:
             q.put_nowait(payload)
         except queue.Full:
             pass
+
+    def _ingest_outbox(self, payload: dict) -> None:
+        """One replayed outbox frame off the agent's write stream: dedupe
+        by key, record if fresh, and push an ``outboxAck`` request for the
+        new watermark onto the read stream."""
+        if not isinstance(payload, dict):
+            return
+        try:
+            seq = int(payload.get("outbox_seq", 0))
+        except (TypeError, ValueError):
+            return
+        key = str(payload.get("dedupe_key") or "")
+        with self._lock:
+            if key not in self.outbox_keys:
+                self.outbox_keys[key] = None
+                while len(self.outbox_keys) > self.outbox_keys_max:
+                    self.outbox_keys.popitem(last=False)
+                self.outbox_records.append(payload)
+                del self.outbox_records[:-self.outbox_records_max]
+            if seq > self.outbox_acked:
+                self.outbox_acked = seq
+            ack_seq = self.outbox_acked
+            self._seq += 1
+            ack_req_id = f"op-{self._seq}-ack"
+            self._ack_req_ids[ack_req_id] = True
+            # agents ack every frame-batch; keep only recent ids so a
+            # slow agent's late responses age into `unsolicited` (bounded)
+            while len(self._ack_req_ids) > 512:
+                self._ack_req_ids.popitem(last=False)
+        if not self._gone.is_set():
+            self.outbound.put(
+                {"req_id": ack_req_id,
+                 "data": {"method": "outboxAck", "seq": ack_seq}}
+            )
 
     def mark_gone(self) -> None:
         self._gone.set()

@@ -56,6 +56,9 @@ class AuditStore:
         self.writer = writer
         self.retention_seconds = retention_seconds
         self.time_now_fn = time.time
+        # optional post-record observer (the server wires the session
+        # outbox here); must never fail the record path
+        self.on_record = None
         db.execute(
             f"""CREATE TABLE IF NOT EXISTS {TABLE} (
                 id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -116,6 +119,25 @@ class AuditStore:
             self.writer.submit("audit", sql, params)
         else:
             self.db.execute(sql, params)
+        hook = self.on_record
+        if hook is not None:
+            try:
+                hook(
+                    {
+                        "ts": params[0],
+                        "component": component,
+                        "action": action,
+                        "suggested": suggested,
+                        "trigger_health": trigger_health,
+                        "trigger_reason": trigger_reason or "",
+                        "decision": decision,
+                        "outcome": outcome,
+                        "detail": detail or "",
+                        "duration_seconds": duration_seconds,
+                    }
+                )
+            except Exception:  # noqa: BLE001
+                logger.exception("audit on_record hook failed")
 
     def flush(self) -> None:
         """Read-after-write barrier (no-op without a writer)."""

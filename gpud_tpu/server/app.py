@@ -436,6 +436,34 @@ def build_app(srv: "Server") -> web.Application:
         limit = int(_qfloat(req, "limit", 0.0))
         return _json(srv.chaos.campaigns(limit=max(0, limit)))
 
+    async def session_status(_req: web.Request) -> web.Response:
+        """Control-plane session health: connection + auth state, circuit
+        breaker, and the store-and-forward outbox backlog/watermark."""
+
+        def collect() -> dict:
+            out: dict = {
+                "configured": srv.session is not None,
+                "degraded": _session_degraded(srv),
+            }
+            session = srv.session
+            if session is not None:
+                out["session"] = {
+                    "endpoint": session.endpoint,
+                    "connected": session.connected,
+                    "auth_failed": session.auth_failed,
+                    "connect_attempts": getattr(session, "connect_attempts", 0),
+                    "last_connect_error": session.last_connect_error,
+                }
+            circuit = getattr(srv, "session_circuit", None)
+            if circuit is not None:
+                out["circuit"] = circuit.stats()
+            outbox = getattr(srv, "outbox", None)
+            if outbox is not None:
+                out["outbox"] = outbox.stats()
+            return out
+
+        return _json(await _run_blocking(srv, collect))
+
     async def admin_config(_req: web.Request) -> web.Response:
         cfg = srv.config
         # the local API is unauthenticated — never serve credentials
@@ -569,6 +597,7 @@ def build_app(srv: "Server") -> web.Application:
     r.add_post("/v1/remediation/policy", remediation_policy_post)
     r.add_post("/v1/chaos/run", chaos_run)
     r.add_get("/v1/chaos/campaigns", chaos_campaigns)
+    r.add_get("/v1/session/status", session_status)
     r.add_get("/v1/events", events)
     r.add_get("/v1/metrics", metrics_v1)
     r.add_get("/v1/info", info)
@@ -583,6 +612,22 @@ def build_app(srv: "Server") -> web.Application:
 
 
 SELF_COMPONENT = "tpud-self"
+
+
+def _session_degraded(srv: "Server") -> bool:
+    """True when a control-plane session exists but delivery is impaired:
+    disconnected, parked on an auth failure, or circuit not closed. New
+    records still land in the outbox journal, so nothing is lost — but
+    the manager's view of this node is stale until the path recovers."""
+    session = srv.session
+    if session is None:
+        return False
+    if not session.connected or session.auth_failed:
+        return True
+    circuit = getattr(srv, "session_circuit", None)
+    from gpud_tpu.session.outbox import CIRCUIT_CLOSED
+
+    return circuit is not None and circuit.state != CIRCUIT_CLOSED
 
 
 def _self_info_entry(srv: "Server", start: float, now: float) -> dict:
@@ -612,6 +657,17 @@ def _self_info_entry(srv: "Server", start: float, now: float) -> dict:
         extra["health_transitions_total"] = str(summary["transitions_total"])
         extra["health_components_tracked"] = str(summary["components_tracked"])
         extra["health_flapping_components"] = ",".join(summary["flapping"])
+    # SessionDegraded: the manager-facing warning flag — set whenever a
+    # configured control-plane session cannot currently deliver (records
+    # keep journaling to the outbox; nothing is lost, only delayed)
+    if srv.session is not None:
+        extra["SessionDegraded"] = str(_session_degraded(srv)).lower()
+        circuit = getattr(srv, "session_circuit", None)
+        if circuit is not None:
+            extra["session_circuit_state"] = circuit.state
+    outbox = getattr(srv, "outbox", None)
+    if outbox is not None:
+        extra["outbox_backlog"] = str(outbox.stats()["backlog"])
     return ComponentInfo(
         component=SELF_COMPONENT,
         start_time=start,

@@ -179,6 +179,27 @@ class Server:
                 ),
             )
 
+        # durable session outbox + control-plane circuit breaker
+        # (docs/session.md): producers journal here; a replay job drains
+        # everything above the manager-acked watermark into the session
+        self.outbox = None
+        self._outbox_replay_job = None
+        from gpud_tpu.session.outbox import CircuitBreaker, SessionOutbox
+
+        self.session_circuit = CircuitBreaker(
+            failure_threshold=self.config.session_circuit_failure_threshold,
+            open_seconds=float(self.config.session_circuit_open_seconds),
+        )
+        if self.config.outbox_enabled:
+            self.outbox = SessionOutbox(
+                self.db_rw,
+                writer=self.storage_writer,
+                max_rows=self.config.outbox_max_rows,
+                max_age_seconds=float(self.config.outbox_max_age_seconds),
+                replay_batch=self.config.outbox_replay_batch,
+            )
+            self._wire_outbox_producers()
+
         # unified check scheduler: one deadline heap + bounded worker pool
         # owns every periodic job (docs/scheduler.md) — components, metrics
         # scrape/record, retention, remediation scan, update watcher
@@ -317,6 +338,98 @@ class Server:
         if err_comp is not None and err_comp.syncer is not None:
             self.kmsg_watcher.register(err_comp.syncer)
 
+    # -- durable outbox wiring (docs/session.md) ---------------------------
+    def _wire_outbox_producers(self) -> None:
+        """Hook every control-plane-relevant producer into the outbox
+        journal: events, health transitions, remediation audit rows, and
+        chaos campaign results (gossip publishes from its dispatch
+        worker). Dedupe keys are derived from each record's natural
+        identity so the manager can collapse at-least-once redeliveries."""
+        outbox = self.outbox
+
+        def on_event(component: str, ev) -> None:
+            outbox.publish(
+                "event",
+                {
+                    "component": component,
+                    "time": ev.time,
+                    "name": ev.name,
+                    "type": ev.type,
+                    "message": ev.message,
+                },
+                dedupe_key=f"event:{component}:{ev.time}:{ev.name}",
+            )
+
+        def on_transition(
+            component: str, from_state: str, to_state: str,
+            ts: float, reason: str,
+        ) -> None:
+            outbox.publish(
+                "transition",
+                {
+                    "component": component,
+                    "from": from_state,
+                    "to": to_state,
+                    "ts": ts,
+                    "reason": reason,
+                },
+                dedupe_key=f"transition:{component}:{ts}:{to_state}",
+            )
+
+        def on_audit(row: dict) -> None:
+            outbox.publish(
+                "remediation_audit",
+                row,
+                dedupe_key=(
+                    f"audit:{row.get('component')}:{row.get('ts')}:"
+                    f"{row.get('action')}"
+                ),
+            )
+
+        def on_chaos_result(result: dict) -> None:
+            outbox.publish(
+                "chaos_result",
+                {
+                    "id": result.get("id"),
+                    "scenario": result.get("scenario"),
+                    "passed": result.get("passed"),
+                    "error": result.get("error", ""),
+                },
+                dedupe_key=f"chaos:{result.get('scenario')}:{result.get('id')}",
+            )
+
+        self.event_store.on_insert = on_event
+        self.health_ledger.on_transition = on_transition
+        if self.remediation is not None:
+            self.remediation.audit.on_record = on_audit
+        if self.chaos is not None:
+            self.chaos.on_result = on_chaos_result
+
+    def _outbox_replay_tick(self) -> int:
+        """Scheduler job "session-outbox-replay": drain one batch of
+        unacked records into the session; no-op while disconnected, auth-
+        parked, or caught up."""
+        outbox = self.outbox
+        if outbox is None:
+            return 0
+        return outbox.replay_once(self.session)
+
+    def _session_frame_drop_event(self, direction: str, detail: str) -> None:
+        """Rate-limited (session-side) Warning event for dropped session
+        frames — overflow must be visible in the event timeline, not just
+        a counter."""
+        from gpud_tpu.api.v1.types import Event, EventType
+
+        self.event_store.bucket("session").insert(
+            Event(
+                component="session",
+                time=time.time(),
+                name="session_frame_dropped",
+                type=EventType.WARNING,
+                message=f"{direction} channel overflow: {detail}",
+            )
+        )
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         """Start pollers + API listener (non-blocking; reference spawns
@@ -355,6 +468,12 @@ class Server:
                 self._retention_targets.append(
                     ("remediation-audit", self.remediation.audit.purge_once)
                 )
+            if self.outbox is not None:
+                # size/age bounds on the delivery journal: a week-long
+                # partition degrades telemetry, never fills the disk
+                self._retention_targets.append(
+                    ("session-outbox", self.outbox.purge_once)
+                )
             retention_interval = max(
                 60.0, self.config.events_retention_seconds / 5.0
             )
@@ -383,6 +502,17 @@ class Server:
                         interval=interval,
                         initial_delay=interval,
                     )
+            if self.outbox is not None:
+                # replay drains above the acked watermark whenever the
+                # session is connected; on_connected pokes it for an
+                # immediate post-reconnect drain
+                interval = float(self.config.outbox_replay_interval_seconds)
+                self._outbox_replay_job = self.scheduler.add_job(
+                    "session-outbox-replay",
+                    self._outbox_replay_tick,
+                    interval=interval,
+                    initial_delay=interval,
+                )
             if self.remediation is not None:
                 self.remediation.start(self.scheduler)
             self.metrics_syncer.start(self.scheduler)
@@ -649,7 +779,18 @@ class Server:
                     # fallback) must still be persistable
                     snapshot = pair
 
-            session.on_connected = persist_on_connect
+            def on_connected() -> None:
+                persist_on_connect()
+                # drain the outbox backlog immediately instead of waiting
+                # out the replay interval — reconnect is exactly when the
+                # store-and-forward journal has work
+                job = self._outbox_replay_job
+                if job is not None:
+                    job.poke()
+
+            session.circuit = self.session_circuit
+            session.on_frame_dropped = self._session_frame_drop_event
+            session.on_connected = on_connected
             self.session.on_auth_failure = self._make_auth_failure_handler(
                 session
             )

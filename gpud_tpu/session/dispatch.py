@@ -221,6 +221,18 @@ class Dispatcher:
                     machine_id=self.server.machine_id,
                 )
                 self.server.last_gossip = mi.to_dict()
+                # journal a compact gossip marker into the durable outbox
+                # (the full tree is poll-on-demand; what must survive a
+                # partition is that this node gossiped, and when)
+                outbox = getattr(self.server, "outbox", None)
+                if outbox is not None:
+                    outbox.publish(
+                        "gossip",
+                        {
+                            "machine_id": self.server.machine_id,
+                            "ts": time.time(),
+                        },
+                    )
             except Exception:  # noqa: BLE001
                 logger.exception("gossip failed")
             finally:
@@ -414,6 +426,34 @@ class Dispatcher:
             return {"error": "chaos is disabled (chaos_enabled)"}
         limit = int(req.get("limit") or 0)
         return chaos.campaigns(limit=max(0, limit))
+
+    # -- durable outbox (session/outbox.py) --------------------------------
+    def _m_outboxAck(self, req: Dict) -> Dict:
+        """Manager acks the outbox replay watermark: everything at/below
+        ``seq`` was received (and deduped by key) on its side. Monotonic —
+        a stale or replayed ack never regresses the watermark."""
+        outbox = getattr(self.server, "outbox", None)
+        if outbox is None:
+            return {"error": "outbox is disabled (outbox_enabled)"}
+        try:
+            seq = int(req.get("seq"))
+        except (TypeError, ValueError):
+            return {"error": "outboxAck requires an integer 'seq'"}
+        if seq < 0:
+            return {"error": "outboxAck requires seq >= 0"}
+        return {"acked_seq": outbox.ack(seq)}
+
+    def _m_outboxStatus(self, req: Dict) -> Dict:
+        """Outbox journal + circuit-breaker state (the session-method
+        mirror of ``GET /v1/session/status``)."""
+        outbox = getattr(self.server, "outbox", None)
+        if outbox is None:
+            return {"error": "outbox is disabled (outbox_enabled)"}
+        out: Dict = {"outbox": outbox.stats()}
+        circuit = getattr(self.server, "session_circuit", None)
+        if circuit is not None:
+            out["circuit"] = circuit.stats()
+        return out
 
     def _m_bootstrap(self, req: Dict) -> Dict:
         """base64 script exec (reference: session bootstrap)."""

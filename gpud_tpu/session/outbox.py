@@ -1,0 +1,474 @@
+"""Durable store-and-forward outbox for the control-plane session.
+
+The session's in-memory channels (``CHANNEL_CAP`` in session.py) are a
+wire buffer, not a delivery contract: anything produced while the
+control plane is unreachable — exactly the window the fleet operator
+most needs this node's telemetry ("When GPUs Fail Quietly", PAPERS.md) —
+was silently lost on overflow or daemon restart. The ``SessionOutbox``
+closes that gap:
+
+- producers (event inserts, health transitions, remediation audit rows,
+  chaos campaign results, gossip) ``publish()`` outbound records; each
+  is journaled to a SQLite table through the shared write-behind
+  ``BatchWriter`` (docs/storage.md) and assigned a monotonic sequence
+  number at publish time;
+- a replay job drains everything above the last manager-acked watermark
+  into the live session whenever it is connected — at-least-once
+  delivery: a redelivered frame carries the same ``dedupe_key``, so the
+  manager side deduplicates;
+- the manager acks by calling the ``outboxAck`` session method with the
+  highest contiguous sequence it has seen; the watermark only ever
+  advances (``MAX(acked_seq, ?)`` both in memory and in SQL), so a crash
+  or batch reorder can never regress it and re-deliver the world;
+- retention bounds the journal by row count and age so a week-long
+  partition degrades telemetry (oldest rows drop, with accounting in
+  ``tpud_outbox_dropped_total``) instead of filling the disk.
+
+The module also owns the session ``CircuitBreaker``
+(closed → open on consecutive connect failures → half-open probe →
+closed), exposed as ``tpud_session_circuit_state`` and consulted by the
+session keep-alive loop so a hard-down manager stops costing connect
+attempts. Delivery semantics are documented in docs/session.md.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import counter, gauge
+
+logger = get_logger(__name__)
+
+TABLE = "tpud_session_outbox_v0_1"
+ACK_TABLE = "tpud_session_outbox_ack_v0_1"
+
+DEFAULT_MAX_ROWS = 100_000        # journal hard cap (rows)
+DEFAULT_MAX_AGE = 7 * 86400       # journal age cap: one week of partition
+DEFAULT_REPLAY_BATCH = 500        # frames handed to the session per drain
+
+# delivery frames ride the normal agent→manager stream with this req_id
+# prefix; the manager treats them as unsolicited data, not responses
+REPLAY_REQ_PREFIX = "outbox-"
+
+# write-behind contract (tools/storage_lint.py): these methods must route
+# through the BatchWriter, never commit per-row via db.execute directly
+HOT_WRITE_METHODS = ("publish", "ack")
+
+_c_published = counter(
+    "tpud_outbox_published_total",
+    "records journaled into the session outbox, by kind",
+)
+_c_replayed = counter(
+    "tpud_outbox_replayed_total",
+    "outbox frames handed to the session transport (delivery attempts; "
+    "at-least-once, so redeliveries count again)",
+)
+_c_dropped = counter(
+    "tpud_outbox_dropped_total",
+    "outbox records lost before ack, by reason (journal-full write drops, "
+    "retention purging unacked rows past the hard cap)",
+)
+_c_purged = counter(
+    "tpud_outbox_purged_total",
+    "acked outbox rows removed by size/age retention (normal housekeeping, "
+    "not data loss)",
+)
+_g_backlog = gauge(
+    "tpud_outbox_backlog",
+    "journaled outbox records not yet acked by the manager",
+)
+_g_acked = gauge(
+    "tpud_outbox_acked_seq",
+    "highest manager-acked outbox sequence number (the replay watermark)",
+)
+_g_circuit = gauge(
+    "tpud_session_circuit_state",
+    "control-plane circuit breaker state: 0=closed, 1=open, 2=half-open",
+)
+_c_circuit_transitions = counter(
+    "tpud_session_circuit_transitions_total",
+    "circuit breaker state transitions, by target state",
+)
+_c_circuit_blocked = counter(
+    "tpud_session_circuit_blocked_total",
+    "connect attempts suppressed because the circuit breaker was open",
+)
+
+
+class SessionOutbox:
+    """Durable at-least-once delivery journal (module docstring).
+
+    Thread-safe: ``publish`` may be called from any producer thread
+    (component checks, the kmsg watcher, session dispatch, the chaos
+    runner); ``ack`` arrives on the session serve thread; ``replay_once``
+    runs on a scheduler worker. Sequence assignment and the watermark are
+    guarded by one lock; SQL rides the shared ``BatchWriter`` buffer.
+    """
+
+    def __init__(
+        self,
+        db,
+        writer=None,
+        max_rows: int = DEFAULT_MAX_ROWS,
+        max_age_seconds: float = DEFAULT_MAX_AGE,
+        replay_batch: int = DEFAULT_REPLAY_BATCH,
+        time_now_fn: Callable[[], float] = time.time,
+    ) -> None:
+        self.db = db
+        self.writer = writer
+        self.max_rows = int(max_rows)
+        self.max_age_seconds = float(max_age_seconds)
+        self.replay_batch = max(1, int(replay_batch))
+        self.time_now_fn = time_now_fn
+        self._mu = threading.Lock()
+        db.execute(
+            f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+                seq INTEGER PRIMARY KEY,
+                ts REAL NOT NULL,
+                kind TEXT NOT NULL,
+                dedupe_key TEXT NOT NULL,
+                payload TEXT NOT NULL
+            )"""
+        )
+        db.execute(
+            f"""CREATE TABLE IF NOT EXISTS {ACK_TABLE} (
+                id INTEGER PRIMARY KEY CHECK (id = 1),
+                acked_seq INTEGER NOT NULL
+            )"""
+        )
+        db.execute(
+            f"INSERT OR IGNORE INTO {ACK_TABLE} (id, acked_seq) VALUES (1, 0)"
+        )
+        # restart: resume sequence numbering after the highest journaled
+        # row and reload the persisted watermark — both only ever advance
+        row = db.query_one(f"SELECT MAX(seq) FROM {TABLE}")
+        self._next_seq = int(row[0] or 0) + 1 if row else 1
+        row = db.query_one(f"SELECT acked_seq FROM {ACK_TABLE} WHERE id=1")
+        self._acked = int(row[0] or 0) if row else 0
+        # a restart may reload a watermark ahead of MAX(seq) if acked rows
+        # were purged; never mint a seq at/below the watermark
+        if self._acked >= self._next_seq:
+            self._next_seq = self._acked + 1
+        self._published = 0
+        self._replayed = 0
+        self._write_drops = 0
+        self._retention_drops = 0
+        _g_acked.set(self._acked)
+        _g_backlog.set(self.backlog())
+
+    # -- producer side -----------------------------------------------------
+    def publish(
+        self, kind: str, payload: Dict, dedupe_key: str = ""
+    ) -> int:
+        """Journal one outbound record; returns its sequence number.
+
+        ``dedupe_key`` identifies the record across redeliveries (the
+        manager's dedupe handle); empty derives a stable ``kind:seq`` key.
+        """
+        now = self.time_now_fn()
+        with self._mu:
+            seq = self._next_seq
+            self._next_seq += 1
+            self._published += 1
+        key = dedupe_key or f"{kind}:{seq}"
+        sql = (
+            f"INSERT INTO {TABLE} (seq, ts, kind, dedupe_key, payload) "
+            "VALUES (?, ?, ?, ?, ?)"
+        )
+        params = (seq, now, kind, key, json.dumps(payload, default=str))
+        if self.writer is not None:
+            if not self.writer.submit("outbox", sql, params):
+                with self._mu:
+                    self._write_drops += 1
+                _c_dropped.inc(labels={"reason": "journal_full"})
+        else:
+            self.db.execute(sql, params)
+        _c_published.inc(labels={"kind": kind})
+        _g_backlog.set(max(0, seq - self._acked))
+        return seq
+
+    # -- manager ack path --------------------------------------------------
+    def ack(self, seq: int) -> int:
+        """Advance the replay watermark to ``seq``; returns the (possibly
+        unchanged) watermark. Monotonic: a stale or duplicate ack — the
+        manager replays acks too under at-least-once — never regresses it.
+        """
+        seq = int(seq)
+        with self._mu:
+            if seq <= self._acked:
+                return self._acked
+            self._acked = seq
+        # MAX() in SQL too: group-commit batches may reorder vs. memory
+        sql = f"UPDATE {ACK_TABLE} SET acked_seq = MAX(acked_seq, ?) WHERE id = 1"
+        if self.writer is not None:
+            # coalesce: many acks inside one flush window commit once
+            self.writer.submit("outbox", sql, (seq,), key=("outbox-ack",))
+        else:
+            self.db.execute(sql, (seq,))
+        _g_acked.set(seq)
+        _g_backlog.set(self.backlog())
+        return seq
+
+    @property
+    def acked_seq(self) -> int:
+        with self._mu:
+            return self._acked
+
+    @property
+    def last_seq(self) -> int:
+        with self._mu:
+            return self._next_seq - 1
+
+    def backlog(self) -> int:
+        with self._mu:
+            return max(0, (self._next_seq - 1) - self._acked)
+
+    # -- replay ------------------------------------------------------------
+    def flush(self) -> None:
+        """Read-after-write barrier (no-op without a writer)."""
+        if self.writer is not None:
+            self.writer.flush()
+
+    def pending(self, limit: int = 0) -> List[Tuple[int, float, str, str, Dict]]:
+        """Journaled records above the watermark, oldest first:
+        ``(seq, ts, kind, dedupe_key, payload)`` rows."""
+        self.flush()
+        sql = (
+            f"SELECT seq, ts, kind, dedupe_key, payload FROM {TABLE} "
+            "WHERE seq > ? ORDER BY seq"
+        )
+        params: list = [self.acked_seq]
+        if limit:
+            sql += " LIMIT ?"
+            params.append(limit)
+        out = []
+        for seq, ts, kind, key, payload in self.db.query(sql, params):
+            try:
+                data = json.loads(payload)
+            except ValueError:
+                data = {"raw": payload}
+            out.append((int(seq), float(ts), kind, key, data))
+        return out
+
+    def replay_once(self, session) -> int:
+        """Drain one batch of unacked records into a connected session.
+
+        Returns frames handed to the transport. Stops early on writer-
+        channel backpressure (``send`` timing out) — the next replay tick
+        resumes from the same watermark, which is what at-least-once
+        means. A disconnected or auth-parked session is a no-op: replay
+        must not hammer a manager that just revoked the token.
+        """
+        if session is None or not session.connected or session.auth_failed:
+            return 0
+        from gpud_tpu.session.session import Frame
+
+        sent = 0
+        for seq, ts, kind, key, payload in self.pending(self.replay_batch):
+            frame = Frame(
+                req_id=f"{REPLAY_REQ_PREFIX}{seq}",
+                data={
+                    "outbox_seq": seq,
+                    "kind": kind,
+                    "dedupe_key": key,
+                    "ts": ts,
+                    "payload": payload,
+                },
+            )
+            if not session.send(frame):
+                break
+            sent += 1
+        if sent:
+            with self._mu:
+                self._replayed += sent
+            _c_replayed.inc(sent)
+        return sent
+
+    # -- retention ---------------------------------------------------------
+    def purge_once(self) -> int:
+        """Size/age retention pass (scheduler "retention-purge" target).
+
+        Acked rows older than ``max_age_seconds`` go first (normal
+        housekeeping). Past ``max_rows`` the oldest rows drop regardless
+        of ack state — unacked drops are data loss and are accounted in
+        ``tpud_outbox_dropped_total{reason=retention}``.
+        """
+        self.flush()
+        cutoff = self.time_now_fn() - self.max_age_seconds
+        acked = self.acked_seq
+        cur = self.db.execute(
+            f"DELETE FROM {TABLE} WHERE seq <= ? AND ts < ?", (acked, cutoff)
+        )
+        purged = max(0, int(getattr(cur, "rowcount", 0) or 0))
+        row = self.db.query_one(f"SELECT COUNT(*), MIN(seq) FROM {TABLE}")
+        count, min_seq = (int(row[0] or 0), int(row[1] or 0)) if row else (0, 0)
+        if count > self.max_rows:
+            excess = count - self.max_rows
+            horizon = min_seq + excess - 1
+            lost = self.db.query_one(
+                f"SELECT COUNT(*) FROM {TABLE} WHERE seq <= ? AND seq > ?",
+                (horizon, acked),
+            )
+            lost_n = int(lost[0] or 0) if lost else 0
+            self.db.execute(f"DELETE FROM {TABLE} WHERE seq <= ?", (horizon,))
+            purged += excess
+            if lost_n:
+                with self._mu:
+                    self._retention_drops += lost_n
+                _c_dropped.inc(lost_n, {"reason": "retention"})
+                logger.warning(
+                    "outbox retention dropped %d unacked record(s) "
+                    "(journal past %d rows)", lost_n, self.max_rows,
+                )
+                # rows below the horizon are gone; pretend the manager
+                # acked them so replay doesn't spin on a hole forever
+                self.ack(horizon)
+        if purged:
+            _c_purged.inc(purged)
+        _g_backlog.set(self.backlog())
+        return purged
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> Dict:
+        with self._mu:
+            published = self._published
+            replayed = self._replayed
+            acked = self._acked
+            next_seq = self._next_seq
+            write_drops = self._write_drops
+            retention_drops = self._retention_drops
+        return {
+            "last_seq": next_seq - 1,
+            "acked_seq": acked,
+            "backlog": max(0, (next_seq - 1) - acked),
+            "published": published,
+            "replayed": replayed,
+            "dropped_journal_full": write_drops,
+            "dropped_retention": retention_drops,
+            "max_rows": self.max_rows,
+            "max_age_seconds": self.max_age_seconds,
+        }
+
+
+# -- circuit breaker -------------------------------------------------------
+
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_OPEN = "open"
+CIRCUIT_HALF_OPEN = "half_open"
+
+_CIRCUIT_GAUGE_VALUES = {CIRCUIT_CLOSED: 0, CIRCUIT_OPEN: 1, CIRCUIT_HALF_OPEN: 2}
+
+DEFAULT_FAILURE_THRESHOLD = 5
+DEFAULT_OPEN_SECONDS = 30.0
+_HISTORY_CAP = 64
+
+
+class CircuitBreaker:
+    """Connect-path circuit breaker (closed → open → half-open → closed).
+
+    ``allow()`` gates each connect attempt: closed always permits; open
+    denies until ``open_seconds`` elapse, then transitions to half-open
+    and permits exactly one probe; the probe's ``record_success`` closes
+    the circuit, its ``record_failure`` re-opens it for a fresh cooldown.
+    State rides ``tpud_session_circuit_state`` and a bounded transition
+    history feeds the chaos expectation layer.
+    """
+
+    def __init__(
+        self,
+        failure_threshold: int = DEFAULT_FAILURE_THRESHOLD,
+        open_seconds: float = DEFAULT_OPEN_SECONDS,
+        time_fn: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.open_seconds = float(open_seconds)
+        self.time_fn = time_fn
+        self._mu = threading.Lock()
+        self._state = CIRCUIT_CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+        self._blocked = 0
+        # (monotonic_ts, state) transitions, oldest first, bounded
+        self.history: List[Tuple[float, str]] = [(self.time_fn(), CIRCUIT_CLOSED)]
+        _g_circuit.set(0)
+
+    def _transition_locked(self, state: str) -> None:
+        if state == self._state:
+            return
+        self._state = state
+        self.history.append((self.time_fn(), state))
+        del self.history[:-_HISTORY_CAP]
+        _g_circuit.set(_CIRCUIT_GAUGE_VALUES[state])
+        _c_circuit_transitions.inc(labels={"to": state})
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            return self._state
+
+    def states_seen(self) -> List[str]:
+        with self._mu:
+            return [s for _ts, s in self.history]
+
+    def allow(self) -> bool:
+        """True when a connect attempt may proceed now."""
+        with self._mu:
+            if self._state == CIRCUIT_CLOSED:
+                return True
+            if self._state == CIRCUIT_OPEN:
+                if self.time_fn() - self._opened_at >= self.open_seconds:
+                    self._transition_locked(CIRCUIT_HALF_OPEN)
+                    return True  # the single half-open probe
+                self._blocked += 1
+                _c_circuit_blocked.inc()
+                return False
+            # half-open: one probe is already in flight on the keep-alive
+            # thread; there is exactly one caller, so permitting again is
+            # harmless but keep the gate strict
+            return True
+
+    def seconds_until_probe(self) -> float:
+        """Remaining cooldown while open (0 when an attempt may proceed)."""
+        with self._mu:
+            if self._state != CIRCUIT_OPEN:
+                return 0.0
+            return max(0.0, self.open_seconds - (self.time_fn() - self._opened_at))
+
+    def record_success(self) -> None:
+        with self._mu:
+            self._failures = 0
+            self._transition_locked(CIRCUIT_CLOSED)
+
+    def record_failure(self) -> None:
+        with self._mu:
+            self._failures += 1
+            if self._state == CIRCUIT_HALF_OPEN:
+                # failed probe: back to open for a fresh cooldown
+                self._opened_at = self.time_fn()
+                self._transition_locked(CIRCUIT_OPEN)
+            elif (
+                self._state == CIRCUIT_CLOSED
+                and self._failures >= self.failure_threshold
+            ):
+                self._opened_at = self.time_fn()
+                self._transition_locked(CIRCUIT_OPEN)
+
+    @property
+    def blocked_count(self) -> int:
+        with self._mu:
+            return self._blocked
+
+    def stats(self) -> Dict:
+        with self._mu:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._failures,
+                "failure_threshold": self.failure_threshold,
+                "open_seconds": self.open_seconds,
+                "blocked_attempts": self._blocked,
+                "states_seen": [s for _ts, s in self.history],
+            }

@@ -29,6 +29,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import counter
 from gpud_tpu.version import __version__
 
 logger = get_logger(__name__)
@@ -40,6 +41,18 @@ BACKOFF_MAX = 60.0
 BACKOFF_FACTOR = 2.0
 # while auth-parked, how often to re-check whether the token changed
 AUTH_RECHECK_INTERVAL = 5.0
+# rate limit on the Warning event emitted when a session channel drops a
+# frame (the counter still counts every drop)
+FRAME_DROP_EVENT_INTERVAL = 30.0
+# while the circuit breaker is open, cap each wait slice so stop() and
+# token changes stay responsive
+CIRCUIT_WAIT_SLICE = 1.0
+
+_c_frames_dropped = counter(
+    "tpud_session_frames_dropped_total",
+    "frames dropped by a full session channel, by direction (read = "
+    "manager requests, write = agent responses/outbox deliveries)",
+)
 
 # anchored so incidental digits ("port=4013") and local OS errors
 # ("[Errno 13] Permission denied") never classify as auth failures
@@ -61,7 +74,11 @@ def is_auth_error(reason) -> bool:
     """Classify a connect failure as an auth failure (revoked/invalid
     token) vs a network blip (reference: session_reconnect.go:38-226 +
     session_v2.go:359 classify Unauthenticated/401). Prefers structured
-    fields (HTTP status, grpc code); text matching is anchored."""
+    fields (a pre-classified ``auth_error`` attribute, HTTP status, grpc
+    code); text matching is anchored."""
+    explicit = getattr(reason, "auth_error", None)
+    if explicit is not None:
+        return bool(explicit)
     resp = getattr(reason, "response", None)
     if resp is not None:
         code = getattr(resp, "status_code", None)
@@ -174,6 +191,23 @@ class Session:
         # set by the server's auth-failure handler after it promotes the
         # boot-flag token once; guards against credential ping-pong
         self.flag_token_tried = False
+        # optional connect-path circuit breaker (session/outbox.py): the
+        # server injects one so a hard-down manager stops costing connect
+        # attempts; None = classic backoff-only behavior (tests, tools)
+        self.circuit = None
+        # frame-drop visibility (tpud_session_frames_dropped_total): the
+        # server wires an event emitter here; calls are rate-limited to
+        # one per direction per FRAME_DROP_EVENT_INTERVAL
+        self.on_frame_dropped: Optional[Callable[[str, str], None]] = None
+        self._last_drop_note: Dict[str, float] = {}
+        # structured auth classification of last_connect_error: transports
+        # classify mid-stream failures while the exception object is live
+        # (HTTP status / grpc code) instead of regexing the formatted
+        # string later; None = unclassified, fall back to is_auth_error()
+        self._last_reason_auth: Optional[bool] = None
+        # connect attempts ever made (chaos proves the open circuit keeps
+        # this flat)
+        self.connect_attempts = 0
 
         # protocol auto: try v2 gRPC, fall back to legacy v1 dual streams
         # (reference: session_v2.go:49-80); injected transports pin v1
@@ -214,22 +248,51 @@ class Session:
     def _keep_alive(self) -> None:
         backoff = BACKOFF_INITIAL
         while not self._stop.is_set():
+            cb = self.circuit
+            if cb is not None and not cb.allow():
+                # circuit open: no network attempt at all until the
+                # cooldown elapses (the connect-attempt counter must stay
+                # flat); wake in bounded slices so stop() stays responsive
+                wait = min(
+                    max(cb.seconds_until_probe(), 0.05), CIRCUIT_WAIT_SLICE
+                )
+                if self.time_sleep_fn(wait):
+                    return
+                continue
             self._drain_reader()
             self._reconnect_signal.clear()
+            self._last_reason_auth = None
+            self.connect_attempts += 1
             try:
                 stops = self._connect()
             except Exception as e:  # noqa: BLE001
                 self.last_connect_error = str(e)
                 logger.warning("session connect failed: %s", e)
-                if is_auth_error(e):
+                auth = is_auth_error(e)
+                if cb is not None and not auth:
+                    # auth rejections park below — counting them toward
+                    # the circuit would double-suppress the token path
+                    cb.record_failure()
+                if auth:
                     if self._park_on_auth_failure(str(e)):
                         return
+                    backoff = BACKOFF_INITIAL
+                    continue
+                if cb is not None and cb.state != "closed":
+                    # the failure tripped (or re-tripped) the breaker:
+                    # its cooldown is now the single pacing authority.
+                    # Sleeping the exponential backoff on top would
+                    # stack two waits and stall recovery long after the
+                    # manager is back (a failed half-open probe with
+                    # backoff grown to minutes is the worst case)
                     backoff = BACKOFF_INITIAL
                     continue
                 if self.time_sleep_fn(self.jitter_fn(backoff)):
                     return
                 backoff = min(backoff * BACKOFF_FACTOR, BACKOFF_MAX)
                 continue
+            if cb is not None:
+                cb.record_success()
             self._connected.set()
             if self.on_connected is not None:
                 try:
@@ -249,8 +312,14 @@ class Session:
             if self._stop.is_set():
                 return
             # a 401/Unauthenticated may also arrive mid-stream via
-            # signal_reconnect's reason rather than a connect exception
-            if is_auth_error(self.last_connect_error):
+            # signal_reconnect's reason rather than a connect exception;
+            # prefer the transport's structured classification (v1 HTTP
+            # status / v2 grpc code captured while the exception was live)
+            # over regexing the formatted string
+            auth = self._last_reason_auth
+            if auth is None:
+                auth = is_auth_error(self.last_connect_error)
+            if auth:
                 if self._park_on_auth_failure(self.last_connect_error):
                     return
                 backoff = BACKOFF_INITIAL
@@ -310,9 +379,14 @@ class Session:
         self.active_protocol = "v1"
         return stops
 
-    def signal_reconnect(self, reason: str = "") -> None:
+    def signal_reconnect(self, reason: str = "", auth: Optional[bool] = None) -> None:
+        """``auth`` carries the transport's structured classification of
+        the failure (computed from the live exception's HTTP status/grpc
+        code); None = unknown, the keep-alive loop falls back to text
+        matching via ``is_auth_error``."""
         if reason:
             self.last_connect_error = reason
+            self._last_reason_auth = auth
         self._reconnect_signal.set()
 
     def _drain_reader(self) -> None:
@@ -356,8 +430,29 @@ class Session:
             self.writer.put(frame, timeout=self.send_timeout)
             return True
         except queue.Full:
-            logger.warning("session writer channel full; dropping frame")
+            self.note_frame_dropped(
+                "write", "session writer channel full; dropping frame"
+            )
             return False
+
+    def note_frame_dropped(self, direction: str, detail: str) -> None:
+        """Account one dropped frame: the counter counts every drop; the
+        Warning event hook (server-wired) is rate-limited per direction so
+        a sustained overflow doesn't flood the event store."""
+        _c_frames_dropped.inc(labels={"direction": direction})
+        logger.warning("%s", detail)
+        hook = self.on_frame_dropped
+        if hook is None:
+            return
+        now = time.monotonic()
+        last = self._last_drop_note.get(direction)
+        if last is not None and now - last < FRAME_DROP_EVENT_INTERVAL:
+            return
+        self._last_drop_note[direction] = now
+        try:
+            hook(direction, detail)
+        except Exception:  # noqa: BLE001
+            logger.exception("on_frame_dropped hook failed")
 
     # -- HTTP transport (requests-based; replaced in tests) ----------------
     def _headers(self, session_type: str) -> Dict[str, str]:
@@ -398,14 +493,17 @@ class Session:
                         try:
                             self.reader.put(frame, timeout=5.0)
                         except queue.Full:
-                            logger.warning("reader channel full; dropping request")
+                            self.note_frame_dropped(
+                                "read",
+                                "reader channel full; dropping request",
+                            )
                 # graceful server-side close is also a disconnect: without a
                 # reconnect the session would look connected but be deaf
                 if not stopped.is_set():
                     self.signal_reconnect("read stream closed")
             except Exception as e:  # noqa: BLE001
                 if not stopped.is_set():
-                    self.signal_reconnect(f"read stream: {e}")
+                    self.signal_reconnect(f"read stream: {e}", auth=is_auth_error(e))
 
         t = threading.Thread(target=pump, name="tpud-session-reader", daemon=True)
         t.start()
@@ -447,7 +545,9 @@ class Session:
                     self.signal_reconnect("write stream closed")
             except Exception as e:  # noqa: BLE001
                 if not stopped.is_set():
-                    self.signal_reconnect(f"write stream: {e}")
+                    self.signal_reconnect(
+                        f"write stream: {e}", auth=is_auth_error(e)
+                    )
 
         t = threading.Thread(target=run, name="tpud-session-writer", daemon=True)
         t.start()

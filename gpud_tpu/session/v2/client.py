@@ -18,6 +18,7 @@ from typing import TYPE_CHECKING, Callable, Optional
 import grpc
 
 from gpud_tpu.log import get_logger
+from gpud_tpu.session.session import is_auth_error
 from gpud_tpu.session.v2 import session_pb2 as pb
 from gpud_tpu.session.v2 import typed
 from gpud_tpu.version import __version__
@@ -65,7 +66,13 @@ def resolve_v2_target(endpoint: str, override: str) -> "tuple[str, bool]":
 
 
 class HandshakeRejected(Exception):
-    pass
+    """HelloAck rejection (or connect-time RpcError). ``auth_error``
+    carries the structured auth-vs-network classification computed at the
+    failure site — ``is_auth_error`` reads it before any text matching,
+    so a revoked token parks the keep-alive loop the same way v1's HTTP
+    401 does instead of retrying through backoff forever."""
+
+    auth_error: bool = False
 
 
 def start_v2_transport(session: "Session") -> Callable[[], None]:
@@ -90,6 +97,9 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
     stopped = threading.Event()
     handshake_ok = threading.Event()
     handshake_err: list = []
+    # parallel to handshake_err: structured auth classification computed
+    # while the failure object (grpc code / rejection reason) was live
+    handshake_auth: list = []
     # reconnect signals are only valid once this transport was adopted —
     # a failed v2 probe must not tear down the v1 fallback that follows
     established = threading.Event()
@@ -121,15 +131,16 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
 
     call = stream(request_iter())
 
-    def _signal_if_established(reason: str) -> None:
+    def _signal_if_established(reason: str, auth: Optional[bool] = None) -> None:
         """A disconnect after adoption must reconnect the session; one
         during a failed probe must not poison the v1 fallback. The drain/
         EOF may race the main thread between handshake-ok and adoption, so
-        wait briefly for the verdict instead of sampling it."""
+        wait briefly for the verdict instead of sampling it. ``auth``
+        forwards the structured classification to the keep-alive loop."""
         if stopped.is_set():
             return
         if established.wait(HANDSHAKE_TIMEOUT) and not stopped.is_set():
-            session.signal_reconnect(reason)
+            session.signal_reconnect(reason, auth=auth)
 
     def _enqueue_request(req_id: str, data) -> bool:
         """Hand one inbound request to the session serve loop; False when
@@ -140,7 +151,9 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
             session.reader.put(Frame(req_id=req_id, data=data), timeout=5.0)
             return True
         except queue.Full:
-            logger.warning("v2 reader channel full; dropping")
+            session.note_frame_dropped(
+                "read", "v2 reader channel full; dropping request"
+            )
             return False
 
     def recv_pump():
@@ -151,7 +164,12 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
                 kind = mpkt.WhichOneof("payload")
                 if kind == "hello_ack":
                     if not mpkt.hello_ack.accepted:
-                        handshake_err.append(mpkt.hello_ack.reason or "rejected")
+                        reason = mpkt.hello_ack.reason or "rejected"
+                        handshake_err.append(reason)
+                        # the HelloAck vocabulary is narrow ("bad token",
+                        # "invalid machine proof" vs revision mismatch);
+                        # classify here, at the authoritative site
+                        handshake_auth.append(is_auth_error(reason))
                         handshake_ok.set()
                         return
                     negotiated[0] = typed.negotiate_revision(
@@ -191,13 +209,20 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
                         )
             if not stopped.is_set():
                 handshake_err.append("stream closed before ack")
+                handshake_auth.append(False)
                 handshake_ok.set()
-                _signal_if_established("v2 stream closed")
+                _signal_if_established("v2 stream closed", auth=False)
         except grpc.RpcError as e:
+            # classify while the live error object still carries its grpc
+            # code — the formatted string a later is_auth_error would see
+            # loses UNAUTHENTICATED/PERMISSION_DENIED structure (v1 parity:
+            # the HTTP transports classify from the response status)
+            auth = is_auth_error(e)
             handshake_err.append(str(e))
+            handshake_auth.append(auth)
             handshake_ok.set()
             if not stopped.is_set():
-                _signal_if_established(f"v2 stream: {e.code()}")
+                _signal_if_established(f"v2 stream: {e.code()}", auth=auth)
 
     def send_pump():
         import json
@@ -228,7 +253,9 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
         stopped.set()
         call.cancel()
         channel.close()
-        raise HandshakeRejected(handshake_err[0])
+        exc = HandshakeRejected(handshake_err[0])
+        exc.auth_error = bool(handshake_auth[0]) if handshake_auth else False
+        raise exc
 
     established.set()
     send_t = threading.Thread(target=send_pump, name="tpud-v2-send", daemon=True)

@@ -48,6 +48,8 @@ _METRIC_MODULES = (
     "gpud_tpu.scheduler.core",
     "gpud_tpu.server.app",
     "gpud_tpu.session.dispatch",
+    "gpud_tpu.session.outbox",
+    "gpud_tpu.session.session",
     "gpud_tpu.sqlite",
     "gpud_tpu.storage.writer",
 )

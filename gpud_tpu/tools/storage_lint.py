@@ -19,7 +19,7 @@ is exactly the pattern the write-behind layer exists to remove. Read
 paths, purges, and schema setup are untouched — only the declared hot
 write methods are scanned.
 
-The four store modules are pinned in ``STORE_MODULES``: a store that
+The store modules are pinned in ``STORE_MODULES``: a store that
 drops its ``HOT_WRITE_METHODS`` declaration (or a new store added to the
 list without one) fails the lint, so "all stores write through the
 shared layer" stays true by construction. Runs in CI via
@@ -42,6 +42,7 @@ STORE_MODULES = (
     "gpud_tpu/health_history.py",
     "gpud_tpu/metrics/store.py",
     "gpud_tpu/remediation/audit.py",
+    "gpud_tpu/session/outbox.py",
 )
 
 _EXEC_ATTRS = ("execute", "executemany")

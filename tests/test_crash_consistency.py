@@ -365,3 +365,131 @@ def test_sigkill_mid_group_commit_batches_are_atomic(tmp_path):
     # loss is bounded to the in-flight flush window: at most one
     # unACKed batch can have committed
     assert len(counts) <= acked + 2, counts
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL mid outbox replay: the acked watermark never regresses and no
+# frame is delivered zero times.
+
+_REPLAY_CHILD = r"""
+import sys
+import time
+
+from gpud_tpu.session.outbox import SessionOutbox
+from gpud_tpu.sqlite import DB
+from gpud_tpu.storage.writer import BatchWriter
+
+state = sys.argv[1]
+db = DB(state)
+writer = BatchWriter(db, flush_interval_seconds=0.05, fsync=True)
+outbox = SessionOutbox(db, writer=writer, replay_batch=20)
+
+TOTAL = 600
+for i in range(TOTAL):
+    outbox.publish("event", {"i": i}, dedupe_key=f"crash:{i}")
+
+
+class Loopback:
+    connected = True
+    auth_failed = False
+
+    def send(self, frame):
+        print("DEL", frame.data["outbox_seq"], flush=True)
+        return True
+
+
+sess = Loopback()
+while outbox.backlog() > 0:
+    sent = outbox.replay_once(sess)
+    if not sent:
+        break
+    # the "manager" acks the batch it just saw; the flush barrier makes
+    # the watermark durable BEFORE the ACK line is printed, so every
+    # printed ACK is a floor the restart watermark may never sink below
+    outbox.ack(outbox.acked_seq + sent)
+    writer.flush(timeout=30)
+    print("ACK", outbox.acked_seq, flush=True)
+    time.sleep(0.05)
+print("DONE", flush=True)
+"""
+
+
+def test_sigkill_mid_outbox_replay_watermark_and_delivery(tmp_path):
+    """Kill the daemon between outbox replay batches. On restart the
+    acked watermark must never regress below the last durable ack (or
+    frames already consumed by the manager replay again forever), and
+    must never pass a frame that was not handed to the transport (or
+    that frame is delivered zero times — silent loss)."""
+    from gpud_tpu.session.outbox import SessionOutbox
+    from gpud_tpu.sqlite import DB
+
+    state = str(tmp_path / "outbox.state")
+    child = subprocess.Popen(
+        [sys.executable, "-c", _REPLAY_CHILD, state],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    delivered = set()
+    acks = []
+    try:
+        deadline = time.time() + 60
+        while len(acks) < 4 and time.time() < deadline:
+            line = child.stdout.readline()
+            assert line, "replay child died before 4 batches ACKed"
+            if line.startswith("DEL "):
+                delivered.add(int(line.split()[1]))
+            elif line.startswith("ACK "):
+                acks.append(int(line.split()[1]))
+        assert len(acks) >= 4, "never reached 4 ACKed replay batches"
+    finally:
+        # kill between batches: frames past the last ACK may already be
+        # DEL-printed (delivered, unacked) — exactly the at-least-once
+        # redelivery window
+        os.kill(child.pid, signal.SIGKILL)
+        child.wait(timeout=10)
+
+    _integrity_ok(state)
+
+    db = DB(state)
+    try:
+        outbox = SessionOutbox(db)
+        watermark = outbox.acked_seq
+        # never regresses: every printed ACK was flushed+fsynced first
+        assert watermark >= acks[-1], (
+            f"watermark {watermark} regressed below durable ack {acks[-1]}"
+        )
+        # never acks the undelivered: the child only acked frames its
+        # transport already accepted
+        assert watermark <= max(delivered), (
+            f"watermark {watermark} passed frames never handed to the "
+            f"transport (max delivered {max(delivered)})"
+        )
+        total = outbox.last_seq
+        assert total == 600, f"journal lost publishes: last_seq={total}"
+
+        class Drain:
+            connected = True
+            auth_failed = False
+
+            def __init__(self):
+                self.seqs = set()
+
+            def send(self, frame):
+                self.seqs.add(frame.data["outbox_seq"])
+                return True
+
+        sess = Drain()
+        while outbox.backlog() > 0:
+            sent = outbox.replay_once(sess)
+            if not sent:
+                break
+            outbox.ack(max(sess.seqs))
+        # replay resumes exactly above the watermark...
+        assert sess.seqs == set(range(watermark + 1, total + 1))
+        # ...so pre-kill deliveries + post-restart replay cover every
+        # journaled frame: nothing is delivered zero times
+        assert delivered | sess.seqs == set(range(1, total + 1))
+    finally:
+        db.close()

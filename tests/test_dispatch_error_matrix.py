@@ -104,6 +104,13 @@ MATRIX = [
     ("remediationPolicy", {"policy": "not-a-dict"}, "no-crash"),
     ("remediationPolicy", {"policy": {"enforce_actions": ["bogus"]}}, "no-crash"),
     ("remediationPolicy", {"policy": {"cooldown_seconds": "forever"}}, "no-crash"),
+    # outbox: ack requires a non-negative integer seq (a stale/duplicate
+    # ack is valid — monotonic watermark — and must not error)
+    ("outboxAck", {}, "error"),
+    ("outboxAck", {"seq": "garbage"}, "error"),
+    ("outboxAck", {"seq": -1}, "error"),
+    ("outboxAck", {"seq": 0}, "ok"),
+    ("outboxStatus", {}, "ok"),
     # chaos: missing/unknown/garbage scenarios are clean errors; status
     # tolerates no filter but rejects a non-numeric limit
     ("chaosRun", {}, "error"),

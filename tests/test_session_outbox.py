@@ -1,0 +1,415 @@
+"""Session outbox: durable store-and-forward delivery + circuit breaker.
+
+The outbox is the delivery contract the in-memory session channels never
+had: records journal to SQLite at publish time, replay drains above the
+manager-acked watermark, the watermark only ever advances, and retention
+bounds the journal with explicit loss accounting. The circuit breaker
+gates the connect path so a hard-down manager stops costing attempts.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gpud_tpu.session.outbox import (
+    CIRCUIT_CLOSED,
+    CIRCUIT_HALF_OPEN,
+    CIRCUIT_OPEN,
+    TABLE,
+    CircuitBreaker,
+    SessionOutbox,
+)
+from gpud_tpu.session.session import Frame, Session, is_auth_error
+from gpud_tpu.sqlite import DB
+
+
+class FakeSession:
+    """Transport stand-in for replay: connected unless told otherwise."""
+
+    def __init__(self, connected=True, auth_failed=False, accept=None):
+        self.connected = connected
+        self.auth_failed = auth_failed
+        self.frames = []
+        self.accept = accept  # None = accept all, else max sends
+
+    def send(self, frame) -> bool:
+        if self.accept is not None and len(self.frames) >= self.accept:
+            return False
+        self.frames.append(frame)
+        return True
+
+
+def _wait(cond, timeout=3.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+# -- journal / ack / replay -------------------------------------------------
+
+def test_publish_assigns_monotonic_seqs_and_journals():
+    db = DB(":memory:")
+    ob = SessionOutbox(db)
+    assert ob.publish("event", {"a": 1}, dedupe_key="k1") == 1
+    assert ob.publish("gossip", {"b": 2}) == 2
+    rows = ob.pending()
+    assert [(r[0], r[2], r[3]) for r in rows] == [
+        (1, "event", "k1"),
+        (2, "gossip", "gossip:2"),  # empty key derives kind:seq
+    ]
+    assert ob.backlog() == 2
+    db.close()
+
+
+def test_ack_is_monotonic_and_trims_pending():
+    db = DB(":memory:")
+    ob = SessionOutbox(db)
+    for i in range(5):
+        ob.publish("event", {"i": i})
+    assert ob.ack(3) == 3
+    assert ob.ack(1) == 3, "stale ack regressed the watermark"
+    assert ob.ack(-7) == 3
+    assert [r[0] for r in ob.pending()] == [4, 5]
+    assert ob.backlog() == 2
+    db.close()
+
+
+def test_replay_delivers_pending_in_order_with_dedupe_keys():
+    db = DB(":memory:")
+    ob = SessionOutbox(db, replay_batch=2)
+    for i in range(3):
+        ob.publish("event", {"i": i}, dedupe_key=f"k{i}")
+    sess = FakeSession()
+    assert ob.replay_once(sess) == 2  # bounded by replay_batch
+    assert [f.req_id for f in sess.frames] == ["outbox-1", "outbox-2"]
+    assert sess.frames[0].data["dedupe_key"] == "k0"
+    assert sess.frames[0].data["payload"] == {"i": 0}
+    # nothing acked yet: replay re-sends the same frames (at-least-once)
+    sess2 = FakeSession()
+    ob.replay_once(sess2)
+    assert [f.data["outbox_seq"] for f in sess2.frames] == [1, 2]
+    ob.ack(2)
+    sess3 = FakeSession()
+    ob.replay_once(sess3)
+    assert [f.data["outbox_seq"] for f in sess3.frames] == [3]
+    db.close()
+
+
+def test_replay_noop_when_disconnected_or_auth_parked():
+    db = DB(":memory:")
+    ob = SessionOutbox(db)
+    ob.publish("event", {})
+    assert ob.replay_once(None) == 0
+    assert ob.replay_once(FakeSession(connected=False)) == 0
+    assert ob.replay_once(FakeSession(auth_failed=True)) == 0
+    db.close()
+
+
+def test_replay_stops_on_transport_backpressure():
+    db = DB(":memory:")
+    ob = SessionOutbox(db)
+    for i in range(4):
+        ob.publish("event", {"i": i})
+    sess = FakeSession(accept=2)
+    assert ob.replay_once(sess) == 2
+    # the refused frame was NOT skipped: next replay resumes from the
+    # same watermark and re-sends everything still unacked
+    sess.accept = None
+    assert ob.replay_once(sess) == 4
+    db.close()
+
+
+def test_watermark_and_seq_survive_restart(tmp_path):
+    state = str(tmp_path / "outbox.state")
+    db = DB(state)
+    ob = SessionOutbox(db)
+    for i in range(6):
+        ob.publish("event", {"i": i})
+    ob.ack(4)
+    db.close()
+
+    db2 = DB(state)
+    ob2 = SessionOutbox(db2)
+    assert ob2.acked_seq == 4, "acked watermark lost across restart"
+    assert ob2.last_seq == 6
+    # new publishes resume ABOVE the journaled range — never reuse a seq
+    assert ob2.publish("event", {"i": 6}) == 7
+    assert [r[0] for r in ob2.pending()] == [5, 6, 7]
+    db2.close()
+
+
+def test_retention_purges_acked_and_accounts_unacked_drops():
+    db = DB(":memory:")
+    now = [1000.0]
+    ob = SessionOutbox(
+        db, max_rows=1000, max_age_seconds=100.0, time_now_fn=lambda: now[0]
+    )
+    for i in range(4):
+        ob.publish("event", {"i": i})
+    ob.ack(2)
+    now[0] += 200.0  # everything aged out; only acked rows may age-purge
+    purged = ob.purge_once()
+    assert purged == 2
+    assert [r[0] for r in ob.pending()] == [3, 4]
+
+    # size cap: oldest rows drop regardless of ack state, loss accounted,
+    # and the watermark jumps the hole so replay can't spin on it
+    ob2 = SessionOutbox(
+        db, max_rows=1, max_age_seconds=1e9, time_now_fn=lambda: now[0]
+    )
+    ob2.purge_once()
+    assert ob2.stats()["dropped_retention"] == 1
+    assert ob2.acked_seq == 3
+    assert [r[0] for r in ob2.pending()] == [4]
+    db.close()
+
+
+def test_outbox_writes_ride_the_batch_writer(tmp_path):
+    from gpud_tpu.storage.writer import BatchWriter
+
+    db = DB(str(tmp_path / "wb.state"))
+    writer = BatchWriter(db)
+    ob = SessionOutbox(db, writer=writer)
+    ob.publish("event", {"x": 1}, dedupe_key="wb")
+    # unflushed: the row sits in the write-behind buffer, and pending()'s
+    # flush barrier makes it visible without an explicit writer.flush()
+    assert [r[3] for r in ob.pending()] == ["wb"]
+    ob.ack(1)
+    assert ob.pending() == []
+    row = db.query_one(f"SELECT COUNT(*) FROM {TABLE}")
+    assert row[0] == 1
+    writer.close()
+    db.close()
+
+
+# -- circuit breaker --------------------------------------------------------
+
+def test_circuit_opens_after_threshold_and_half_open_probe_closes():
+    now = [0.0]
+    cb = CircuitBreaker(failure_threshold=3, open_seconds=10.0,
+                        time_fn=lambda: now[0])
+    assert cb.state == CIRCUIT_CLOSED
+    for _ in range(2):
+        cb.record_failure()
+    assert cb.state == CIRCUIT_CLOSED
+    cb.record_failure()
+    assert cb.state == CIRCUIT_OPEN
+    # cooling down: attempts denied and counted
+    assert not cb.allow()
+    assert not cb.allow()
+    assert cb.blocked_count == 2
+    assert cb.seconds_until_probe() == pytest.approx(10.0)
+    # cooldown elapsed: exactly one probe allowed, state half-open
+    now[0] = 10.0
+    assert cb.allow()
+    assert cb.state == CIRCUIT_HALF_OPEN
+    cb.record_success()
+    assert cb.state == CIRCUIT_CLOSED
+    assert cb.states_seen() == [
+        CIRCUIT_CLOSED, CIRCUIT_OPEN, CIRCUIT_HALF_OPEN, CIRCUIT_CLOSED,
+    ]
+
+
+def test_circuit_failed_probe_reopens_with_fresh_cooldown():
+    now = [0.0]
+    cb = CircuitBreaker(failure_threshold=1, open_seconds=5.0,
+                        time_fn=lambda: now[0])
+    cb.record_failure()
+    assert cb.state == CIRCUIT_OPEN
+    now[0] = 5.0
+    assert cb.allow()
+    assert cb.state == CIRCUIT_HALF_OPEN
+    cb.record_failure()
+    assert cb.state == CIRCUIT_OPEN
+    assert not cb.allow(), "reopen did not restart the cooldown"
+    now[0] = 10.0
+    assert cb.allow()
+
+
+def test_session_circuit_suppresses_connect_attempts():
+    """An open circuit stops the keep-alive loop from touching the
+    network at all — the transport's connect counter stays flat."""
+
+    class RefusingTransport:
+        def __init__(self):
+            self.connects = 0
+
+        def start_reader(self, session):
+            self.connects += 1
+            raise ConnectionError("refused")
+
+    tr = RefusingTransport()
+    s = Session(
+        endpoint="https://cp.example", machine_id="m1", token="t",
+        dispatch_fn=lambda req: {},
+        start_reader_fn=tr.start_reader,
+        start_writer_fn=lambda session: None,
+        jitter_fn=lambda b: 0.01,
+    )
+    s.circuit = CircuitBreaker(failure_threshold=2, open_seconds=60.0)
+    s.time_sleep_fn = lambda secs: s._stop.wait(min(secs, 0.02))
+    s.start()
+    assert _wait(lambda: s.circuit.state == CIRCUIT_OPEN)
+    at_open = tr.connects
+    assert at_open == 2
+    time.sleep(0.3)
+    assert tr.connects == at_open, "connect attempts leaked while open"
+    assert s.circuit.blocked_count > 0
+    s.stop()
+
+
+def test_auth_failures_do_not_trip_the_circuit():
+    """Auth rejections park the session (token-rotation path); counting
+    them toward the breaker would double-suppress recovery."""
+
+    class AuthRejectTransport:
+        def __init__(self):
+            self.connects = 0
+
+        def start_reader(self, session):
+            self.connects += 1
+            e = ConnectionError("401 unauthorized")
+            e.auth_error = True
+            raise e
+
+    tr = AuthRejectTransport()
+    s = Session(
+        endpoint="https://cp.example", machine_id="m1", token="t",
+        dispatch_fn=lambda req: {},
+        start_reader_fn=tr.start_reader,
+        start_writer_fn=lambda session: None,
+        jitter_fn=lambda b: 0.01,
+    )
+    s.circuit = CircuitBreaker(failure_threshold=1, open_seconds=60.0)
+    s.time_sleep_fn = lambda secs: s._stop.wait(min(secs, 0.02))
+    s.start()
+    assert _wait(lambda: s.auth_failed)
+    assert s.circuit.state == CIRCUIT_CLOSED
+    s.stop()
+
+
+# -- frame-drop accounting --------------------------------------------------
+
+def test_note_frame_dropped_counts_and_rate_limits_the_hook():
+    from gpud_tpu.session.session import _c_frames_dropped
+
+    s = Session(
+        endpoint="https://cp.example", machine_id="m1", token="t",
+        dispatch_fn=lambda req: {},
+        start_reader_fn=lambda session: (lambda: None),
+        start_writer_fn=lambda session: None,
+    )
+    hook_calls = []
+    s.on_frame_dropped = lambda direction, detail: hook_calls.append(direction)
+    before_w = _c_frames_dropped.get(labels={"direction": "write"})
+    before_r = _c_frames_dropped.get(labels={"direction": "read"})
+    for _ in range(5):
+        s.note_frame_dropped("write", "channel full")
+    s.note_frame_dropped("read", "channel full")
+    # every drop counts; the event hook fires once per direction per window
+    assert _c_frames_dropped.get(labels={"direction": "write"}) == before_w + 5
+    assert _c_frames_dropped.get(labels={"direction": "read"}) == before_r + 1
+    assert hook_calls == ["write", "read"]
+
+
+def test_send_overflow_drops_and_notes():
+    s = Session(
+        endpoint="https://cp.example", machine_id="m1", token="t",
+        dispatch_fn=lambda req: {},
+        start_reader_fn=lambda session: (lambda: None),
+        start_writer_fn=lambda session: None,
+    )
+    drops = []
+    s.on_frame_dropped = lambda direction, detail: drops.append(direction)
+    s.send_timeout = 0.01  # injectable: don't pay 5s per full-queue probe
+    # nobody drains s.writer: fill it past CHANNEL_CAP
+    sent = 0
+    for i in range(50):
+        if s.send(Frame(req_id=f"r{i}", data={})):
+            sent += 1
+    assert sent < 50
+    assert drops == ["write"], "overflow did not note a write drop"
+
+
+# -- auth classification (v1/v2 parity) -------------------------------------
+
+def test_is_auth_error_prefers_explicit_attribute():
+    e = RuntimeError("connection reset")
+    e.auth_error = True
+    assert is_auth_error(e)
+    e2 = RuntimeError("401 unauthorized")
+    e2.auth_error = False  # authoritative site said network, not auth
+    assert not is_auth_error(e2)
+
+
+def test_v2_handshake_rejected_carries_auth_flag():
+    from gpud_tpu.session.v2.client import HandshakeRejected
+
+    exc = HandshakeRejected("bad token")
+    exc.auth_error = True
+    assert is_auth_error(exc)
+    exc2 = HandshakeRejected("draining")
+    assert not is_auth_error(exc2)
+
+
+# -- dispatcher ack path ----------------------------------------------------
+
+class _FakeServer:
+    config = None
+
+    def __init__(self, outbox=None):
+        self.outbox = outbox
+
+
+def test_dispatcher_outbox_ack_advances_watermark():
+    from gpud_tpu.session.dispatch import Dispatcher
+
+    db = DB(":memory:")
+    ob = SessionOutbox(db)
+    for i in range(3):
+        ob.publish("event", {"i": i})
+    d = Dispatcher(_FakeServer(outbox=ob))
+    assert d({"method": "outboxAck", "seq": 2}) == {"acked_seq": 2}
+    assert d({"method": "outboxAck", "seq": 1}) == {"acked_seq": 2}
+    assert "error" in d({"method": "outboxAck", "seq": "garbage"})
+    assert "error" in d({"method": "outboxAck", "seq": -1})
+    assert "error" in d({"method": "outboxAck"})
+    assert ob.acked_seq == 2
+    db.close()
+
+
+def test_dispatcher_outbox_ack_without_outbox_errors():
+    from gpud_tpu.session.dispatch import Dispatcher
+
+    d = Dispatcher(_FakeServer(outbox=None))
+    assert "error" in d({"method": "outboxAck", "seq": 1})
+
+
+# -- manager-side ingest ----------------------------------------------------
+
+def test_agent_handle_dedupes_and_acks_outbox_frames():
+    from gpud_tpu.manager.control_plane import AgentHandle
+
+    h = AgentHandle("m1", "v1")
+    frame = {"outbox_seq": 1, "kind": "event", "dedupe_key": "k1",
+             "ts": 1.0, "payload": {}}
+    h.resolve("outbox-1", frame)
+    h.resolve("outbox-1", frame)  # redelivery: recorded once
+    h.resolve("outbox-2", {"outbox_seq": 2, "kind": "event",
+                           "dedupe_key": "k2", "ts": 2.0, "payload": {}})
+    assert [r["dedupe_key"] for r in h.outbox_records] == ["k1", "k2"]
+    assert h.outbox_acked == 2
+    acks = []
+    while not h.outbound.empty():
+        item = h.outbound.get_nowait()
+        if item and item["data"].get("method") == "outboxAck":
+            acks.append(item["data"]["seq"])
+    assert acks == [1, 1, 2]
+    # the agent's responses to our acks are swallowed, not queued as
+    # unsolicited noise
+    h.resolve("op-1-ack", {"acked_seq": 1})
+    assert h.unsolicited == []
