@@ -413,6 +413,9 @@ def bench_chaos(scenario: str) -> int:
         session_circuit_failure_threshold=3,
         session_circuit_open_seconds=6.0,
         outbox_replay_interval_seconds=0.5,
+        # small but non-zero: the reconnect-storm drill asserts a paced
+        # (jittered) replay poke without stretching expectation windows
+        outbox_replay_jitter_seconds=0.5,
     )
     srv = Server(config=cfg)
     srv.start()
@@ -709,16 +712,21 @@ def bench_outbox(frames: int = 100_000) -> int:
     rss1 = _rss_mb()
 
     class _LoopbackSession:
-        """Transport stand-in: always connected, records delivered seqs."""
+        """Transport stand-in: always connected, records delivered seqs.
+        Replay hands over batched ``outbox_batch`` frames (one per
+        replay_once call; docs/session.md wire format)."""
 
         connected = True
         auth_failed = False
 
         def __init__(self) -> None:
             self.seqs = []
+            self.records = 0
 
         def send(self, frame) -> bool:
-            self.seqs.append(frame.data["outbox_seq"])
+            batch = frame.data["outbox_batch"]
+            self.seqs.append(batch["last_seq"])
+            self.records += batch["count"]
             return True
 
     sess = _LoopbackSession()
@@ -729,7 +737,7 @@ def bench_outbox(frames: int = 100_000) -> int:
         if not sent:
             break
         drained += sent
-        outbox.ack(sess.seqs[-1])  # manager acks the batch watermark
+        outbox.ack(sess.seqs[-1])  # one cumulative ack per batch frame
     drain_elapsed = time.monotonic() - t1
     stats = outbox.stats()
 
@@ -775,6 +783,174 @@ def bench_outbox(frames: int = 100_000) -> int:
     return 0 if ok else 1
 
 
+WIRE_TARGET_FRAMES_PER_SEC = 100_000
+WIRE_MIN_COMPRESSION_RATIO = 3.0
+
+
+def bench_wire(records: int = 120_000) -> int:
+    """``--wire`` mode: drain a journaled backlog through the full batched
+    wire path — delta encode, batch frame, rev-3 codec framing (zlib),
+    proto serialize/parse, decode, and real manager-side batch ingest with
+    cumulative-watermark acks. Measures end-to-end records/sec and wire
+    bytes/frame against the pre-batching baseline (one bare-JSON frame
+    per record); exit gates on the 100k records/sec target, zero loss,
+    and a >= 3x bytes-on-the-wire reduction."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import queue
+    import shutil
+
+    from gpud_tpu.manager.control_plane import AgentHandle
+    from gpud_tpu.scheduler import Scheduler
+    from gpud_tpu.session import wire
+    from gpud_tpu.session.outbox import SessionOutbox
+    from gpud_tpu.session.v2 import session_pb2 as pb
+    from gpud_tpu.session.v2 import typed
+    from gpud_tpu.sqlite import DB
+    from gpud_tpu.storage import BatchWriter
+
+    tmp = tempfile.mkdtemp(prefix="tpud-wire-")
+    db = DB(os.path.join(tmp, "state.db"))
+    writer = BatchWriter(
+        db,
+        flush_interval_seconds=0.2,
+        max_pending=400_000,
+        flush_threshold=5_000,
+    )
+    scheduler = Scheduler(workers=2)
+    writer.start(scheduler)
+    scheduler.start()
+    outbox = SessionOutbox(
+        db, writer=writer, max_rows=records * 2, replay_batch=4_000
+    )
+
+    # fleet-shaped payloads: a handful of components emitting the same
+    # event with a couple of mutating fields — exactly the stream shape
+    # the per-stream delta codec targets
+    components = [f"tpu-chip-{i}" for i in range(8)]
+    baseline_bytes = 0
+    for i in range(records):
+        payload = {
+            "component": components[i % len(components)],
+            "name": "hbm_utilization",
+            "state": "healthy",
+            "labels": {"pod": "bench", "slice": "0"},
+            "value": 50.0 + (i % 17),
+            "i": i,
+        }
+        seq = outbox.publish("event", payload, dedupe_key=f"wire:{i}")
+        # pre-batching wire cost: one bare-JSON frame per record (what a
+        # rev-2 session puts on the stream for this same backlog)
+        baseline_bytes += len(json.dumps(
+            {"req_id": f"outbox-{seq}",
+             "data": {"outbox_seq": seq, "ts": time.time(), "kind": "event",
+                      "dedupe_key": f"wire:{i}", "payload": payload}},
+            separators=(",", ":"),
+        ).encode("utf-8"))
+    if not writer.flush(timeout=60.0):
+        print("[wire] WARNING: journal flush barrier timed out",
+              file=sys.stderr)
+
+    handle = AgentHandle("bench-wire", "v2-rev3")
+
+    class _WireSession:
+        """Loopback through the real wire path: every replay frame is
+        codec-framed (rev-3), proto round-tripped, decoded, and fed to
+        the manager-side batch ingest — byte counts are what a real v2
+        stream would carry."""
+
+        connected = True
+        auth_failed = False
+
+        def __init__(self) -> None:
+            self.frames = 0
+            self.records = 0
+            self.wire_bytes = 0
+
+        def send(self, frame) -> bool:
+            self.frames += 1
+            self.records += frame.data["outbox_batch"]["count"]
+            pkt = typed.make_result(frame.req_id, frame.data, compress=True)
+            raw = pkt.SerializeToString()
+            self.wire_bytes += len(raw)
+            rt = pb.AgentPacket.FromString(raw)
+            payload = wire.decode_payload(rt.result.payload_json)
+            handle.resolve(rt.result.request_id, payload)
+            return True
+
+    sess = _WireSession()
+    t0 = time.monotonic()
+    drained = 0
+    while True:
+        sent = outbox.replay_once(sess)
+        if not sent:
+            break
+        drained += sent
+        # pump the manager's cumulative-watermark acks back, as the
+        # agent's read stream would
+        while True:
+            try:
+                item = handle.outbound.get_nowait()
+            except queue.Empty:
+                break
+            if item and item["data"].get("method") == "outboxAck":
+                outbox.ack(int(item["data"]["seq"]))
+    elapsed = time.monotonic() - t0
+    stats = outbox.stats()
+    acked = stats["acked_seq"]
+
+    writer.close()
+    scheduler.close()
+    db.close()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    rate = drained / elapsed if elapsed else 0.0
+    ratio = baseline_bytes / sess.wire_bytes if sess.wire_bytes else 0.0
+    wire_per_rec = sess.wire_bytes / drained if drained else 0.0
+    base_per_rec = baseline_bytes / records if records else 0.0
+    zero_loss = (
+        drained == records
+        and stats["backlog"] == 0
+        and acked == records
+        and handle.outbox_acked == records
+    )
+    cstats = wire.codec_stats()
+    print(
+        f"[wire] drain: {rate:,.0f} records/sec "
+        f"({drained:,} records in {sess.frames} batch frames, "
+        f"{elapsed:.2f}s, acked_seq={acked}) "
+        f"[target >= {WIRE_TARGET_FRAMES_PER_SEC:,}]",
+        file=sys.stderr,
+    )
+    print(
+        f"[wire] bytes/record: {wire_per_rec:.1f} wire vs "
+        f"{base_per_rec:.1f} per-record JSON baseline "
+        f"({ratio:.1f}x reduction [gate >= "
+        f"{WIRE_MIN_COMPRESSION_RATIO:g}x]; codec zlib ratio "
+        f"{cstats['compression_ratio']:.2f} over "
+        f"{cstats['raw_egress_bytes']:,} raw bytes)",
+        file=sys.stderr,
+    )
+    ok = (
+        rate >= WIRE_TARGET_FRAMES_PER_SEC
+        and zero_loss
+        and ratio >= WIRE_MIN_COMPRESSION_RATIO
+    )
+    if not zero_loss:
+        print(
+            f"[wire] LOSS: drained={drained} backlog={stats['backlog']} "
+            f"acked={acked} manager_acked={handle.outbox_acked}",
+            file=sys.stderr,
+        )
+    print(json.dumps({
+        "metric": "session wire drain throughput",
+        "value": round(rate, 1),
+        "unit": "records/sec",
+        "vs_baseline": round(ratio, 2),
+    }))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -804,6 +980,16 @@ def main(argv=None) -> int:
         "--outbox-frames", type=int, default=100_000,
         help="frames to journal/drain for --outbox (default 100000)",
     )
+    ap.add_argument(
+        "--wire", action="store_true",
+        help="run the batched session wire-path bench (delta codec + "
+             "rev-3 framing + manager ingest) instead of the standard "
+             "bench",
+    )
+    ap.add_argument(
+        "--wire-records", type=int, default=120_000,
+        help="records to journal/drain for --wire (default 120000)",
+    )
     args = ap.parse_args(argv)
     if args.chaos:
         return bench_chaos(args.chaos)
@@ -811,6 +997,8 @@ def main(argv=None) -> int:
         return bench_ingest(duration=args.ingest_seconds)
     if args.outbox:
         return bench_outbox(frames=args.outbox_frames)
+    if args.wire:
+        return bench_wire(records=args.wire_records)
     res = bench_fault_detection()
     # the secondary benches are stderr-only color; none may take down the
     # primary JSON line. The footprint bench additionally gates on the

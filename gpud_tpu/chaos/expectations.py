@@ -268,6 +268,14 @@ def _eval_outbox(server, spec: Dict, ctx) -> List[ExpectationResult]:
       connects_flat_while_open: while the circuit reads open, the plane's
                        connect/refusal counters must not move — the
                        breaker provably suppresses attempts
+      replay_paced:    after a circuit recovery, the server applied a
+                       non-zero replay jitter before poking the outbox
+                       drain (server.last_replay_jitter_seconds > 0) —
+                       the reconnect-storm stagger provably engaged
+      max_total_connects: ceiling on total connect attempts that reached
+                       the plane (accepted + refused) across the whole
+                       campaign — an unpaced reconnect/replay storm
+                       blows through it
     """
     out: List[ExpectationResult] = []
     outbox = getattr(server, "outbox", None)
@@ -410,6 +418,52 @@ def _eval_outbox(server, spec: Dict, ctx) -> List[ExpectationResult]:
                         else f"{moved} connect attempt(s) leaked while circuit open"
                     ),
                 ))
+    if spec.get("replay_paced", False):
+        deadline = ctx.time_fn() + within
+
+        def paced():
+            j = getattr(server, "last_replay_jitter_seconds", None)
+            return (j,) if j is not None and j > 0 else None
+
+        got = _poll(paced, deadline, ctx)
+        if got is None:
+            j = getattr(server, "last_replay_jitter_seconds", None)
+            out.append(ExpectationResult(
+                "outbox", False, timed_out=True,
+                detail=(
+                    f"replay_paced: no post-recovery jitter within "
+                    f"{within:g}s (last jitter: {j})"
+                ),
+            ))
+        else:
+            out.append(ExpectationResult(
+                "outbox", True,
+                detail=f"replay paced: {got[0] * 1000.0:.0f}ms jitter "
+                       "applied after circuit recovery",
+            ))
+
+    max_connects = spec.get("max_total_connects")
+    if max_connects is not None:
+        if ctx.plane is None:
+            out.append(ExpectationResult(
+                "outbox", False,
+                detail="max_total_connects needs a fake control plane",
+            ))
+        else:
+            total = (
+                int(getattr(ctx.plane, "connects", 0))
+                + int(getattr(ctx.plane, "refused", 0))
+                - int(ctx.baseline.get("plane_attempts", 0.0))
+            )
+            ok = total <= int(max_connects)
+            out.append(ExpectationResult(
+                "outbox", ok,
+                detail=(
+                    f"{total} connect attempt(s) reached the plane this "
+                    f"campaign (ceiling {int(max_connects)})"
+                ),
+            ))
+
     if not out:
         out.append(ExpectationResult(
             "outbox", True, detail="no outbox assertion",

@@ -56,6 +56,10 @@ class FakeControlPlane:
         self.outbox_keys: set = set()
         self.outbox_acked: Dict[str, int] = {}  # machine_id → highest seq
         self._ack_seq = 0
+        # per-machine delta decoders for batched delivery frames
+        # (session/wire.py); reset on reconnect like the real manager's
+        # per-connection AgentHandle decoder
+        self._outbox_decoders: Dict[str, object] = {}
 
     # -- server ------------------------------------------------------------
     async def _login(self, req: web.Request) -> web.Response:
@@ -93,6 +97,9 @@ class FakeControlPlane:
             await resp.prepare(req)
             q: asyncio.Queue = asyncio.Queue()
             self.sessions[machine] = q
+            # fresh connection = fresh delta streams (the agent resets
+            # its encoder on reconnect; mirror the real manager handle)
+            self._outbox_decoders.pop(machine, None)
             self.connects += 1
             self.connected.set()
             try:
@@ -121,25 +128,55 @@ class FakeControlPlane:
                     continue
                 self.responses.append(d)
                 data = d.get("data") if isinstance(d, dict) else None
-                if isinstance(data, dict) and "outbox_seq" in data:
+                if isinstance(data, dict) and (
+                    "outbox_seq" in data or "outbox_batch" in data
+                ):
                     self._ingest_outbox(machine, data)
             return web.json_response({"ok": True})
         return web.json_response({"error": "bad session type"}, status=400)
 
     def _ingest_outbox(self, machine: str, data: dict) -> None:
-        """Record one store-and-forward frame and auto-ack its sequence on
-        the machine's read stream (dedupe is by key — at-least-once means
-        redeliveries are normal and must not double-record)."""
-        try:
-            seq = int(data.get("outbox_seq", 0))
-        except (TypeError, ValueError):
-            return
-        key = str(data.get("dedupe_key") or "")
-        if key not in self.outbox_keys:
-            self.outbox_keys.add(key)
-            self.outbox_frames.append(data)
-        if seq > self.outbox_acked.get(machine, 0):
-            self.outbox_acked[machine] = seq
+        """Record one store-and-forward delivery frame — a batched
+        delta-encoded ``outbox_batch`` (docs/session.md wire format) or a
+        legacy per-record payload — and auto-ack ONE cumulative watermark
+        on the machine's read stream (dedupe is by key — at-least-once
+        means redeliveries are normal and must not double-record)."""
+        from gpud_tpu.session import wire
+
+        batch = wire.parse_batch(data)
+        if batch is not None:
+            decoder = self._outbox_decoders.get(machine)
+            if decoder is None:
+                decoder = self._outbox_decoders[machine] = wire.DeltaDecoder()
+            records = []
+            for rec in batch.get("records") or []:
+                try:
+                    seq, ts, kind, key, body = decoder.decode_record(rec)
+                except (wire.DeltaDecodeError, TypeError, ValueError):
+                    break  # ack the decoded prefix only
+                records.append({
+                    "outbox_seq": seq,
+                    "ts": ts,
+                    "kind": kind,
+                    "dedupe_key": key,
+                    "payload": body,
+                })
+            if not records:
+                return
+            ack_to = records[-1]["outbox_seq"]
+        else:
+            try:
+                ack_to = int(data.get("outbox_seq", 0))
+            except (TypeError, ValueError):
+                return
+            records = [data]
+        for rec in records:
+            key = str(rec.get("dedupe_key") or "")
+            if key not in self.outbox_keys:
+                self.outbox_keys.add(key)
+                self.outbox_frames.append(rec)
+        if ack_to > self.outbox_acked.get(machine, 0):
+            self.outbox_acked[machine] = ack_to
         q = self.sessions.get(machine)
         if q is not None:
             self._ack_seq += 1

@@ -120,6 +120,14 @@ class CampaignRunner:
             "failures": counter_total(reg, "tpud_scheduler_job_failures_total"),
             "watchdog": counter_total(reg, "tpud_scheduler_watchdog_fires_total"),
         }
+        if self.plane is not None:
+            # connect-attempt baseline: max_total_connects ceilings are
+            # per-campaign deltas, not absolutes — a `--chaos all` run
+            # accumulates plane counters across scenarios
+            ctx.baseline["plane_attempts"] = (
+                float(getattr(self.plane, "connects", 0))
+                + float(getattr(self.plane, "refused", 0))
+            )
         started = self.time_fn()
         ctx.campaign_start = started
         audit_log("chaos_campaign", scenario=sc.name)

@@ -56,6 +56,12 @@ DEFAULT_OUTBOX_REPLAY_INTERVAL = 1.0         # replay job cadence (s)
 # control-plane circuit breaker (docs/session.md)
 DEFAULT_SESSION_CIRCUIT_THRESHOLD = 5        # consecutive failures before open
 DEFAULT_SESSION_CIRCUIT_OPEN_SECONDS = 30.0  # open-state cooldown before probe
+# session wire path (docs/session.md wire format): batched delta-encoded
+# delivery frames with cumulative acks, rev-3 payload compression
+DEFAULT_WIRE_KEYFRAME_INTERVAL = 64          # full payload every K records/stream
+DEFAULT_WIRE_COMPRESS_MIN_BYTES = 512        # zlib floor for rev-3 payloads
+DEFAULT_OUTBOX_REDELIVER_SECONDS = 30.0      # ack-stall window before redelivery
+DEFAULT_OUTBOX_REPLAY_JITTER = 2.0           # post-recovery replay stagger cap (s)
 
 STATE_FILE = "tpud.state"                # reference: default.go:137-157 (gpud.state)
 FIFO_FILE = "tpud.fifo"
@@ -137,6 +143,14 @@ class Config:
     outbox_max_age_seconds: int = DEFAULT_OUTBOX_MAX_AGE
     outbox_replay_batch: int = DEFAULT_OUTBOX_REPLAY_BATCH
     outbox_replay_interval_seconds: float = DEFAULT_OUTBOX_REPLAY_INTERVAL
+    # session wire path (docs/session.md wire format): per-stream delta
+    # keyframe cadence, rev-3 compression floor, ack-stall redelivery
+    # window, and the post-recovery replay jitter cap that staggers a
+    # reconnecting fleet's replay storm
+    session_wire_keyframe_interval: int = DEFAULT_WIRE_KEYFRAME_INTERVAL
+    session_wire_compress_min_bytes: int = DEFAULT_WIRE_COMPRESS_MIN_BYTES
+    outbox_redeliver_seconds: float = DEFAULT_OUTBOX_REDELIVER_SECONDS
+    outbox_replay_jitter_seconds: float = DEFAULT_OUTBOX_REPLAY_JITTER
     # control-plane circuit breaker: closed → open after N consecutive
     # connect failures → half-open probe after the cooldown
     session_circuit_failure_threshold: int = DEFAULT_SESSION_CIRCUIT_THRESHOLD
@@ -254,6 +268,14 @@ class Config:
             return "session circuit failure threshold must be >= 1"
         if self.session_circuit_open_seconds <= 0:
             return "session circuit open seconds must be > 0s"
+        if self.session_wire_keyframe_interval < 1:
+            return "session wire keyframe interval must be >= 1"
+        if self.session_wire_compress_min_bytes < 0:
+            return "session wire compress min bytes must be >= 0"
+        if self.outbox_redeliver_seconds <= 0:
+            return "outbox redeliver window must be > 0s"
+        if self.outbox_replay_jitter_seconds < 0:
+            return "outbox replay jitter must be >= 0s"
         if self.scheduler_workers < 1:
             return "scheduler workers must be >= 1"
         if self.scheduler_watchdog_seconds < 0:

@@ -42,7 +42,10 @@ logger = get_logger(__name__)
 
 DEFAULT_REQUEST_TIMEOUT = 30.0
 MAX_REQUEST_TIMEOUT = 600.0
-MAX_REVISION = 2
+# rev 2: typed requests; rev 3: wire-codec framed payload bytes
+# (session/wire.py) — negotiation clamps to the agent's max, so rev-2
+# agents keep speaking bare JSON bytes
+MAX_REVISION = 3
 
 
 class AgentGone(Exception):
@@ -77,6 +80,12 @@ class AgentHandle:
         self.outbox_records_max = 2048
         self.outbox_acked = 0
         self._ack_req_ids: "OrderedDict[str, bool]" = OrderedDict()
+        # per-connection delta decoder for batched delivery frames: the
+        # agent resets its encoder on reconnect, so a fresh handle always
+        # starts on keyframes (session/wire.py)
+        from gpud_tpu.session.wire import DeltaDecoder
+
+        self._outbox_decoder = DeltaDecoder()
 
     # -- operator side -----------------------------------------------------
     def request(self, data: dict, timeout: float = DEFAULT_REQUEST_TIMEOUT) -> dict:
@@ -104,7 +113,8 @@ class AgentHandle:
     def resolve(self, req_id: str, payload: dict) -> None:
         self.last_seen = time.time()
         if req_id.startswith("outbox-") or (
-            isinstance(payload, dict) and "outbox_seq" in payload
+            isinstance(payload, dict)
+            and ("outbox_seq" in payload or "outbox_batch" in payload)
         ):
             self._ingest_outbox(payload)
             return
@@ -123,29 +133,76 @@ class AgentHandle:
 
     def _ingest_outbox(self, payload: dict) -> None:
         """One replayed outbox frame off the agent's write stream: dedupe
-        by key, record if fresh, and push an ``outboxAck`` request for the
-        new watermark onto the read stream."""
+        by key, record if fresh, and push ONE cumulative ``outboxAck``
+        request for the new watermark onto the read stream.
+
+        Two shapes arrive here: the batched delta-encoded
+        ``{"outbox_batch": {...}}`` frame (docs/session.md wire format)
+        and the legacy per-record ``{"outbox_seq": ...}`` payload older
+        agents still send. A batch that stops decoding mid-way (delta
+        without a keyframe base) acks only the decoded prefix — the
+        agent's ack-stall fallback redelivers the rest keyframe-anchored.
+        """
         if not isinstance(payload, dict):
             return
-        try:
-            seq = int(payload.get("outbox_seq", 0))
-        except (TypeError, ValueError):
-            return
-        key = str(payload.get("dedupe_key") or "")
+        from gpud_tpu.session import wire
+
+        batch = wire.parse_batch(payload)
+        if batch is not None:
+            decoded = []
+            decode = self._outbox_decoder.decode_record
+            for rec in batch.get("records") or []:
+                try:
+                    decoded.append(decode(rec))
+                except (wire.DeltaDecodeError, TypeError, ValueError) as e:
+                    logger.warning(
+                        "%s: outbox batch decode stopped, acking prefix: %s",
+                        self.machine_id, e,
+                    )
+                    break
+            if not decoded:
+                return
+            ack_to = decoded[-1][0]
+        else:
+            try:
+                seq = int(payload.get("outbox_seq", 0))
+            except (TypeError, ValueError):
+                return
+            decoded = [(
+                seq,
+                payload.get("ts") or 0.0,
+                payload.get("kind") or "",
+                str(payload.get("dedupe_key") or ""),
+                payload.get("payload"),
+            )]
+            ack_to = seq
         with self._lock:
-            if key not in self.outbox_keys:
-                self.outbox_keys[key] = None
-                while len(self.outbox_keys) > self.outbox_keys_max:
-                    self.outbox_keys.popitem(last=False)
-                self.outbox_records.append(payload)
-                del self.outbox_records[:-self.outbox_records_max]
-            if seq > self.outbox_acked:
-                self.outbox_acked = seq
+            fresh = []
+            for tup in decoded:
+                key = tup[3]
+                if key not in self.outbox_keys:
+                    self.outbox_keys[key] = None
+                    fresh.append(tup)
+            while len(self.outbox_keys) > self.outbox_keys_max:
+                self.outbox_keys.popitem(last=False)
+            # only the tail of a big frame survives the record-buffer
+            # trim; don't materialize dicts the trim would drop anyway
+            for seq, ts, kind, key, body in fresh[-self.outbox_records_max:]:
+                self.outbox_records.append({
+                    "outbox_seq": seq,
+                    "ts": ts,
+                    "kind": kind,
+                    "dedupe_key": key,
+                    "payload": body,
+                })
+            del self.outbox_records[:-self.outbox_records_max]
+            if ack_to > self.outbox_acked:
+                self.outbox_acked = ack_to
             ack_seq = self.outbox_acked
             self._seq += 1
             ack_req_id = f"op-{self._seq}-ack"
             self._ack_req_ids[ack_req_id] = True
-            # agents ack every frame-batch; keep only recent ids so a
+            # one ack per delivery frame; keep only recent ids so a
             # slow agent's late responses age into `unsolicited` (bounded)
             while len(self._ack_req_ids) > 512:
                 self._ack_req_ids.popitem(last=False)
@@ -645,19 +702,28 @@ class ControlPlane:
         self._register(handle)
         stop = threading.Event()
 
+        def decode_bytes(raw: bytes):
+            # rev >= 3: wire-codec framed (prefix + optional zlib);
+            # below: bare JSON bytes (ValueError either way on garbage)
+            if revision >= 3:
+                from gpud_tpu.session import wire
+
+                return wire.decode_payload(raw)
+            return json.loads(raw.decode())
+
         def drain_responses() -> None:
             try:
                 for pkt in request_iterator:
                     kind = pkt.WhichOneof("payload")
                     if kind == "frame":
                         try:
-                            data = json.loads(pkt.frame.data.decode())
+                            data = decode_bytes(pkt.frame.data)
                         except ValueError:
                             continue
                         handle.resolve(pkt.frame.req_id, data)
                     elif kind == "result":
                         try:
-                            data = json.loads(pkt.result.payload_json.decode())
+                            data = decode_bytes(pkt.result.payload_json)
                         except ValueError:
                             continue
                         handle.resolve(pkt.result.request_id, data)
@@ -706,7 +772,12 @@ class ControlPlane:
                         pass
                 m = pb.ManagerPacket()
                 m.frame.req_id = req_id
-                m.frame.data = json.dumps(data).encode()
+                if revision >= 3:
+                    from gpud_tpu.session import wire
+
+                    m.frame.data = wire.encode_payload(data)
+                else:
+                    m.frame.data = json.dumps(data).encode()
                 yield m
         finally:
             self._unregister(handle)

@@ -460,6 +460,12 @@ def build_app(srv: "Server") -> web.Application:
             outbox = getattr(srv, "outbox", None)
             if outbox is not None:
                 out["outbox"] = outbox.stats()
+            from gpud_tpu.session import wire
+
+            out["wire"] = wire.codec_stats()
+            jitter = getattr(srv, "last_replay_jitter_seconds", None)
+            if jitter is not None:
+                out["last_replay_jitter_seconds"] = round(jitter, 3)
             return out
 
         return _json(await _run_blocking(srv, collect))

@@ -182,10 +182,20 @@ class Server:
         # durable session outbox + control-plane circuit breaker
         # (docs/session.md): producers journal here; a replay job drains
         # everything above the manager-acked watermark into the session
+        # as batched delta-encoded delivery frames (docs/session.md wire
+        # format)
         self.outbox = None
         self._outbox_replay_job = None
+        # jitter applied to the last post-recovery replay poke (None =
+        # never connected; 0.0 = immediate, unjittered poke) — chaos
+        # expectations read this to prove replay pacing engaged
+        self.last_replay_jitter_seconds = None
+        from gpud_tpu.session import wire as session_wire
         from gpud_tpu.session.outbox import CircuitBreaker, SessionOutbox
 
+        session_wire.configure(
+            compress_min_bytes=self.config.session_wire_compress_min_bytes
+        )
         self.session_circuit = CircuitBreaker(
             failure_threshold=self.config.session_circuit_failure_threshold,
             open_seconds=float(self.config.session_circuit_open_seconds),
@@ -197,6 +207,10 @@ class Server:
                 max_rows=self.config.outbox_max_rows,
                 max_age_seconds=float(self.config.outbox_max_age_seconds),
                 replay_batch=self.config.outbox_replay_batch,
+                keyframe_interval=self.config.session_wire_keyframe_interval,
+                redeliver_after_seconds=float(
+                    self.config.outbox_redeliver_seconds
+                ),
             )
             self._wire_outbox_producers()
 
@@ -781,11 +795,36 @@ class Server:
 
             def on_connected() -> None:
                 persist_on_connect()
+                # reconnect: in-flight frames from the old connection may
+                # be lost and the manager's delta decoder is fresh — fall
+                # back to the durable watermark, keyframe-anchored
+                if self.outbox is not None:
+                    self.outbox.reset_delivery()
                 # drain the outbox backlog immediately instead of waiting
                 # out the replay interval — reconnect is exactly when the
-                # store-and-forward journal has work
+                # store-and-forward journal has work. EXCEPT straight
+                # after a circuit-breaker recovery: then every agent in
+                # the fleet is reconnecting at once (the manager was
+                # down), and a synchronized replay burst would DDoS it —
+                # stagger the poke by a random jitter instead
                 job = self._outbox_replay_job
-                if job is not None:
+                if job is None:
+                    return
+                jitter_cap = float(self.config.outbox_replay_jitter_seconds)
+                age = self.session_circuit.recovery_age()
+                recovering = age is not None and age <= max(
+                    5.0, 2.0 * jitter_cap
+                )
+                if recovering and jitter_cap > 0:
+                    import random
+
+                    jitter = random.uniform(0.1 * jitter_cap, jitter_cap)
+                    self.last_replay_jitter_seconds = jitter
+                    t = threading.Timer(jitter, job.poke)
+                    t.daemon = True
+                    t.start()
+                else:
+                    self.last_replay_jitter_seconds = 0.0
                     job.poke()
 
             session.circuit = self.session_circuit

@@ -453,6 +453,10 @@ class Dispatcher:
         circuit = getattr(self.server, "session_circuit", None)
         if circuit is not None:
             out["circuit"] = circuit.stats()
+        # wire codec byte accounting (docs/session.md wire format)
+        from gpud_tpu.session import wire
+
+        out["wire"] = wire.codec_stats()
         return out
 
     def _m_bootstrap(self, req: Dict) -> Dict:

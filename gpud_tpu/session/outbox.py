@@ -33,13 +33,13 @@ attempts. Delivery semantics are documented in docs/session.md.
 
 from __future__ import annotations
 
-import json
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from gpud_tpu.log import get_logger
 from gpud_tpu.metrics.registry import counter, gauge
+from gpud_tpu.session import wire
 
 logger = get_logger(__name__)
 
@@ -48,7 +48,8 @@ ACK_TABLE = "tpud_session_outbox_ack_v0_1"
 
 DEFAULT_MAX_ROWS = 100_000        # journal hard cap (rows)
 DEFAULT_MAX_AGE = 7 * 86400       # journal age cap: one week of partition
-DEFAULT_REPLAY_BATCH = 500        # frames handed to the session per drain
+DEFAULT_REPLAY_BATCH = 500        # records packed into one delivery frame
+DEFAULT_REDELIVER_AFTER = 30.0    # ack-stall window before redelivery
 
 # delivery frames ride the normal agent→manager stream with this req_id
 # prefix; the manager treats them as unsolicited data, not responses
@@ -116,6 +117,8 @@ class SessionOutbox:
         max_rows: int = DEFAULT_MAX_ROWS,
         max_age_seconds: float = DEFAULT_MAX_AGE,
         replay_batch: int = DEFAULT_REPLAY_BATCH,
+        keyframe_interval: int = wire.DEFAULT_KEYFRAME_INTERVAL,
+        redeliver_after_seconds: float = DEFAULT_REDELIVER_AFTER,
         time_now_fn: Callable[[], float] = time.time,
     ) -> None:
         self.db = db
@@ -123,8 +126,13 @@ class SessionOutbox:
         self.max_rows = int(max_rows)
         self.max_age_seconds = float(max_age_seconds)
         self.replay_batch = max(1, int(replay_batch))
+        self.redeliver_after_seconds = float(redeliver_after_seconds)
         self.time_now_fn = time_now_fn
         self._mu = threading.Lock()
+        # per-stream delta encoder for delivery batches (docs/session.md
+        # wire format); guarded by _mu — replay runs on a scheduler
+        # worker, reset_delivery on the session keep-alive thread
+        self._encoder = wire.DeltaEncoder(keyframe_interval)
         db.execute(
             f"""CREATE TABLE IF NOT EXISTS {TABLE} (
                 seq INTEGER PRIMARY KEY,
@@ -157,6 +165,22 @@ class SessionOutbox:
         self._replayed = 0
         self._write_drops = 0
         self._retention_drops = 0
+        # delivered-high-water: the highest seq already handed to the
+        # live transport this connection. Purely in-memory — replay reads
+        # above max(acked, delivered) so a slow ack doesn't cause a
+        # redundant SELECT + re-encode every tick (dedupe keys make
+        # redelivery safe; re-reading was pure wasted work). Falls back
+        # to the durable watermark on reconnect (reset_delivery) or when
+        # acks stall past redeliver_after_seconds.
+        self._delivered = self._acked
+        self._ack_progress_ts = self.time_now_fn()
+        # journal-flush high-water: the highest seq known durable behind
+        # the write-behind buffer. pending() only needs a flush barrier
+        # when rows it could return are still buffered; skipping the
+        # barrier otherwise keeps steady-state drain off the flusher's
+        # critical path (the coalesced ack UPDATE is always buffered, but
+        # it never gates a read — the replay floor is in-memory)
+        self._flushed_seq = self._next_seq - 1
         _g_acked.set(self._acked)
         _g_backlog.set(self.backlog())
 
@@ -179,7 +203,11 @@ class SessionOutbox:
             f"INSERT INTO {TABLE} (seq, ts, kind, dedupe_key, payload) "
             "VALUES (?, ?, ?, ?, ?)"
         )
-        params = (seq, now, kind, key, json.dumps(payload, default=str))
+        # wire.pack_obj: msgpack bytes when available (several times
+        # faster to serialize AND to re-read on the replay hot path —
+        # bench.py --wire), compact JSON otherwise; unpack_obj sniffs, so
+        # journals mix encodings freely across upgrades
+        params = (seq, now, kind, key, wire.pack_obj(payload))
         if self.writer is not None:
             if not self.writer.submit("outbox", sql, params):
                 with self._mu:
@@ -202,6 +230,11 @@ class SessionOutbox:
             if seq <= self._acked:
                 return self._acked
             self._acked = seq
+            self._ack_progress_ts = self.time_now_fn()
+            if seq > self._delivered:
+                # an ack implies delivery even if this process never sent
+                # the frame (restart raced a late manager ack)
+                self._delivered = seq
         # MAX() in SQL too: group-commit batches may reorder vs. memory
         sql = f"UPDATE {ACK_TABLE} SET acked_seq = MAX(acked_seq, ?) WHERE id = 1"
         if self.writer is not None:
@@ -227,65 +260,145 @@ class SessionOutbox:
         with self._mu:
             return max(0, (self._next_seq - 1) - self._acked)
 
+    @property
+    def delivered_seq(self) -> int:
+        with self._mu:
+            return self._delivered
+
+    def reset_delivery(self) -> None:
+        """Reconnect hook (server on_connected): in-flight unacked frames
+        may have died with the old connection and the manager's delta
+        decoder is fresh — fall back to the durable watermark and restart
+        every delta stream at a keyframe."""
+        with self._mu:
+            self._delivered = self._acked
+            self._encoder.reset()
+            self._ack_progress_ts = self.time_now_fn()
+
     # -- replay ------------------------------------------------------------
     def flush(self) -> None:
-        """Read-after-write barrier (no-op without a writer)."""
-        if self.writer is not None:
-            self.writer.flush()
+        """Read-after-write barrier (no-op without a writer, or when every
+        published row is already known durable)."""
+        if self.writer is None:
+            return
+        with self._mu:
+            target = self._next_seq - 1
+            if target <= self._flushed_seq:
+                return
+        if self.writer.flush():
+            with self._mu:
+                if target > self._flushed_seq:
+                    self._flushed_seq = target
 
-    def pending(self, limit: int = 0) -> List[Tuple[int, float, str, str, Dict]]:
-        """Journaled records above the watermark, oldest first:
-        ``(seq, ts, kind, dedupe_key, payload)`` rows."""
-        self.flush()
+    def _read_pending(
+        self, after: int, limit: int
+    ) -> Tuple[List[Tuple], List]:
+        """Rows above ``after`` plus their decoded payloads, as parallel
+        lists (the replay hot path consumes them zipped without building
+        combined 5-tuples). Callers handle the flush barrier."""
         sql = (
             f"SELECT seq, ts, kind, dedupe_key, payload FROM {TABLE} "
             "WHERE seq > ? ORDER BY seq"
         )
-        params: list = [self.acked_seq]
+        params: list = [after]
         if limit:
             sql += " LIMIT ?"
             params.append(limit)
-        out = []
-        for seq, ts, kind, key, payload in self.db.query(sql, params):
-            try:
-                data = json.loads(payload)
-            except ValueError:
-                data = {"raw": payload}
-            out.append((int(seq), float(ts), kind, key, data))
-        return out
+        rows = self.db.query(sql, params)
+        raws = [r[4] for r in rows]
+        try:
+            payloads = wire.unpack_many(raws)
+        except ValueError:
+            # a corrupt row must not become a poison pill that fails every
+            # replay tick — deliver it as an opaque blob instead
+            payloads = []
+            for raw in raws:
+                try:
+                    payloads.append(wire.unpack_obj(raw))
+                except ValueError:
+                    payloads.append({"raw": repr(raw)})
+        return rows, payloads
+
+    def pending(
+        self, limit: int = 0, after: Optional[int] = None
+    ) -> List[Tuple[int, float, str, str, Dict]]:
+        """Journaled records above the watermark (or ``after``), oldest
+        first: ``(seq, ts, kind, dedupe_key, payload)`` rows."""
+        self.flush()
+        rows, payloads = self._read_pending(
+            self.acked_seq if after is None else int(after), limit
+        )
+        return [
+            (seq, ts, kind, key, payloads[i])
+            for i, (seq, ts, kind, key, _raw) in enumerate(rows)
+        ]
 
     def replay_once(self, session) -> int:
-        """Drain one batch of unacked records into a connected session.
+        """Drain one delivery batch into a connected session.
 
-        Returns frames handed to the transport. Stops early on writer-
-        channel backpressure (``send`` timing out) — the next replay tick
-        resumes from the same watermark, which is what at-least-once
-        means. A disconnected or auth-parked session is a no-op: replay
-        must not hammer a manager that just revoked the token.
+        Packs up to ``replay_batch`` delta-encoded records into ONE
+        ``outbox_batch`` frame (docs/session.md wire format); the manager
+        answers a single cumulative ``outboxAck`` per batch. Returns the
+        number of records handed to the transport (0 = nothing pending
+        or the send was refused — the next tick retries keyframe-anchored,
+        which is what at-least-once means). Reads above
+        ``max(acked, delivered)`` so already-delivered-but-unacked rows
+        aren't re-read and re-encoded every tick; an ack stalled past
+        ``redeliver_after_seconds`` drops the delivered floor back to the
+        durable watermark and restarts the delta streams. A disconnected
+        or auth-parked session is a no-op: replay must not hammer a
+        manager that just revoked the token.
         """
         if session is None or not session.connected or session.auth_failed:
             return 0
         from gpud_tpu.session.session import Frame
 
-        sent = 0
-        for seq, ts, kind, key, payload in self.pending(self.replay_batch):
-            frame = Frame(
-                req_id=f"{REPLAY_REQ_PREFIX}{seq}",
-                data={
-                    "outbox_seq": seq,
-                    "kind": kind,
-                    "dedupe_key": key,
-                    "ts": ts,
-                    "payload": payload,
-                },
-            )
-            if not session.send(frame):
-                break
-            sent += 1
-        if sent:
+        now = self.time_now_fn()
+        with self._mu:
+            if (
+                self._delivered > self._acked
+                and now - self._ack_progress_ts >= self.redeliver_after_seconds
+            ):
+                # frames in flight on a previous connection (or a stalled
+                # manager) never acked: redeliver from the durable
+                # watermark, keyframe-anchored — this is also the repair
+                # path for a peer whose delta decoder lost sync
+                logger.warning(
+                    "outbox ack stalled %.0fs at seq %d (delivered %d); "
+                    "redelivering", now - self._ack_progress_ts,
+                    self._acked, self._delivered,
+                )
+                self._delivered = self._acked
+                self._encoder.reset()
+                self._ack_progress_ts = now
+            floor = max(self._acked, self._delivered)
+        self.flush()
+        rows, payloads = self._read_pending(floor, self.replay_batch)
+        if not rows:
+            return 0
+        with self._mu:
+            encode = self._encoder.encode_record
+            records = [
+                encode(row[0], row[1], row[2], row[3], payloads[i])
+                for i, row in enumerate(rows)
+            ]
+        first, last = rows[0][0], rows[-1][0]
+        frame = Frame(
+            req_id=f"{REPLAY_REQ_PREFIX}batch-{first}-{last}",
+            data=wire.build_batch(records),
+        )
+        if not session.send(frame):
             with self._mu:
-                self._replayed += sent
-            _c_replayed.inc(sent)
+                # the peer may have read a prefix of the frame's streams;
+                # restart them so redelivery is keyframe-anchored
+                self._encoder.reset()
+            return 0
+        sent = len(rows)
+        with self._mu:
+            self._replayed += sent
+            if last > self._delivered:
+                self._delivered = last
+        _c_replayed.inc(sent)
         return sent
 
     # -- retention ---------------------------------------------------------
@@ -338,13 +451,18 @@ class SessionOutbox:
             published = self._published
             replayed = self._replayed
             acked = self._acked
+            delivered = self._delivered
             next_seq = self._next_seq
             write_drops = self._write_drops
             retention_drops = self._retention_drops
+            keyframe_interval = self._encoder.keyframe_interval
         return {
             "last_seq": next_seq - 1,
             "acked_seq": acked,
+            "delivered_seq": delivered,
             "backlog": max(0, (next_seq - 1) - acked),
+            "keyframe_interval": keyframe_interval,
+            "redeliver_after_seconds": self.redeliver_after_seconds,
             "published": published,
             "replayed": replayed,
             "dropped_journal_full": write_drops,
@@ -430,6 +548,22 @@ class CircuitBreaker:
             # thread; there is exactly one caller, so permitting again is
             # harmless but keep the gate strict
             return True
+
+    def recovery_age(self) -> Optional[float]:
+        """Seconds since the breaker last closed out of half-open, or
+        None when the latest transition isn't such a recovery. A fresh
+        recovery means this connect is the first after an outage — the
+        whole fleet is reconnecting at once, so the server jitters its
+        outbox replay poke instead of bursting (docs/session.md)."""
+        with self._mu:
+            h = self.history
+            if (
+                len(h) >= 2
+                and h[-1][1] == CIRCUIT_CLOSED
+                and h[-2][1] == CIRCUIT_HALF_OPEN
+            ):
+                return max(0.0, self.time_fn() - h[-1][0])
+        return None
 
     def seconds_until_probe(self) -> float:
         """Remaining cooldown while open (0 when an attempt may proceed)."""

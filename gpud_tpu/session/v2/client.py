@@ -30,10 +30,14 @@ logger = get_logger(__name__)
 
 METHOD = "/tpud.session.v2.Session/Connect"
 # rev 1: JSON Frames over gRPC; rev 2: typed per-method ManagerPacket
-# requests answered with Result packets (see session.proto header)
+# requests answered with Result packets (see session.proto header);
+# rev 3: every Frame.data / Result.payload_json byte string carries the
+# 1-byte wire-codec prefix (session/wire.py — "j" raw JSON, "z" zlib),
+# negotiated exactly like rev 2 so a rev-2 peer still interoperates on
+# bare JSON bytes
 MIN_REVISION = 1
-MAX_REVISION = 2
-CAPABILITIES = ["typed-requests", "drain-notice"]
+MAX_REVISION = 3
+CAPABILITIES = ["typed-requests", "drain-notice", "wire-zlib"]
 HANDSHAKE_TIMEOUT = 10.0
 
 
@@ -180,7 +184,12 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
                     import json
 
                     try:
-                        data = json.loads(mpkt.frame.data.decode("utf-8"))
+                        if negotiated[0] >= 3:
+                            from gpud_tpu.session import wire
+
+                            data = wire.decode_payload(mpkt.frame.data)
+                        else:
+                            data = json.loads(mpkt.frame.data.decode("utf-8"))
                     except ValueError:
                         continue
                     _enqueue_request(mpkt.frame.req_id, data)
@@ -199,12 +208,16 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
                         req = typed.request_to_dict(mpkt)
                     except typed.UnsupportedRequest as e:
                         if mpkt.request_id:
-                            out_q.put(typed.error_result(mpkt.request_id, str(e)))
+                            out_q.put(typed.error_result(
+                                mpkt.request_id, str(e),
+                                compress=negotiated[0] >= 3,
+                            ))
                         continue
                     if not _enqueue_request(mpkt.request_id, req) and mpkt.request_id:
                         out_q.put(
                             typed.error_result(
-                                mpkt.request_id, "agent busy: request dropped"
+                                mpkt.request_id, "agent busy: request dropped",
+                                compress=negotiated[0] >= 3,
                             )
                         )
             if not stopped.is_set():
@@ -233,8 +246,11 @@ def start_v2_transport(session: "Session") -> Callable[[], None]:
             except queue.Empty:
                 continue
             if negotiated[0] >= 2:
-                # rev 2: responses are Result packets keyed by request_id
-                pkt = typed.make_result(frame.req_id, frame.data)
+                # rev 2: responses are Result packets keyed by request_id;
+                # rev 3 adds the wire-codec framing on the payload bytes
+                pkt = typed.make_result(
+                    frame.req_id, frame.data, compress=negotiated[0] >= 3
+                )
             else:
                 pkt = pb.AgentPacket()
                 pkt.frame.req_id = frame.req_id

@@ -286,15 +286,28 @@ def _plugin_spec_from_dict(spec: Dict) -> pb.PluginSpec:
     return out
 
 
-def make_result(request_id: str, payload: Dict) -> pb.AgentPacket:
+def make_result(
+    request_id: str, payload: Dict, compress: bool = False
+) -> pb.AgentPacket:
+    """``compress=True`` applies the rev-3 wire framing (1-byte codec
+    prefix, zlib above the size floor — session/wire.py); only valid
+    once the handshake negotiated revision >= 3. Default is the rev-2
+    bare-JSON encoding."""
     pkt = pb.AgentPacket()
     pkt.result.request_id = request_id
-    pkt.result.payload_json = json.dumps(payload).encode("utf-8")
+    if compress:
+        from gpud_tpu.session import wire
+
+        pkt.result.payload_json = wire.encode_payload(payload)
+    else:
+        pkt.result.payload_json = json.dumps(payload).encode("utf-8")
     return pkt
 
 
-def error_result(request_id: str, message: str) -> pb.AgentPacket:
-    return make_result(request_id, {"error": message})
+def error_result(
+    request_id: str, message: str, compress: bool = False
+) -> pb.AgentPacket:
+    return make_result(request_id, {"error": message}, compress=compress)
 
 
 def negotiate_revision(ack_revision: int, max_supported: int) -> int:

@@ -50,6 +50,7 @@ _METRIC_MODULES = (
     "gpud_tpu.session.dispatch",
     "gpud_tpu.session.outbox",
     "gpud_tpu.session.session",
+    "gpud_tpu.session.wire",
     "gpud_tpu.sqlite",
     "gpud_tpu.storage.writer",
 )

@@ -252,7 +252,7 @@ def _mk_session(cp, machine_id, **kw):
     return s, responses
 
 
-def test_v2_agent_negotiates_rev2_and_answers_typed(v2_stack):
+def test_v2_agent_negotiates_rev3_and_answers_typed(v2_stack):
     cp = v2_stack
     s, _ = _mk_session(cp, "v2-agent")
     try:
@@ -260,7 +260,7 @@ def test_v2_agent_negotiates_rev2_and_answers_typed(v2_stack):
         while time.time() < deadline and "v2-agent" not in cp.agents:
             time.sleep(0.05)
         h = cp.agent("v2-agent")
-        assert h.transport == "v2-rev2"
+        assert h.transport == "v2-rev3"
         # travels as a typed GetStatesRequest, comes back as a Result
         resp = h.request({"method": "states"}, timeout=10)
         assert resp == {"echo": "states"}
@@ -356,7 +356,7 @@ def test_live_daemon_over_v2(v2_stack, tmp_path):
         while time.time() < deadline and "v2-daemon" not in cp.agents:
             time.sleep(0.05)
         h = cp.agent("v2-daemon")
-        assert h.transport == "v2-rev2"
+        assert h.transport == "v2-rev3"
         states = h.request({"method": "states"}, timeout=15)["states"]
         assert {s["component"] for s in states} >= {"cpu", "memory"}
     finally:
@@ -399,8 +399,8 @@ def test_agent_min_revision_above_manager_is_rejected(v2_stack):
     hello = pb.AgentPacket()
     hello.hello.machine_id = "future-agent"
     hello.hello.token = "t"
-    hello.hello.min_revision = 3
-    hello.hello.max_revision = 3
+    hello.hello.min_revision = 4
+    hello.hello.max_revision = 4
     replies = list(stream(iter([hello])))
     channel.close()
     assert len(replies) == 1

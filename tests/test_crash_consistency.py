@@ -394,7 +394,10 @@ class Loopback:
     auth_failed = False
 
     def send(self, frame):
-        print("DEL", frame.data["outbox_seq"], flush=True)
+        # batched delivery (docs/session.md wire format): one DEL line
+        # per record so the parent can track per-seq delivery
+        for rec in frame.data["outbox_batch"]["records"]:
+            print("DEL", rec[0], flush=True)
         return True
 
 
@@ -477,7 +480,8 @@ def test_sigkill_mid_outbox_replay_watermark_and_delivery(tmp_path):
                 self.seqs = set()
 
             def send(self, frame):
-                self.seqs.add(frame.data["outbox_seq"])
+                for rec in frame.data["outbox_batch"]["records"]:
+                    self.seqs.add(rec[0])
                 return True
 
         sess = Drain()

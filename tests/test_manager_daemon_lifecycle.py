@@ -1,5 +1,5 @@
 """Management lifecycle driven TYPED end-to-end: a real daemon enrolled
-in the real control plane over v2-rev2, every management action issued
+in the real control plane over v2-rev3, every management action issued
 through the manager's operator surface and thus through the typed
 encoder → gRPC → agent decoder → dispatcher chain (the reference's
 manager↔agent method surface, pkg/session/session.proto:16-60)."""
@@ -18,7 +18,7 @@ requests = pytest.importorskip("requests")
 
 @pytest.fixture(scope="module")
 def fleet(tmp_path_factory):
-    """ControlPlane + one real daemon connected over v2-rev2."""
+    """ControlPlane + one real daemon connected over v2-rev3."""
     import os
 
     tmp = tmp_path_factory.mktemp("lifecycle")
@@ -44,7 +44,7 @@ def fleet(tmp_path_factory):
         while time.time() < deadline and "lifecycle-box" not in cp.agents:
             time.sleep(0.05)
         h = cp.agent("lifecycle-box")
-        assert h.transport == "v2-rev2"
+        assert h.transport == "v2-rev3"
         yield cp, srv, h
     finally:
         # setup failures must not leak the env override (it would

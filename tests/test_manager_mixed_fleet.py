@@ -1,7 +1,7 @@
 """Mixed-protocol fleet against one manager.
 
 A real fleet upgrades gradually: v1-only agents (legacy chunked-stream
-transport) and v2-rev2 agents (typed gRPC) coexist on the SAME control
+transport) and v2-rev3 agents (typed gRPC) coexist on the SAME control
 plane. The manager must serve operator requests to both, keep their
 handles separate, and deliver drain semantics appropriately per
 transport (v2 gets a DrainNotice; v1 streams just close). Reference:
@@ -61,7 +61,7 @@ def test_v1_and_v2_agents_coexist_and_answer(cp):
         _wait_enrolled(cp, "legacy-box", "typed-box")
         h1, h2 = cp.agent("legacy-box"), cp.agent("typed-box")
         assert h1.transport == "v1"
-        assert h2.transport == "v2-rev2"
+        assert h2.transport == "v2-rev3"
         # requests route to the right agent over the right transport
         r1 = h1.request({"method": "states"}, timeout=10)
         r2 = h2.request({"method": "states"}, timeout=10)
@@ -70,7 +70,7 @@ def test_v1_and_v2_agents_coexist_and_answer(cp):
         # machine list reports both with their transports
         listed = {m["machine_id"]: m for m in cp.machines()}
         assert listed["legacy-box"]["transport"] == "v1"
-        assert listed["typed-box"]["transport"] == "v2-rev2"
+        assert listed["typed-box"]["transport"] == "v2-rev3"
     finally:
         v1.stop()
         v2.stop()
@@ -142,11 +142,11 @@ def test_same_machine_upgrading_transport_replaces_handle(cp):
         deadline = time.time() + 15
         while time.time() < deadline:
             h = cp.agents.get("upgrade-box")
-            if h is not None and h.transport == "v2-rev2":
+            if h is not None and h.transport == "v2-rev3":
                 break
             time.sleep(0.05)
         h = cp.agent("upgrade-box")
-        assert h.transport == "v2-rev2"
+        assert h.transport == "v2-rev3"
         assert h.request({"method": "states"}, 10)["from"] == "upgrade-box"
     finally:
         v2.stop()

@@ -78,24 +78,31 @@ def test_ack_is_monotonic_and_trims_pending():
     db.close()
 
 
-def test_replay_delivers_pending_in_order_with_dedupe_keys():
+def test_replay_delivers_batched_frames_in_order():
     db = DB(":memory:")
     ob = SessionOutbox(db, replay_batch=2)
     for i in range(3):
         ob.publish("event", {"i": i}, dedupe_key=f"k{i}")
     sess = FakeSession()
     assert ob.replay_once(sess) == 2  # bounded by replay_batch
-    assert [f.req_id for f in sess.frames] == ["outbox-1", "outbox-2"]
-    assert sess.frames[0].data["dedupe_key"] == "k0"
-    assert sess.frames[0].data["payload"] == {"i": 0}
-    # nothing acked yet: replay re-sends the same frames (at-least-once)
-    sess2 = FakeSession()
-    ob.replay_once(sess2)
-    assert [f.data["outbox_seq"] for f in sess2.frames] == [1, 2]
-    ob.ack(2)
-    sess3 = FakeSession()
-    ob.replay_once(sess3)
-    assert [f.data["outbox_seq"] for f in sess3.frames] == [3]
+    assert len(sess.frames) == 1, "one delivery frame per replay tick"
+    assert sess.frames[0].req_id == "outbox-batch-1-2"
+    batch = sess.frames[0].data["outbox_batch"]
+    assert (batch["first_seq"], batch["last_seq"], batch["count"]) == (1, 2, 2)
+    recs = batch["records"]
+    assert [r[0] for r in recs] == [1, 2]
+    # first record of a stream is a keyframe (length 6, full payload);
+    # the next one deltas against it (length 7)
+    assert len(recs[0]) == 6 and recs[0][3] == "k0" and recs[0][5] == {"i": 0}
+    assert len(recs[1]) == 7
+    # delivered-high-water: the next tick delivers the tail, then replay
+    # idles — delivered-but-unacked rows are not re-read every tick
+    assert ob.replay_once(sess) == 1
+    assert sess.frames[1].data["outbox_batch"]["last_seq"] == 3
+    assert ob.replay_once(sess) == 0
+    assert ob.delivered_seq == 3 and ob.acked_seq == 0
+    ob.ack(3)
+    assert ob.backlog() == 0
     db.close()
 
 
@@ -109,17 +116,45 @@ def test_replay_noop_when_disconnected_or_auth_parked():
     db.close()
 
 
-def test_replay_stops_on_transport_backpressure():
+def test_replay_retries_refused_batch_keyframe_anchored():
     db = DB(":memory:")
     ob = SessionOutbox(db)
     for i in range(4):
         ob.publish("event", {"i": i})
-    sess = FakeSession(accept=2)
-    assert ob.replay_once(sess) == 2
-    # the refused frame was NOT skipped: next replay resumes from the
-    # same watermark and re-sends everything still unacked
+    sess = FakeSession(accept=0)
+    # the whole batch frame was refused: nothing counts as delivered
+    assert ob.replay_once(sess) == 0
+    assert ob.delivered_seq == 0
+    # next replay resumes from the same watermark, and the encoder was
+    # reset so the retried batch re-anchors on a keyframe
     sess.accept = None
     assert ob.replay_once(sess) == 4
+    batch = sess.frames[0].data["outbox_batch"]
+    assert (batch["first_seq"], batch["last_seq"]) == (1, 4)
+    assert len(batch["records"][0]) == 6  # keyframe, not a dangling delta
+    db.close()
+
+
+def test_ack_stall_redelivers_from_acked_watermark():
+    db = DB(":memory:")
+    now = [1000.0]
+    ob = SessionOutbox(
+        db, redeliver_after_seconds=5.0, time_now_fn=lambda: now[0]
+    )
+    for i in range(3):
+        ob.publish("event", {"i": i})
+    sess = FakeSession()
+    assert ob.replay_once(sess) == 3
+    assert ob.replay_once(sess) == 0  # delivered, awaiting ack
+    now[0] += 6.0
+    # no ack progress within the window: assume the frames were lost and
+    # redeliver everything above the acked watermark, keyframe-anchored
+    assert ob.replay_once(sess) == 3
+    redo = sess.frames[-1].data["outbox_batch"]
+    assert (redo["first_seq"], redo["last_seq"]) == (1, 3)
+    assert len(redo["records"][0]) == 6
+    # the stall clock was refreshed: no immediate re-redelivery
+    assert ob.replay_once(sess) == 0
     db.close()
 
 
@@ -413,3 +448,64 @@ def test_agent_handle_dedupes_and_acks_outbox_frames():
     # unsolicited noise
     h.resolve("op-1-ack", {"acked_seq": 1})
     assert h.unsolicited == []
+
+
+def _drain_acks(h):
+    acks = []
+    while not h.outbound.empty():
+        item = h.outbound.get_nowait()
+        if item and item["data"].get("method") == "outboxAck":
+            acks.append(item["data"]["seq"])
+    return acks
+
+
+def test_agent_handle_ingests_batch_with_one_cumulative_ack():
+    from gpud_tpu.manager.control_plane import AgentHandle
+    from gpud_tpu.session import wire
+
+    h = AgentHandle("m1", "v2-rev3")
+    enc = wire.DeltaEncoder()
+    recs = [
+        enc.encode_record(i + 1, float(i), "event", f"k{i + 1}",
+                          {"component": "tpu0", "i": i})
+        for i in range(5)
+    ]
+    h.resolve("outbox-batch-1-5", wire.build_batch(recs))
+    assert [r["outbox_seq"] for r in h.outbox_records] == [1, 2, 3, 4, 5]
+    # deltas decoded back to full payloads
+    assert [r["payload"]["i"] for r in h.outbox_records] == [0, 1, 2, 3, 4]
+    assert h.outbox_acked == 5
+    assert _drain_acks(h) == [5], "one cumulative ack per batch frame"
+
+    # redelivery of the same records dedupes but still re-acks the
+    # watermark so the sender can make progress
+    enc.reset()
+    redo = [
+        enc.encode_record(i + 1, float(i), "event", f"k{i + 1}",
+                          {"component": "tpu0", "i": i})
+        for i in range(5)
+    ]
+    h.resolve("outbox-batch-1-5", wire.build_batch(redo))
+    assert len(h.outbox_records) == 5
+    assert _drain_acks(h) == [5]
+
+
+def test_agent_handle_acks_decoded_prefix_on_delta_desync():
+    from gpud_tpu.manager.control_plane import AgentHandle
+    from gpud_tpu.session import wire
+
+    h = AgentHandle("m1", "v2-rev3")
+    good = wire.DeltaEncoder().encode_record(
+        1, 1.0, "event", "k1", {"component": "a", "i": 0}
+    )
+    # fabricate a delta whose keyframe was never delivered: encode two
+    # records on another stream and ship only the second
+    enc = wire.DeltaEncoder()
+    enc.encode_record(1, 1.0, "event", "x", {"component": "b", "i": 0})
+    orphan = enc.encode_record(2, 2.0, "event", "k2", {"component": "b", "i": 1})
+    h.resolve("outbox-batch-1-2", wire.build_batch([good, orphan]))
+    # the decodable prefix is recorded and acked; the desynced tail is
+    # left for the sender's keyframe-anchored redelivery
+    assert [r["dedupe_key"] for r in h.outbox_records] == ["k1"]
+    assert h.outbox_acked == 1
+    assert _drain_acks(h) == [1]

@@ -43,8 +43,9 @@ def test_hello_advertises_revision_range_and_capabilities():
         assert _wait(lambda: s.connected)
         h = m.hellos[0]
         assert h.min_revision == 1
-        assert h.max_revision == 2
+        assert h.max_revision == 3
         assert "typed-requests" in list(h.capabilities)
+        assert "wire-zlib" in list(h.capabilities)
         assert h.revision == 1  # legacy compat field for old managers
         s.stop()
     finally:
@@ -260,6 +261,8 @@ def test_negotiate_revision_clamps():
     assert typed.negotiate_revision(1, 2) == 1
     assert typed.negotiate_revision(2, 2) == 2
     assert typed.negotiate_revision(3, 2) == 2   # future manager clamped
+    assert typed.negotiate_revision(3, 3) == 3   # rev-3 compressed wire
+    assert typed.negotiate_revision(2, 3) == 2   # rev-2 peer: no compression
 
 
 # -- manager-side encoder (control plane) ----------------------------------

@@ -1,0 +1,259 @@
+"""Session wire codec: delta streams, batch frames, rev-3 payload framing.
+
+The codec is the perf tentpole behind ``bench.py --wire`` (>=100k
+records/sec, >=3x bytes-on-the-wire vs per-record JSON): positional
+array records (keyframe length 6, delta length 7), per-stream dict
+diffs with a keyframe every K records, and a 1-byte codec prefix
+(j/z/m/M) on every rev-3 tunnel payload. Correctness here is what makes
+the speed safe to ship: exact roundtrips, deterministic resync after
+encoder resets, and loud failures on desync so the ack watermark never
+passes an undecodable record.
+"""
+
+import random
+import time
+
+import pytest
+
+from gpud_tpu.session import wire
+from gpud_tpu.session.wire import (
+    DeltaDecodeError,
+    DeltaDecoder,
+    DeltaEncoder,
+    WireCodecError,
+)
+
+
+def _roundtrip(enc, dec, records):
+    """Encode then decode a (seq, ts, kind, key, payload) list; assert
+    the decoder reproduces every payload exactly."""
+    for seq, ts, kind, key, payload in records:
+        arr = enc.encode_record(seq, ts, kind, key, payload)
+        got = dec.decode_record(arr)
+        assert got == (seq, ts, kind, key, payload), f"seq {seq} diverged"
+
+
+# -- delta codec -------------------------------------------------------------
+
+def test_delta_roundtrip_identity_over_random_mutations():
+    rng = random.Random(0xC0FFEE)
+    components = [f"tpu-chip-{i}" for i in range(4)]
+    states = ["healthy", "degraded", "unhealthy"]
+    payloads = {c: {"component": c, "state": "healthy", "value": 0.0,
+                    "labels": {"pod": "p0"}} for c in components}
+    records = []
+    for seq in range(1, 401):
+        c = rng.choice(components)
+        p = dict(payloads[c])  # encoder keeps refs: never mutate in place
+        mutation = rng.random()
+        if mutation < 0.5:
+            p["value"] = rng.randrange(1000) / 10.0
+        elif mutation < 0.7:
+            p["state"] = rng.choice(states)
+        elif mutation < 0.85:
+            p[f"extra_{rng.randrange(3)}"] = rng.randrange(10)  # key added
+        else:
+            for k in [k for k in p if k.startswith("extra_")]:
+                p.pop(k)  # keys removed -> exercises the del list
+        payloads[c] = p
+        records.append((seq, float(seq), "metric", f"k{seq}", p))
+    _roundtrip(DeltaEncoder(keyframe_interval=16), DeltaDecoder(), records)
+
+
+def test_keyframe_cadence_every_k_records_per_stream():
+    enc = DeltaEncoder(keyframe_interval=4)
+    lengths = [
+        len(enc.encode_record(i + 1, 0.0, "event", f"k{i}",
+                              {"component": "a", "i": i}))
+        for i in range(9)
+    ]
+    # keyframe (6), then K-1 deltas (7), then the cadence repeats
+    assert lengths == [6, 7, 7, 7, 6, 7, 7, 7, 6]
+    # a second stream keeps its own cadence counter
+    other = enc.encode_record(10, 0.0, "event", "kx", {"component": "b"})
+    assert len(other) == 6
+
+
+def test_encoder_reset_restarts_streams_and_decoder_resyncs():
+    enc, dec = DeltaEncoder(keyframe_interval=64), DeltaDecoder()
+    p1 = {"component": "a", "i": 1}
+    dec.decode_record(enc.encode_record(1, 0.0, "event", "k1", p1))
+    # reconnect: a fresh decoder would desync on a delta, so the encoder
+    # reset forces the next record out as a keyframe
+    enc.reset()
+    dec2 = DeltaDecoder()
+    p2 = {"component": "a", "i": 2}
+    arr = enc.encode_record(2, 0.0, "event", "k2", p2)
+    assert len(arr) == 6
+    assert dec2.decode_record(arr)[4] == p2
+
+
+def test_delta_without_base_and_malformed_records_raise():
+    enc = DeltaEncoder()
+    enc.encode_record(1, 0.0, "event", "k1", {"component": "a", "i": 0})
+    delta = enc.encode_record(2, 0.0, "event", "k2", {"component": "a", "i": 1})
+    assert len(delta) == 7
+    with pytest.raises(DeltaDecodeError):
+        DeltaDecoder().decode_record(delta)  # keyframe never arrived
+    with pytest.raises(DeltaDecodeError):
+        DeltaDecoder().decode_record([1, 0.0, "event"])  # truncated
+    with pytest.raises(DeltaDecodeError):
+        DeltaDecoder().decode_record(delta + ["junk"])  # wrong length
+    with pytest.raises(DeltaDecodeError):
+        DeltaDecoder().decode_record({"not": "an array"})
+    with pytest.raises(DeltaDecodeError):
+        DeltaDecoder().decode_record(None)
+
+
+def test_non_dict_payloads_skip_delta_and_clear_the_stream():
+    enc, dec = DeltaEncoder(), DeltaDecoder()
+    _roundtrip(enc, dec, [
+        (1, 0.0, "event", "k1", {"i": 0}),       # keyframe on "event:"
+        (2, 0.0, "event", "k2", "plain-string"),  # non-dict drops the base
+        (3, 0.0, "event", "k3", {"i": 1}),       # must re-keyframe
+    ])
+
+
+def test_decoder_does_not_mutate_prior_payloads():
+    enc, dec = DeltaEncoder(), DeltaDecoder()
+    first = dec.decode_record(
+        enc.encode_record(1, 0.0, "event", "k1", {"component": "a", "i": 0})
+    )[4]
+    second = dec.decode_record(
+        enc.encode_record(2, 0.0, "event", "k2", {"component": "a", "i": 1})
+    )[4]
+    assert first["i"] == 0 and second["i"] == 1
+
+
+# -- batch envelope ----------------------------------------------------------
+
+def test_build_and_parse_batch_envelope():
+    enc = DeltaEncoder()
+    recs = [
+        enc.encode_record(i, float(i), "event", f"k{i}", {"i": i})
+        for i in (3, 4, 5)
+    ]
+    data = wire.build_batch(recs)
+    batch = wire.parse_batch(data)
+    assert batch is not None
+    assert (batch["v"], batch["first_seq"], batch["last_seq"],
+            batch["count"]) == (wire.BATCH_VERSION, 3, 5, 3)
+    assert wire.parse_batch({"outbox_seq": 1}) is None
+    assert wire.parse_batch("nope") is None
+    assert wire.build_batch([])[wire.BATCH_KEY]["count"] == 0
+
+
+# -- rev-3 payload framing ---------------------------------------------------
+
+def test_encode_decode_payload_roundtrip_small_and_large():
+    small = {"method": "outboxAck", "seq": 7}
+    buf = wire.encode_payload(small)
+    assert buf[:1] in (wire.PREFIX_JSON, wire.PREFIX_MSGPACK)
+    assert wire.decode_payload(buf) == small
+
+    # repetitive batch-shaped payload above the floor: zlib framing wins
+    big = {"records": [
+        {"component": f"tpu-chip-{i % 8}", "state": "healthy",
+         "name": "hbm_utilization", "value": i} for i in range(200)
+    ]}
+    zbuf = wire.encode_payload(big, min_bytes=64)
+    assert zbuf[:1] in (wire.PREFIX_ZLIB, wire.PREFIX_ZLIB_MSGPACK)
+    assert wire.decode_payload(zbuf) == big
+
+
+def test_encode_payload_skips_zlib_below_floor_or_when_it_grows():
+    small = {"a": 1}
+    assert wire.encode_payload(small, min_bytes=10_000)[:1] in (
+        wire.PREFIX_JSON, wire.PREFIX_MSGPACK
+    )
+    # high-entropy bytes don't compress: stays on the plain framing even
+    # above the floor (msgpack's bin type carries raw bytes losslessly)
+    if wire._msgpack is not None:
+        rng = random.Random(7)
+        noise = {"blob": bytes(rng.randrange(256) for _ in range(2048))}
+        buf = wire.encode_payload(noise, min_bytes=0)
+        assert buf[:1] == wire.PREFIX_MSGPACK
+        assert wire.decode_payload(buf) == noise
+
+
+def test_decode_payload_rejects_garbage():
+    with pytest.raises(WireCodecError):
+        wire.decode_payload(b"")
+    with pytest.raises(WireCodecError):
+        wire.decode_payload(b"?whatever")
+    with pytest.raises(WireCodecError):
+        wire.decode_payload(wire.PREFIX_ZLIB + b"not-zlib")
+    with pytest.raises(WireCodecError):
+        wire.decode_payload(wire.PREFIX_JSON + b"{broken")
+
+
+def test_codec_stats_track_egress_ratio():
+    before = wire.codec_stats()
+    wire.encode_payload({"records": ["x" * 50] * 100}, min_bytes=0)
+    after = wire.codec_stats()
+    assert after["wire_egress_bytes"] > before["wire_egress_bytes"]
+    assert after["raw_egress_bytes"] > before["raw_egress_bytes"]
+    assert after["compression_ratio"] >= 1.0
+
+
+# -- journal column packing --------------------------------------------------
+
+def test_pack_unpack_obj_and_legacy_json_rows():
+    obj = {"component": "tpu0", "value": 1.5, "labels": {"pod": "p"}}
+    assert wire.unpack_obj(wire.pack_obj(obj)) == obj
+    # rows journaled before the binary column encoding are JSON text
+    assert wire.unpack_obj('{"legacy": true}') == {"legacy": True}
+    with pytest.raises(ValueError):
+        wire.unpack_obj("not json")
+
+
+def test_unpack_many_bulk_path_and_mixed_legacy_fallback():
+    objs = [{"i": i, "component": f"c{i % 3}"} for i in range(50)]
+    raws = [wire.pack_obj(o) for o in objs]
+    assert wire.unpack_many(raws) == objs
+    # a legacy JSON text row in the middle forces the row-by-row path
+    mixed = raws[:10] + ['{"legacy": 1}'] + raws[10:]
+    assert wire.unpack_many(mixed) == objs[:10] + [{"legacy": 1}] + objs[10:]
+    assert wire.unpack_many([]) == []
+
+
+# -- cross-revision handshake ------------------------------------------------
+
+def test_rev2_agent_against_rev3_manager_negotiates_down(monkeypatch):
+    """A fleet mid-rollout runs old agents against a new manager: the
+    hello clamps to rev 2 and payloads stay bare JSON (no codec prefix
+    the old peer wouldn't understand)."""
+    pytest.importorskip("grpc")
+    from gpud_tpu.manager.control_plane import ControlPlane
+    from gpud_tpu.session.session import Session
+    from gpud_tpu.session.v2 import client as v2_client
+
+    monkeypatch.setattr(v2_client, "MAX_REVISION", 2)
+    cp = ControlPlane()
+    cp.start()
+    try:
+        monkeypatch.setenv(
+            "TPUD_SESSION_V2_TARGET", f"127.0.0.1:{cp.grpc_port}"
+        )
+        s = Session(
+            endpoint=cp.endpoint,
+            machine_id="old-agent",
+            token="t",
+            machine_proof="p",
+            dispatch_fn=lambda req: {"echo": req.get("method")},
+            protocol="auto",
+        )
+        s.start()
+        try:
+            deadline = time.time() + 15
+            while time.time() < deadline and "old-agent" not in cp.agents:
+                time.sleep(0.05)
+            h = cp.agent("old-agent")
+            assert h.transport == "v2-rev2"
+            assert h.request({"method": "states"}, timeout=10) == {
+                "echo": "states"
+            }
+        finally:
+            s.stop()
+    finally:
+        cp.stop()
