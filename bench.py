@@ -398,6 +398,7 @@ def bench_chaos(scenario: str) -> int:
     kmsg = os.path.join(tmp, "kmsg.fixture")
     open(kmsg, "w").close()
     cp = FakeControlPlane()
+    cp.attach_rollup()  # fleet-rollup-storm asserts rollup consistency
     cp.start()
     cfg = default_config(
         data_dir=os.path.join(tmp, "data"),
@@ -951,6 +952,210 @@ def bench_wire(records: int = 120_000) -> int:
     return 0 if ok else 1
 
 
+FLEET_TARGET_AGENTS = 500
+FLEET_TARGET_INGEST_PER_SEC = 20_000
+FLEET_COLD_P95_MS = 500.0
+FLEET_CACHED_P95_MS = 50.0
+FLEET_MIN_CACHE_HIT_RATIO = 0.5
+FLEET_MAX_RSS_DELTA_MB = 200.0
+
+
+def bench_fleet(agents: int = FLEET_TARGET_AGENTS,
+                records_per_agent: int = 200) -> int:
+    """``--fleet`` mode: boot a real manager (HTTP operator API + fleet
+    rollup store on disk), enroll ``agents`` simulated agent transports,
+    and drive delta-encoded outbox batches through the real ingest path
+    while an operator hammers the rollup API. Gates: sustained ingest
+    records/sec, cold rollup-query p95 under ingest load, cached p95
+    after quiesce, cache hit ratio, manager RSS delta, zero record loss,
+    and end-to-end correlation-id retrieval via /v1/fleet/traces."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import queue
+    import shutil
+    import threading
+
+    import requests
+
+    from gpud_tpu.manager.control_plane import AgentHandle, ControlPlane
+    from gpud_tpu.session import wire
+
+    tmp = tempfile.mkdtemp(prefix="tpud-fleet-")
+    cp = ControlPlane(data_dir=os.path.join(tmp, "manager"))
+    cp.start()
+    base = cp.endpoint
+    sess = requests.Session()
+
+    def _scrape() -> dict:
+        """Unlabeled tpud_fleet_* sample values off the manager's
+        federated /metrics endpoint."""
+        out = {}
+        for line in sess.get(f"{base}/metrics", timeout=30).text.splitlines():
+            if line.startswith("tpud_fleet_") and "{" not in line:
+                try:
+                    name, val = line.split()
+                    out[name] = float(val)
+                except ValueError:
+                    continue
+        return out
+
+    rss0 = _rss_mb()
+    handles = []
+    for i in range(agents):
+        h = AgentHandle(f"sim-{i:04d}", "bench")
+        # the manager keeps a per-agent tail buffer for its live-debug
+        # view; at fleet scale the rollup store is the system of record,
+        # so keep the per-handle tail small to bound manager memory
+        h.outbox_records_max = 64
+        cp._register(h)
+        handles.append(h)
+
+    components = ["tpu-hbm", "tpu-ici", "tpu-kmsg", "tpu-runtime"]
+    batch_size = 50
+    total = agents * records_per_agent
+    ingest_done = threading.Event()
+    cold_lat_ms: list = []
+    read_errors = []
+
+    def _operator_load() -> None:
+        # operator reads during sustained ingest: every one is a cold
+        # cache miss (each batch bumps the store generation), so this
+        # measures the flush-barrier + full recompute path under load
+        while not ingest_done.is_set():
+            for path in ("/v1/fleet/rollup", "/v1/fleet/agents?limit=100"):
+                t = time.monotonic()
+                try:
+                    r = sess.get(f"{base}{path}", timeout=30)
+                    if r.status_code != 200:
+                        read_errors.append(f"{path}: HTTP {r.status_code}")
+                        return
+                except Exception as e:  # noqa: BLE001
+                    read_errors.append(f"{path}: {e}")
+                    return
+                cold_lat_ms.append((time.monotonic() - t) * 1000.0)
+            time.sleep(0.05)
+
+    reader = threading.Thread(target=_operator_load, daemon=True)
+    reader.start()
+
+    t0 = time.monotonic()
+    sent = 0
+    for i, h in enumerate(handles):
+        enc = wire.DeltaEncoder()
+        recs = []
+        for n in range(records_per_agent):
+            comp = components[n % len(components)]
+            to = "Unhealthy" if n % 2 == 0 else "Healthy"
+            frm = "Healthy" if to == "Unhealthy" else "Unhealthy"
+            ts = t0 + n * 0.001
+            payload = {"component": comp, "from": frm, "to": to,
+                       "ts": ts, "reason": "bench"}
+            if i == 0 and n == 0:
+                payload["correlation_id"] = "bench-cid-fleet"
+            recs.append(enc.encode_record(
+                n + 1, ts, "transition",
+                f"transition:{comp}:{ts}:{to}", payload,
+            ))
+            if len(recs) >= batch_size or n == records_per_agent - 1:
+                h.resolve(f"outbox-{n + 1}", wire.build_batch(recs))
+                sent += len(recs)
+                recs = []
+                while True:  # drain acks as the agent's read stream would
+                    try:
+                        h.outbound.get_nowait()
+                    except queue.Empty:
+                        break
+    elapsed = time.monotonic() - t0
+    ingest_done.set()
+    reader.join(timeout=60)
+    rate = sent / elapsed if elapsed else 0.0
+
+    if not cp.writer.flush(timeout=60.0):
+        print("[fleet] WARNING: journal flush barrier timed out",
+              file=sys.stderr)
+
+    # quiesced operator reads: generation is stable, so after one cold
+    # recompute the TTL cache serves until expiry
+    m0 = _scrape()
+    cached_lat_ms = []
+    rollup = None
+    for _ in range(40):
+        for path in ("/v1/fleet/rollup", "/v1/fleet/agents?limit=100"):
+            t = time.monotonic()
+            r = sess.get(f"{base}{path}", timeout=30)
+            cached_lat_ms.append((time.monotonic() - t) * 1000.0)
+            if path == "/v1/fleet/rollup":
+                rollup = r.json()
+    m1 = _scrape()
+    d_hits = m1.get("tpud_fleet_cache_hits_total", 0) - m0.get(
+        "tpud_fleet_cache_hits_total", 0)
+    d_miss = m1.get("tpud_fleet_cache_misses_total", 0) - m0.get(
+        "tpud_fleet_cache_misses_total", 0)
+    hit_ratio = d_hits / (d_hits + d_miss) if (d_hits + d_miss) else 0.0
+
+    traces = sess.get(
+        f"{base}/v1/fleet/traces?correlation_id=bench-cid-fleet", timeout=30
+    ).json()
+    rss_delta = _rss_mb() - rss0
+
+    cold_p95 = (statistics.quantiles(cold_lat_ms, n=20)[-1]
+                if len(cold_lat_ms) >= 2 else float("inf"))
+    cached_p95 = (statistics.quantiles(cached_lat_ms, n=20)[-1]
+                  if len(cached_lat_ms) >= 2 else float("inf"))
+    journaled = cp.rollup.journal_count()
+    zero_loss = (
+        rollup is not None
+        and rollup["records_total"] == total
+        and journaled == total
+        and rollup["agents"] == agents
+    )
+    correlated = traces.get("count", 0) >= 1
+
+    cp.stop()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    print(
+        f"[fleet] ingest: {rate:,.0f} records/sec ({sent:,} records from "
+        f"{agents} agents in {elapsed:.2f}s) [target >= "
+        f"{FLEET_TARGET_INGEST_PER_SEC:,}]",
+        file=sys.stderr,
+    )
+    print(
+        f"[fleet] rollup query p95: cold {cold_p95:.1f}ms over "
+        f"{len(cold_lat_ms)} reads under ingest [<= {FLEET_COLD_P95_MS:g}], "
+        f"cached {cached_p95:.1f}ms over {len(cached_lat_ms)} quiesced "
+        f"reads [<= {FLEET_CACHED_P95_MS:g}], cache hit ratio "
+        f"{hit_ratio:.2f} [>= {FLEET_MIN_CACHE_HIT_RATIO:g}]",
+        file=sys.stderr,
+    )
+    print(
+        f"[fleet] journal: {journaled:,} rows (zero_loss={zero_loss}), "
+        f"correlation stitch={'ok' if correlated else 'MISSING'}, "
+        f"manager RSS delta {rss_delta:.1f}MB "
+        f"[<= {FLEET_MAX_RSS_DELTA_MB:g}]",
+        file=sys.stderr,
+    )
+    if read_errors:
+        print(f"[fleet] READ ERRORS: {read_errors[:5]}", file=sys.stderr)
+    ok = (
+        rate >= FLEET_TARGET_INGEST_PER_SEC
+        and cold_p95 <= FLEET_COLD_P95_MS
+        and cached_p95 <= FLEET_CACHED_P95_MS
+        and hit_ratio >= FLEET_MIN_CACHE_HIT_RATIO
+        and rss_delta <= FLEET_MAX_RSS_DELTA_MB
+        and zero_loss
+        and correlated
+        and not read_errors
+    )
+    print(json.dumps({
+        "metric": "fleet rollup ingest throughput",
+        "value": round(rate, 1),
+        "unit": "records/sec",
+        "vs_baseline": round(rate / FLEET_TARGET_INGEST_PER_SEC, 2),
+    }))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -990,7 +1195,20 @@ def main(argv=None) -> int:
         "--wire-records", type=int, default=120_000,
         help="records to journal/drain for --wire (default 120000)",
     )
+    ap.add_argument(
+        "--fleet", action="store_true",
+        help="run the fleet observability plane bench (manager rollup "
+             "store + operator API under simulated-agent ingest) instead "
+             "of the standard bench",
+    )
+    ap.add_argument(
+        "--fleet-agents", type=int, default=FLEET_TARGET_AGENTS,
+        help="simulated agents to enroll for --fleet (default "
+             f"{FLEET_TARGET_AGENTS})",
+    )
     args = ap.parse_args(argv)
+    if args.fleet:
+        return bench_fleet(agents=args.fleet_agents)
     if args.chaos:
         return bench_chaos(args.chaos)
     if args.ingest:

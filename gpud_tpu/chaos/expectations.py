@@ -16,6 +16,9 @@ invariants that hold the daemon itself to account:
   outbox:       store-and-forward delivery held — zero loss across the
                 partition, circuit-breaker transitions in order, connect
                 attempts flat while the breaker is open
+  fleet:        the manager-side rollup store (manager/rollup.py) agrees
+                with the plane's ingest ledger — one row per accepted
+                record, redeliveries deduped, per-kind counts matching
   invariants:   zero unhandled worker exceptions (scheduler failure +
                 watchdog counters flat), un-faulted job cadence within
                 slack, thread-count and RSS gates
@@ -471,6 +474,82 @@ def _eval_outbox(server, spec: Dict, ctx) -> List[ExpectationResult]:
     return out
 
 
+def _eval_fleet(server, spec: Dict, ctx) -> List[ExpectationResult]:
+    """Fleet rollup consistency (manager/rollup.py) against the fake
+    control plane's ingest ledger:
+
+      consistent:  the rollup journal holds exactly one row per deduped
+                   record the plane accepted (``plane.outbox_keys``) —
+                   redeliveries across a disconnect storm must not
+                   double-count, and nothing the plane accepted may be
+                   missing from the rollup. Cumulative across campaigns
+                   sharing the plane, like the plane's own dedupe set.
+      kinds_match: per-kind record counts in the rollup equal a recount
+                   over the plane's accepted frames — no torn aggregates.
+    """
+    out: List[ExpectationResult] = []
+    plane = ctx.plane
+    rollup = getattr(plane, "rollup", None) if plane is not None else None
+    if rollup is None:
+        return [ExpectationResult(
+            "fleet", False,
+            detail="no fleet rollup store attached to the fake control plane",
+        )]
+    within = float(spec.get("within", ctx.detect_timeout))
+
+    if spec.get("consistent", True):
+        deadline = ctx.time_fn() + within
+
+        def agree():
+            delivered = len(plane.outbox_keys)
+            journaled = rollup.journal_count()
+            if delivered and journaled == delivered == rollup.records_total():
+                return (delivered,)
+            return None
+
+        got = _poll(agree, deadline, ctx)
+        if got is None:
+            out.append(ExpectationResult(
+                "fleet", False, timed_out=True,
+                detail=(
+                    f"rollup/plane divergence after {within:g}s: plane "
+                    f"accepted {len(plane.outbox_keys)} record(s), rollup "
+                    f"journaled {rollup.journal_count()}, applied "
+                    f"{rollup.records_total()}"
+                ),
+            ))
+        else:
+            out.append(ExpectationResult(
+                "fleet", True,
+                detail=(
+                    f"rollup consistent: {got[0]} record(s) journaled == "
+                    "accepted == applied, redeliveries deduped"
+                ),
+            ))
+
+    if spec.get("kinds_match", False):
+        from collections import Counter
+
+        want = Counter(f.get("kind") or "" for f in plane.outbox_frames)
+        have: Counter = Counter()
+        for agent_id in rollup.agent_ids():
+            snap = rollup.agent_snapshot(agent_id)
+            have.update(snap["records_by_kind"])
+        ok = have == want
+        out.append(ExpectationResult(
+            "fleet", ok,
+            detail=(
+                f"per-kind counts match across {len(want)} kind(s)"
+                if ok
+                else f"per-kind mismatch: plane={dict(want)} rollup={dict(have)}"
+            ),
+        ))
+
+    if not out:
+        out.append(ExpectationResult("fleet", True, detail="no fleet assertion"))
+    return out
+
+
 def _eval_invariants(server, spec: Dict, ctx) -> List[ExpectationResult]:
     out = []
     reg = server.metrics_registry
@@ -558,6 +637,8 @@ def evaluate_phase(server, expect: Dict, ctx) -> List[ExpectationResult]:
         results.append(_eval_plane(server, expect["plane"] or {}, ctx))
     if "outbox" in expect:
         results.extend(_eval_outbox(server, expect["outbox"] or {}, ctx))
+    if "fleet" in expect:
+        results.extend(_eval_fleet(server, expect["fleet"] or {}, ctx))
     if "invariants" in expect:
         results.extend(_eval_invariants(server, expect["invariants"] or {}, ctx))
     return results

@@ -60,6 +60,21 @@ class FakeControlPlane:
         # (session/wire.py); reset on reconnect like the real manager's
         # per-connection AgentHandle decoder
         self._outbox_decoders: Dict[str, object] = {}
+        # optional fleet rollup store (manager/rollup.py): when attached,
+        # every fresh (deduped) record is forwarded exactly like the real
+        # control plane's AgentHandle.on_records hook, so chaos campaigns
+        # can assert rollup/ingest consistency (`fleet` expectations)
+        self.rollup = None
+
+    def attach_rollup(self):
+        """Attach an in-memory FleetRollupStore fed by the outbox ingest
+        path; returns the store. Synchronous writes (no BatchWriter) —
+        chaos asserts consistency, not throughput."""
+        from gpud_tpu.manager.rollup import FleetRollupStore
+        from gpud_tpu.sqlite import DB
+
+        self.rollup = FleetRollupStore(DB(":memory:"), writer=None)
+        return self.rollup
 
     # -- server ------------------------------------------------------------
     async def _login(self, req: web.Request) -> web.Response:
@@ -170,11 +185,24 @@ class FakeControlPlane:
             except (TypeError, ValueError):
                 return
             records = [data]
+        fresh = []
         for rec in records:
             key = str(rec.get("dedupe_key") or "")
             if key not in self.outbox_keys:
                 self.outbox_keys.add(key)
                 self.outbox_frames.append(rec)
+                fresh.append((
+                    rec.get("outbox_seq") or 0,
+                    rec.get("ts") or 0.0,
+                    rec.get("kind") or "",
+                    key,
+                    rec.get("payload"),
+                ))
+        if self.rollup is not None and fresh:
+            try:
+                self.rollup.ingest(machine or "chaos-agent", fresh)
+            except Exception:  # noqa: BLE001 — observability is best-effort
+                pass
         if ack_to > self.outbox_acked.get(machine, 0):
             self.outbox_acked[machine] = ack_to
         q = self.sessions.get(machine)

@@ -67,7 +67,7 @@ KNOWN_ACTIONS = (
 # expectation kinds evaluated after each phase (gpud_tpu/chaos/expectations.py)
 KNOWN_EXPECTATIONS = (
     "detect", "ledger", "remediation", "events", "invariants", "plane",
-    "outbox",
+    "outbox", "fleet",
 )
 
 MAX_STEP_OCCURRENCES = 1000  # per phase — runaway `count` backstop

@@ -742,6 +742,7 @@ def cmd_manager(args) -> int:
             grpc_port=args.grpc_port,
             session_token=args.session_token or None,
             admin_token=args.admin_token or None,
+            data_dir=args.data_dir or None,
         )
         # handlers go in before the endpoint line: the printed JSON is the
         # readiness contract, and a supervisor may SIGTERM immediately after
@@ -773,6 +774,59 @@ def cmd_manager(args) -> int:
     except Exception as e:  # noqa: BLE001 - CLI boundary: no tracebacks
         print(f"error: {e}", file=sys.stderr)
         return 1
+
+
+def cmd_fleet(args) -> int:
+    """Fleet observability against a manager's operator API: rollup
+    aggregates, paginated per-agent views, journaled history, and
+    correlation-id trace stitching (docs/fleet.md)."""
+    import json as _json
+
+    import requests
+
+    headers = {}
+    if args.admin_token:
+        headers["Authorization"] = f"Bearer {args.admin_token}"
+    base = args.endpoint.rstrip("/")
+
+    def get(path: str, params=None) -> Optional[dict]:
+        r = requests.get(
+            f"{base}{path}", headers=headers, params=params, timeout=30
+        )
+        if r.status_code != 200:
+            print(f"error {r.status_code}: {r.text}", file=sys.stderr)
+            return None
+        return r.json()
+
+    try:
+        if args.fleet_cmd == "rollup":
+            data = get("/v1/fleet/rollup")
+        elif args.fleet_cmd == "agents":
+            data = get(
+                "/v1/fleet/agents",
+                params={"offset": args.offset, "limit": args.limit},
+            )
+        elif args.fleet_cmd == "history":
+            params = {"limit": args.limit, "offset": args.offset}
+            if args.since:
+                params["since"] = args.since
+            data = get(
+                f"/v1/fleet/agents/{args.machine_id}/history", params=params
+            )
+        elif args.fleet_cmd == "traces":
+            data = get(
+                "/v1/fleet/traces",
+                params={"correlation_id": args.correlation_id},
+            )
+        else:
+            return 2
+    except Exception as e:  # noqa: BLE001 - CLI boundary: no tracebacks
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    if data is None:
+        return 1
+    print(_json.dumps(data, indent=2))
+    return 0
 
 
 def _manager_operator_cmd(args, requests, _json) -> int:
@@ -1033,6 +1087,9 @@ def build_parser() -> argparse.ArgumentParser:
     ms.add_argument("--grpc-port", type=int, default=15136)
     ms.add_argument("--session-token", default="")
     ms.add_argument("--admin-token", default="")
+    ms.add_argument("--data-dir", default="",
+                    help="persist the fleet rollup journal here "
+                         "(default: in-memory)")
     ms.set_defaults(fn=cmd_manager)
     mm = msub.add_parser("machines", help="list connected agents")
     mm.add_argument("--endpoint", default="http://127.0.0.1:15135")
@@ -1046,6 +1103,37 @@ def build_parser() -> argparse.ArgumentParser:
     mr.add_argument("--admin-token", default="")
     mr.add_argument("--timeout", type=float, default=30.0)
     mr.set_defaults(fn=cmd_manager)
+
+    pfl = sub.add_parser(
+        "fleet", help="fleet observability via a manager's operator API"
+    )
+    fsub = pfl.add_subparsers(dest="fleet_cmd", required=True)
+
+    def _fleet_common(sp) -> None:
+        sp.add_argument("--endpoint", default="http://127.0.0.1:15135")
+        sp.add_argument("--admin-token", default="")
+        sp.set_defaults(fn=cmd_fleet)
+
+    fr = fsub.add_parser("rollup", help="fleet-wide rollup aggregates")
+    _fleet_common(fr)
+    fa = fsub.add_parser("agents", help="paginated per-agent rollups")
+    fa.add_argument("--offset", type=int, default=0)
+    fa.add_argument("--limit", type=int, default=100)
+    _fleet_common(fa)
+    fh = fsub.add_parser(
+        "history", help="one agent's journaled records, newest first"
+    )
+    fh.add_argument("machine_id")
+    fh.add_argument("--since", type=float, default=0.0,
+                    help="unix-timestamp floor")
+    fh.add_argument("--offset", type=int, default=0)
+    fh.add_argument("--limit", type=int, default=100)
+    _fleet_common(fh)
+    ft = fsub.add_parser(
+        "traces", help="fleet records stitched to one check's trace"
+    )
+    ft.add_argument("correlation_id")
+    _fleet_common(ft)
 
     return p
 

@@ -40,10 +40,15 @@ class Client:
         base_url: str = "https://localhost:15132",
         timeout: float = DEFAULT_TIMEOUT,
         session: Optional[requests.Session] = None,
+        admin_token: str = "",
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.http = session or requests.Session()
+        if admin_token:
+            # manager operator endpoints (fleet rollup/history/traces)
+            # require the admin bearer when the manager is started with one
+            self.http.headers["Authorization"] = f"Bearer {admin_token}"
         self.http.verify = False
         # REQUESTS_CA_BUNDLE/CURL_CA_BUNDLE in the environment would
         # override verify=False on merge; the local API is always
@@ -232,3 +237,51 @@ class Client:
         connection + auth state, circuit breaker, and the
         store-and-forward outbox backlog/watermark."""
         return self._req("GET", "/v1/session/status")
+
+    # -- fleet observability (manager operator API) ------------------------
+    # These speak to a *manager* (manager/control_plane.py), not an agent:
+    # construct the Client with the manager's endpoint as base_url (and
+    # admin_token when the manager enforces one).
+
+    def get_fleet_rollup(self) -> Dict:
+        """Fleet-wide rollup aggregates (``GET /v1/fleet/rollup``):
+        availability, MTTR/MTBF, flap leaders, per-kind record counts."""
+        return self._req("GET", "/v1/fleet/rollup")
+
+    def get_fleet_agents(self, offset: int = 0, limit: int = 100) -> Dict:
+        """One paginated page of per-agent rollups
+        (``GET /v1/fleet/agents``); ``next_offset`` is None on the last
+        page."""
+        return self._req(
+            "GET", "/v1/fleet/agents",
+            params={"offset": offset, "limit": limit},
+        )
+
+    def get_fleet_history(
+        self,
+        agent_id: str,
+        since: Optional[float] = None,
+        limit: Optional[int] = None,
+        offset: Optional[int] = None,
+    ) -> Dict:
+        """One agent's journaled record history, newest first
+        (``GET /v1/fleet/agents/{id}/history``)."""
+        params = {}
+        if since is not None:
+            params["since"] = since
+        if limit is not None:
+            params["limit"] = limit
+        if offset is not None:
+            params["offset"] = offset
+        return self._req(
+            "GET", f"/v1/fleet/agents/{agent_id}/history",
+            params=params or None,
+        )
+
+    def get_fleet_traces(self, correlation_id: str) -> Dict:
+        """Every fleet record stitched to one agent-side check trace
+        (``GET /v1/fleet/traces?correlation_id=``)."""
+        return self._req(
+            "GET", "/v1/fleet/traces",
+            params={"correlation_id": correlation_id},
+        )

@@ -30,6 +30,7 @@ from gpud_tpu.api.v1.types import (
 )
 from gpud_tpu.log import get_logger
 from gpud_tpu.metrics.registry import counter, gauge, histogram
+from gpud_tpu import tracing
 from gpud_tpu.tracing import DEFAULT_TRACER
 
 if TYPE_CHECKING:  # avoid import cycles at runtime
@@ -253,42 +254,53 @@ class Component:
         span in the ring (sqlite leaves nest under it)."""
         t0 = time.monotonic()
         raised = False
-        with DEFAULT_TRACER.span("component.check", component=self.NAME) as sp:
-            try:
-                cr = self.check_once()
-            except Exception as e:  # noqa: BLE001 — health checks must not raise
-                raised = True
-                logger.exception("component %s check failed", self.NAME)
-                cr = CheckResult(
-                    component_name=self.NAME,
-                    health=HealthStateType.UNHEALTHY,
-                    reason=f"check failed: {e}",
-                    error=traceback.format_exc(limit=5),
-                )
-            sp.set_attr("health", cr.health)
-            if cr.reason:
-                sp.set_attr("reason", cr.reason[:200])
-            if raised:
-                sp.status = "error"
-                sp.error = cr.reason[:500]
-        duration = time.monotonic() - t0
-        ok = not raised and cr.health == HealthStateType.HEALTHY
-        _h_check_duration.observe(duration, {"component": self.NAME})
-        _c_checks.inc(
-            labels={
-                "component": self.NAME,
-                "status": "success" if ok else "failure",
-            }
-        )
-        _g_last_check.set(time.time(), {"component": self.NAME})
-        ledger = getattr(self.instance, "health_ledger", None)
-        if ledger is not None:
-            try:
-                annotations = ledger.observe(self.NAME, cr.health, cr.reason)
-                if annotations:
-                    cr.extra_info.update(annotations)
-            except Exception:  # noqa: BLE001 — accounting must not fail checks
-                logger.exception("health ledger observe failed for %s", self.NAME)
+        # one correlation id per check run: stamped on the root span AND
+        # held in the tracing thread-local across the ledger observe()
+        # below (which fires transition hooks after the span closes) —
+        # the outbox producers read it so the manager can stitch a fleet
+        # event back to this exact trace
+        cid = tracing.new_correlation_id()
+        tracing.set_correlation_id(cid)
+        try:
+            with DEFAULT_TRACER.span("component.check", component=self.NAME) as sp:
+                sp.set_attr("correlation_id", cid)
+                try:
+                    cr = self.check_once()
+                except Exception as e:  # noqa: BLE001 — health checks must not raise
+                    raised = True
+                    logger.exception("component %s check failed", self.NAME)
+                    cr = CheckResult(
+                        component_name=self.NAME,
+                        health=HealthStateType.UNHEALTHY,
+                        reason=f"check failed: {e}",
+                        error=traceback.format_exc(limit=5),
+                    )
+                sp.set_attr("health", cr.health)
+                if cr.reason:
+                    sp.set_attr("reason", cr.reason[:200])
+                if raised:
+                    sp.status = "error"
+                    sp.error = cr.reason[:500]
+            duration = time.monotonic() - t0
+            ok = not raised and cr.health == HealthStateType.HEALTHY
+            _h_check_duration.observe(duration, {"component": self.NAME})
+            _c_checks.inc(
+                labels={
+                    "component": self.NAME,
+                    "status": "success" if ok else "failure",
+                }
+            )
+            _g_last_check.set(time.time(), {"component": self.NAME})
+            ledger = getattr(self.instance, "health_ledger", None)
+            if ledger is not None:
+                try:
+                    annotations = ledger.observe(self.NAME, cr.health, cr.reason)
+                    if annotations:
+                        cr.extra_info.update(annotations)
+                except Exception:  # noqa: BLE001 — accounting must not fail checks
+                    logger.exception("health ledger observe failed for %s", self.NAME)
+        finally:
+            tracing.clear_correlation_id()
         self._last_check_duration = duration
         with self._last_mu:
             self._last_check_result = cr

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import queue
 import threading
 import time
@@ -79,6 +80,10 @@ class AgentHandle:
         self.outbox_records: List[dict] = []  # delivered frames, newest last
         self.outbox_records_max = 2048
         self.outbox_acked = 0
+        # fleet-plane hook: the ControlPlane points this at the rollup
+        # store's ingest so every fresh decoded record is journaled +
+        # rolled up; the handle itself stays transport-only
+        self.on_records = None
         self._ack_req_ids: "OrderedDict[str, bool]" = OrderedDict()
         # per-connection delta decoder for batched delivery frames: the
         # agent resets its encoder on reconnect, so a fresh handle always
@@ -211,6 +216,16 @@ class AgentHandle:
                 {"req_id": ack_req_id,
                  "data": {"method": "outboxAck", "seq": ack_seq}}
             )
+        # after the ack is queued: the agent's latency is not gated on
+        # journaling, and a rollup failure must not kill the transport
+        cb = self.on_records
+        if cb is not None and fresh:
+            try:
+                cb(self.machine_id, fresh)
+            except Exception:  # noqa: BLE001 — observability is best-effort
+                logger.exception(
+                    "%s: fleet rollup ingest failed", self.machine_id
+                )
 
     def mark_gone(self) -> None:
         self._gone.set()
@@ -249,6 +264,8 @@ class ControlPlane:
         session_token: Optional[str] = None,
         admin_token: Optional[str] = None,
         instance_id: Optional[str] = None,
+        data_dir: Optional[str] = None,
+        rollup_cache_ttl: float = 2.0,
     ) -> None:
         self.port = port
         self.grpc_port = grpc_port
@@ -289,9 +306,30 @@ class ControlPlane:
         self._op_pool = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="tpud-mgr-op"
         )
+        # fleet observability plane: journal + rollups behind the shared
+        # write-behind layer. data_dir=None keeps everything in memory
+        # (tests, dev) — same code path, no durability
+        from gpud_tpu.manager.rollup import FleetRollupStore
+        from gpud_tpu.sqlite import DB
+        from gpud_tpu.storage.writer import BatchWriter
+
+        self.data_dir = data_dir
+        db_path = ":memory:"
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            db_path = os.path.join(data_dir, "fleet.db")
+        self.db = DB(db_path)
+        self.writer = BatchWriter(self.db)
+        self.rollup = FleetRollupStore(
+            self.db, self.writer, cache_ttl_seconds=rollup_cache_ttl
+        )
+        self._scheduler = None
 
     # -- registry ----------------------------------------------------------
     def _register(self, handle: AgentHandle) -> None:
+        # point the transport's outbox hook at the rollup store before
+        # the handle is visible, so the very first frame is journaled
+        handle.on_records = self.rollup.ingest
         with self._lock:
             old = self.agents.get(handle.machine_id)
             if old is not None:
@@ -548,6 +586,95 @@ class ControlPlane:
         self.drain("operator drain")
         return web.json_response({"drained": True})
 
+    # -- fleet observability API -------------------------------------------
+    @staticmethod
+    def _q_num(request, name: str, default, caster):  # noqa: ANN001
+        raw = request.query.get(name)
+        if raw is None or raw == "":
+            return default
+        return caster(raw)
+
+    async def _fleet_rollup_route(self, request):  # noqa: ANN001
+        """Fleet-wide rollup aggregates (availability, MTTR/MTBF,
+        flapping, remediation outcomes)."""
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        data = await asyncio.get_event_loop().run_in_executor(
+            self._op_pool, self.rollup.fleet_rollup
+        )
+        return web.json_response(data)
+
+    async def _fleet_agents_route(self, request):  # noqa: ANN001
+        """One page of per-agent rollups (``?offset=&limit=``)."""
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        try:
+            offset = self._q_num(request, "offset", 0, int)
+            limit = self._q_num(request, "limit", 50, int)
+        except ValueError:
+            return web.Response(status=400, text="offset/limit must be integers")
+        data = await asyncio.get_event_loop().run_in_executor(
+            self._op_pool, lambda: self.rollup.agents_page(offset, limit)
+        )
+        return web.json_response(data)
+
+    async def _fleet_history_route(self, request):  # noqa: ANN001
+        """Journaled record timeline for one agent
+        (``?since=&limit=&offset=``), newest first."""
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        agent_id = request.match_info["agent_id"]
+        try:
+            since = self._q_num(request, "since", 0.0, float)
+            limit = self._q_num(request, "limit", 100, int)
+            offset = self._q_num(request, "offset", 0, int)
+        except ValueError:
+            return web.Response(status=400, text="since/limit/offset must be numbers")
+        data = await asyncio.get_event_loop().run_in_executor(
+            self._op_pool,
+            lambda: self.rollup.history(agent_id, since, limit, offset),
+        )
+        return web.json_response(data)
+
+    async def _fleet_traces_route(self, request):  # noqa: ANN001
+        """Fleet records stitched to one agent-side check trace
+        (``?correlation_id=``)."""
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        cid = request.query.get("correlation_id", "")
+        if not cid:
+            return web.Response(status=400, text="correlation_id is required")
+        try:
+            limit = self._q_num(request, "limit", 200, int)
+        except ValueError:
+            return web.Response(status=400, text="limit must be an integer")
+        data = await asyncio.get_event_loop().run_in_executor(
+            self._op_pool, lambda: self.rollup.traces(cid, limit)
+        )
+        return web.json_response(data)
+
+    async def _metrics_route(self, request):  # noqa: ANN001
+        """Federated Prometheus exposition: manager registry + bounded
+        per-agent fleet series. Unauthenticated, like the node /metrics."""
+        from aiohttp import web
+
+        from gpud_tpu.manager.exposition import render_fleet_metrics
+
+        body = await asyncio.get_event_loop().run_in_executor(
+            self._op_pool, lambda: render_fleet_metrics(self.rollup)
+        )
+        return web.Response(
+            text=body, content_type="text/plain", charset="utf-8"
+        )
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         """One-shot: after stop() (including the internal cleanup stop on
@@ -579,6 +706,21 @@ class ControlPlane:
             "/v1/machines/{machine_id}/request", self._request_route
         )
         app.router.add_post("/v1/drain", self._drain_route)
+        app.router.add_get("/v1/fleet/rollup", self._fleet_rollup_route)
+        app.router.add_get("/v1/fleet/agents", self._fleet_agents_route)
+        app.router.add_get(
+            "/v1/fleet/agents/{agent_id}/history", self._fleet_history_route
+        )
+        app.router.add_get("/v1/fleet/traces", self._fleet_traces_route)
+        app.router.add_get("/metrics", self._metrics_route)
+
+        # the writer needs a periodic drain job (threshold pokes are
+        # no-ops without one); the manager owns a one-worker scheduler
+        from gpud_tpu.scheduler.core import Scheduler
+
+        self._scheduler = Scheduler(workers=1)
+        self.writer.start(self._scheduler)
+        self._scheduler.start()
 
         def run() -> None:
             loop = asyncio.new_event_loop()
@@ -813,6 +955,15 @@ class ControlPlane:
             self._thread = None
         self._stream_pool.shutdown(wait=False, cancel_futures=True)
         self._op_pool.shutdown(wait=False, cancel_futures=True)
+        # storage last: the final writer.close() barrier commits whatever
+        # the torn-down transports journaled on their way out
+        if self._scheduler is not None:
+            self._scheduler.close()
+            self._scheduler = None
+        try:
+            self.writer.close()
+        finally:
+            self.db.close()
 
     @property
     def endpoint(self) -> str:

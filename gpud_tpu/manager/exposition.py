@@ -1,0 +1,125 @@
+"""Federated Prometheus exposition for the manager's ``/metrics``.
+
+One scrape of the manager covers the pod: the global registry's own
+series (tpud_fleet_*, tpud_storage_*, session counters) plus hand-
+rendered per-agent series derived from the fleet rollup store. The
+per-agent block is the only place an ``agent`` label exists, and its
+cardinality is bounded twice:
+
+- at most ``max_agents`` agents are rendered (sorted ids, so the set
+  is stable between scrapes); the remainder is surfaced as one
+  ``tpud_fleet_exposition_truncated_agents`` gauge instead of being
+  silently dropped;
+- a fixed, small family set per agent (availability, flap count,
+  outbox lag, transitions, unhealthy series) — per-(agent, component)
+  series are deliberately NOT exposed; that cross-product is what
+  blows up federation (docs/fleet.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List
+
+from gpud_tpu.metrics.registry import (
+    DEFAULT_REGISTRY,
+    gauge,
+    histogram,
+)
+
+DEFAULT_MAX_AGENTS = 1000
+
+_h_scrape = histogram(
+    "tpud_fleet_scrape_seconds",
+    "wall time to render the manager's federated /metrics response",
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0),
+)
+_g_exposed_series = gauge(
+    "tpud_fleet_exposition_series",
+    "per-agent series rendered in the last federated /metrics response",
+)
+_g_truncated = gauge(
+    "tpud_fleet_exposition_truncated_agents",
+    "agents omitted from the last federated /metrics response by the "
+    "cardinality cap",
+)
+
+# the fixed per-agent family set: (suffix-free name, help, value extractor)
+_AGENT_FAMILIES = (
+    ("tpud_fleet_agent_availability_ratio",
+     "healthy share of observed time across the agent's components",
+     "availability"),
+    ("tpud_fleet_agent_flap_count",
+     "state transitions across the agent's components in the flap window",
+     "flap_count"),
+    ("tpud_fleet_agent_outbox_lag_seconds",
+     "manager ingest wall clock minus the agent's newest record timestamp",
+     "outbox_lag_seconds"),
+    ("tpud_fleet_agent_transitions",
+     "health-state transitions journaled for the agent, all components",
+     "transitions"),
+    ("tpud_fleet_agent_unhealthy_series",
+     "the agent's components currently in a non-Healthy state",
+     "unhealthy_series"),
+)
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def render_fleet_metrics(rollup_store, max_agents: int = DEFAULT_MAX_AGENTS) -> str:
+    """The manager's full /metrics body: global registry + bounded
+    per-agent federation block."""
+    t0 = time.monotonic()
+    parts: List[str] = [DEFAULT_REGISTRY.render_prometheus()]
+    # walk the paginated view (cached + flush-barriered like any other
+    # operator read) instead of a private fast path
+    rows = []
+    offset = 0
+    total = None
+    while len(rows) < max_agents:
+        page = rollup_store.agents_page(
+            offset, min(500, max_agents - len(rows))
+        )
+        total = page["total"]
+        for a in page["agents"]:
+            comps = list(a["components"].values())
+            rows.append({
+                "agent": a["agent"],
+                "availability": (
+                    sum(c["availability"] for c in comps) / len(comps)
+                    if comps else 1.0
+                ),
+                "flap_count": sum(c["flap_count"] for c in comps),
+                "outbox_lag_seconds": a["outbox_lag_seconds"],
+                "transitions": sum(c["transitions"] for c in comps),
+                "unhealthy_series": sum(
+                    1 for c in comps if c["state"] and c["state"] != "Healthy"
+                ),
+            })
+        if page["next_offset"] is None:
+            break
+        offset = page["next_offset"]
+    _g_truncated.set(max(0, (total or 0) - len(rows)))
+    series = 0
+    if rows:
+        for name, help_text, field in _AGENT_FAMILIES:
+            lines = [f"# HELP {name} {help_text}", f"# TYPE {name} gauge"]
+            for row in rows:
+                lines.append(
+                    f'{name}{{agent="{_escape(row["agent"])}"}} '
+                    f'{_fmt(row[field])}'
+                )
+                series += 1
+            parts.append("\n".join(lines) + "\n")
+    _g_exposed_series.set(series)
+    _h_scrape.observe(time.monotonic() - t0)
+    return "".join(parts)
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)

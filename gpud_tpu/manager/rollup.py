@@ -1,0 +1,672 @@
+"""Fleet rollup store: the manager-side aggregation layer.
+
+Every observability surface below this file is per-node (ledger,
+remediation audit, trace ring, outbox). The manager ingests all of it
+but — before this store — only kept a bounded in-memory record buffer
+per agent, so it could not answer a single fleet-level question
+("which nodes flapped this week", "fleet availability", "MTTR across
+the pod"). PAPERS.md ("Host-Side Telemetry", "When GPUs Fail Quietly")
+argues diagnosis lives at the aggregation layer: the signals that
+matter are cross-node patterns invisible to any one agent.
+
+Design:
+
+- **Durable journal, derived rollups.** Every ingested outbox record
+  lands in one append-only journal table via the PR-7 ``BatchWriter``
+  (group commit; ``INSERT OR IGNORE`` on ``UNIQUE(agent, dedupe_key)``
+  makes replay after reconnect idempotent at the storage layer). The
+  per-agent/per-component rollups (availability, MTTR/MTBF, flap
+  counts, transition cadence, remediation outcomes, outbox lag) are
+  *derived* state: incrementally updated in memory on ingest and
+  rebuilt from the journal at construction — a SIGKILL can lose at
+  most the writer's durability window and can never tear an aggregate,
+  because aggregates are never persisted, only recomputed.
+- **Read-your-own-writes.** Every read path runs the writer's
+  ``flush()`` barrier before touching SQLite, so batching is invisible
+  to operators.
+- **TTL + generation cache.** Rollup/pagination responses are cached
+  per query-shape. An entry is served only while its TTL holds AND no
+  ingest has advanced the store generation — writes invalidate
+  immediately (read-after-write), the TTL bounds entry lifetime when
+  the fleet is quiet.
+- **Correlation stitching.** Records whose payload carries a
+  ``correlation_id`` (minted by the agent's check wrapper and stamped
+  on its trace span) are indexed by it, so one id resolves to every
+  fleet event the originating check produced.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import Counter as _Counter
+from collections import OrderedDict, deque
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from gpud_tpu.log import get_logger
+from gpud_tpu.metrics.registry import counter, gauge, histogram
+from gpud_tpu.session import wire
+
+logger = get_logger(__name__)
+
+TABLE = "tpud_fleet_journal_v0_1"
+
+# storage_lint contract: these methods route their hot-path persistence
+# through the BatchWriter (sync DB fallback only under a writer guard)
+HOT_WRITE_METHODS = ("ingest",)
+
+DEFAULT_CACHE_TTL = 2.0          # seconds a cached read stays servable
+DEFAULT_DEDUPE_KEYS = 8192       # per-agent in-memory replay suppression
+DEFAULT_RECENT_TRANSITIONS = 64  # per-series window for flap/cadence
+DEFAULT_FLAP_WINDOW = 3600.0     # seconds a transition counts as a flap
+DEFAULT_MAX_JOURNAL_ROWS = 500_000
+
+_INSERT_SQL = (
+    f"INSERT OR IGNORE INTO {TABLE} "
+    "(agent, seq, ts, ingested, kind, dedupe_key, correlation_id, payload) "
+    "VALUES (?, ?, ?, ?, ?, ?, ?, ?)"
+)
+
+_c_records = counter(
+    "tpud_fleet_ingest_records_total",
+    "outbox records accepted into the fleet journal, by kind",
+)
+_c_duplicates = counter(
+    "tpud_fleet_ingest_duplicates_total",
+    "replayed outbox records suppressed by fleet ingest dedupe",
+)
+_g_ingest_lag = gauge(
+    "tpud_fleet_ingest_lag_seconds",
+    "age of the most recently ingested outbox record "
+    "(manager wall clock minus record timestamp)",
+)
+_h_refresh = histogram(
+    "tpud_fleet_rollup_refresh_seconds",
+    "wall time to materialize one fleet rollup response (cache miss path)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+             0.1, 0.25, 0.5, 1.0, 2.5),
+)
+_c_cache_hits = counter(
+    "tpud_fleet_cache_hits_total",
+    "fleet operator-API reads served from the TTL cache",
+)
+_c_cache_misses = counter(
+    "tpud_fleet_cache_misses_total",
+    "fleet operator-API reads that had to materialize (barrier + compute)",
+)
+_g_agents = gauge(
+    "tpud_fleet_agents",
+    "agents with at least one journaled record in the fleet rollup store",
+)
+_g_series = gauge(
+    "tpud_fleet_agent_series",
+    "distinct (agent, component) rollup series held in memory",
+)
+
+
+class _SeriesRollup:
+    """Incremental per-(agent, component) health rollup."""
+
+    __slots__ = (
+        "state", "since", "first_ts", "last_ts", "transitions",
+        "healthy_seconds", "unhealthy_seconds",
+        "repair_total", "repair_count",
+        "tbf_total", "tbf_count", "last_failure_ts", "failures",
+        "recent",
+    )
+
+    def __init__(self) -> None:
+        self.state = ""
+        self.since = 0.0
+        self.first_ts = 0.0
+        self.last_ts = 0.0
+        self.transitions = 0
+        self.healthy_seconds = 0.0
+        self.unhealthy_seconds = 0.0
+        self.repair_total = 0.0     # completed unhealthy-episode downtime
+        self.repair_count = 0
+        self.tbf_total = 0.0        # gaps between consecutive failures
+        self.tbf_count = 0
+        self.last_failure_ts = 0.0
+        self.failures = 0
+        self.recent: deque = deque(maxlen=DEFAULT_RECENT_TRANSITIONS)
+
+    def apply(self, from_state: str, to_state: str, ts: float) -> None:
+        if not self.first_ts:
+            self.first_ts = ts
+        # close the open interval in the previous state
+        if self.state and ts > self.since:
+            dt = ts - self.since
+            if self.state == "Healthy":
+                self.healthy_seconds += dt
+            else:
+                self.unhealthy_seconds += dt
+        prev = self.state or from_state
+        self.transitions += 1
+        self.recent.append(ts)
+        if to_state != "Healthy" and (not prev or prev == "Healthy"):
+            self.failures += 1
+            if self.last_failure_ts:
+                self.tbf_total += ts - self.last_failure_ts
+                self.tbf_count += 1
+            self.last_failure_ts = ts
+        if to_state == "Healthy" and prev and prev != "Healthy" and self.since:
+            self.repair_total += max(0.0, ts - self.since)
+            self.repair_count += 1
+        self.state = to_state
+        self.since = ts
+        if ts > self.last_ts:
+            self.last_ts = ts
+
+    def snapshot(self, as_of: float) -> Dict:
+        healthy = self.healthy_seconds
+        unhealthy = self.unhealthy_seconds
+        # count the open interval up to the newest timestamp we trust
+        if self.state and as_of > self.since:
+            if self.state == "Healthy":
+                healthy += as_of - self.since
+            else:
+                unhealthy += as_of - self.since
+        total = healthy + unhealthy
+        flap_cutoff = as_of - DEFAULT_FLAP_WINDOW
+        flaps = sum(1 for t in self.recent if t >= flap_cutoff)
+        cadence = 0.0
+        if len(self.recent) >= 2:
+            span = self.recent[-1] - self.recent[0]
+            if span > 0:
+                cadence = span / (len(self.recent) - 1)
+        return {
+            "state": self.state,
+            "since": self.since,
+            "transitions": self.transitions,
+            "availability": (healthy / total) if total > 0 else 1.0,
+            "healthy_seconds": healthy,
+            "unhealthy_seconds": unhealthy,
+            "mttr_seconds": (
+                self.repair_total / self.repair_count if self.repair_count else 0.0
+            ),
+            "mtbf_seconds": (
+                self.tbf_total / self.tbf_count if self.tbf_count else 0.0
+            ),
+            "failures": self.failures,
+            "flap_count": flaps,
+            "transition_cadence_seconds": cadence,
+        }
+
+
+class _AgentRollup:
+    """Per-agent aggregate over everything that agent's outbox shipped."""
+
+    __slots__ = (
+        "records_by_kind", "last_seq", "last_ts", "last_ingest",
+        "outbox_lag_seconds", "remediation_outcomes", "series",
+    )
+
+    def __init__(self) -> None:
+        self.records_by_kind: _Counter = _Counter()
+        self.last_seq = 0
+        self.last_ts = 0.0
+        self.last_ingest = 0.0
+        self.outbox_lag_seconds = 0.0
+        self.remediation_outcomes: _Counter = _Counter()
+        self.series: Dict[str, _SeriesRollup] = {}
+
+
+class FleetRollupStore:
+    """Manager-side fleet journal + materialized rollups (module docstring).
+
+    Thread-safe: ``ingest`` may be called from any agent connection's
+    reader thread; reads run on the operator pool. The in-memory state
+    is guarded by one lock; SQLite work happens outside it.
+    """
+
+    def __init__(
+        self,
+        db,
+        writer=None,
+        cache_ttl_seconds: float = DEFAULT_CACHE_TTL,
+        dedupe_keys_max: int = DEFAULT_DEDUPE_KEYS,
+        max_journal_rows: int = DEFAULT_MAX_JOURNAL_ROWS,
+    ) -> None:
+        self.db = db
+        self.writer = writer
+        self.cache_ttl = float(cache_ttl_seconds)
+        self.dedupe_keys_max = int(dedupe_keys_max)
+        self.max_journal_rows = int(max_journal_rows)
+        self._lock = threading.Lock()
+        self._agents: Dict[str, _AgentRollup] = {}
+        self._dedupe: Dict[str, OrderedDict] = {}
+        self._generation = 0
+        self._records_total = 0
+        self._duplicates_total = 0
+        # cache key -> (generation, monotonic deadline, value)
+        self._cache: Dict[tuple, tuple] = {}
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._ensure_schema()
+        self._rebuild()
+
+    # -- schema / rebuild --------------------------------------------------
+    def _ensure_schema(self) -> None:
+        self.db.execute(
+            f"""CREATE TABLE IF NOT EXISTS {TABLE} (
+                agent          TEXT NOT NULL,
+                seq            INTEGER NOT NULL,
+                ts             REAL NOT NULL,
+                ingested       REAL NOT NULL,
+                kind           TEXT NOT NULL,
+                dedupe_key     TEXT NOT NULL,
+                correlation_id TEXT NOT NULL DEFAULT '',
+                payload        BLOB,
+                UNIQUE (agent, dedupe_key)
+            )"""
+        )
+        self.db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_fleet_agent_ts "
+            f"ON {TABLE} (agent, ts)"
+        )
+        self.db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_fleet_correlation "
+            f"ON {TABLE} (correlation_id) WHERE correlation_id != ''"
+        )
+
+    def _rebuild(self) -> None:
+        """Recompute every rollup from the journal (boot / crash recovery).
+
+        The journal is the only durable state; aggregates are a pure
+        function of it, so a SIGKILL between group commits can shorten
+        the journal but never tear a rollup."""
+        rows = self.db.query(
+            f"SELECT agent, seq, ts, ingested, kind, dedupe_key, payload "
+            f"FROM {TABLE} ORDER BY agent, ts, seq"
+        )
+        with self._lock:
+            self._agents.clear()
+            self._dedupe.clear()
+            self._records_total = 0
+            for agent, seq, ts, ingested, kind, key, payload in rows:
+                body = wire.unpack_obj(payload) if payload is not None else {}
+                self._apply_locked(agent, seq, ts, ingested, kind, key, body)
+            self._generation += 1
+            self._cache.clear()
+            self._update_gauges_locked()
+        if rows:
+            logger.info(
+                "fleet rollup store rebuilt from journal: %d records, "
+                "%d agents", len(rows), len(self._agents),
+            )
+
+    # -- ingest ------------------------------------------------------------
+    def ingest(
+        self,
+        agent_id: str,
+        records: Iterable[Tuple[int, float, str, str, object]],
+        now: Optional[float] = None,
+    ) -> int:
+        """Journal + roll up a batch of decoded outbox records.
+
+        ``records`` is the decoder's output shape: ``(seq, ts, kind,
+        dedupe_key, payload)`` tuples. Replays are suppressed twice —
+        a bounded per-agent key LRU here (protects the in-memory
+        aggregates) and ``INSERT OR IGNORE`` in the journal (protects
+        durable state even past the LRU window). Returns the number of
+        fresh records applied."""
+        wall = time.time() if now is None else now
+        rows: List[tuple] = []
+        fresh: List[tuple] = []
+        with self._lock:
+            seen = self._dedupe.get(agent_id)
+            if seen is None:
+                seen = self._dedupe[agent_id] = OrderedDict()
+            for seq, ts, kind, key, payload in records:
+                key = key or f"seq:{seq}"
+                if key in seen:
+                    seen.move_to_end(key)
+                    self._duplicates_total += 1
+                    _c_duplicates.inc()
+                    continue
+                seen[key] = None
+                while len(seen) > self.dedupe_keys_max:
+                    seen.popitem(last=False)
+                body = payload if isinstance(payload, dict) else {}
+                cid = str(body.get("correlation_id", "") or "")
+                rows.append(
+                    (agent_id, seq, ts, wall, kind, key, cid,
+                     wire.pack_obj(payload))
+                )
+                fresh.append((seq, ts, kind, key, body))
+            for seq, ts, kind, key, body in fresh:
+                self._apply_locked(agent_id, seq, ts, wall, kind, key, body)
+            if fresh:
+                self._generation += 1
+                self._update_gauges_locked()
+        if not rows:
+            return 0
+        if self.writer is not None:
+            self.writer.submit_many("fleet", _INSERT_SQL, rows)
+        else:
+            self.db.executemany(_INSERT_SQL, rows)
+        for _, ts, kind, _, _ in fresh:
+            _c_records.inc(labels={"kind": kind})
+        _g_ingest_lag.set(max(0.0, wall - fresh[-1][1]))
+        return len(fresh)
+
+    def _apply_locked(
+        self, agent_id: str, seq: int, ts: float, ingested: float,
+        kind: str, key: str, body: Dict,
+    ) -> None:
+        ar = self._agents.get(agent_id)
+        if ar is None:
+            ar = self._agents[agent_id] = _AgentRollup()
+        ar.records_by_kind[kind] += 1
+        self._records_total += 1
+        if seq > ar.last_seq:
+            ar.last_seq = seq
+        if ts >= ar.last_ts:
+            # lag is anchored to the newest record by *record* time, so a
+            # replayed old record can't make a caught-up agent look laggy
+            ar.last_ts = ts
+            ar.outbox_lag_seconds = max(0.0, ingested - ts)
+        if ingested > ar.last_ingest:
+            ar.last_ingest = ingested
+        if kind == "transition":
+            comp = str(body.get("component", "") or "_unknown")
+            sr = ar.series.get(comp)
+            if sr is None:
+                sr = ar.series[comp] = _SeriesRollup()
+            sr.apply(
+                str(body.get("from", "") or ""),
+                str(body.get("to", "") or ""),
+                float(body.get("ts", ts) or ts),
+            )
+        elif kind == "remediation_audit":
+            ar.remediation_outcomes[str(body.get("outcome", "") or "unknown")] += 1
+
+    def _update_gauges_locked(self) -> None:
+        _g_agents.set(len(self._agents))
+        _g_series.set(sum(len(a.series) for a in self._agents.values()))
+
+    # -- cache plumbing ----------------------------------------------------
+    def _barrier(self) -> None:
+        if self.writer is not None:
+            self.writer.flush()
+
+    def _cached(self, key: tuple, compute) -> object:
+        now = time.monotonic()
+        with self._lock:
+            ent = self._cache.get(key)
+            if ent is not None and ent[0] == self._generation and now < ent[1]:
+                self._cache_hits += 1
+                _c_cache_hits.inc()
+                return ent[2]
+            gen = self._generation
+            self._cache_misses += 1
+        _c_cache_misses.inc()
+        # miss path: barrier first so SQLite-backed computations see
+        # every record journaled before this read began
+        self._barrier()
+        with _h_refresh.time():
+            value = compute()
+        with self._lock:
+            # only cache what was computed against the still-current
+            # generation — an ingest racing the compute wins
+            if gen == self._generation:
+                self._cache[key] = (gen, time.monotonic() + self.cache_ttl, value)
+        return value
+
+    def invalidate_cache(self) -> None:
+        with self._lock:
+            self._cache.clear()
+            self._generation += 1
+
+    def cache_stats(self) -> Dict:
+        with self._lock:
+            return {
+                "hits": self._cache_hits,
+                "misses": self._cache_misses,
+                "entries": len(self._cache),
+                "generation": self._generation,
+            }
+
+    # -- read paths --------------------------------------------------------
+    def fleet_rollup(self) -> Dict:
+        """Fleet-wide aggregates (``GET /v1/fleet/rollup``)."""
+        return self._cached(("rollup",), self._compute_fleet_rollup)
+
+    def _compute_fleet_rollup(self) -> Dict:
+        with self._lock:
+            agents = {aid: ar for aid, ar in self._agents.items()}
+            gen = self._generation
+            records_total = self._records_total
+            duplicates = self._duplicates_total
+        by_kind: _Counter = _Counter()
+        remediation: _Counter = _Counter()
+        transitions = 0
+        failures = 0
+        repair_total = 0.0
+        repair_count = 0
+        tbf_total = 0.0
+        tbf_count = 0
+        healthy = 0.0
+        unhealthy = 0.0
+        series = 0
+        unhealthy_now = 0
+        flapping: List[Dict] = []
+        max_lag = 0.0
+        for aid, ar in sorted(agents.items()):
+            by_kind.update(ar.records_by_kind)
+            remediation.update(ar.remediation_outcomes)
+            max_lag = max(max_lag, ar.outbox_lag_seconds)
+            as_of = ar.last_ts
+            for comp, sr in sorted(ar.series.items()):
+                series += 1
+                snap = sr.snapshot(as_of)
+                transitions += sr.transitions
+                failures += sr.failures
+                repair_total += sr.repair_total
+                repair_count += sr.repair_count
+                tbf_total += sr.tbf_total
+                tbf_count += sr.tbf_count
+                healthy += snap["healthy_seconds"]
+                unhealthy += snap["unhealthy_seconds"]
+                if snap["state"] and snap["state"] != "Healthy":
+                    unhealthy_now += 1
+                if snap["flap_count"] >= 3:
+                    flapping.append(
+                        {"agent": aid, "component": comp,
+                         "flap_count": snap["flap_count"]}
+                    )
+        flapping.sort(key=lambda f: -f["flap_count"])
+        observed = healthy + unhealthy
+        return {
+            "generation": gen,
+            "agents": len(agents),
+            "series": series,
+            "records_total": records_total,
+            "records_by_kind": dict(by_kind),
+            "duplicates_suppressed": duplicates,
+            "transitions_total": transitions,
+            "failures_total": failures,
+            "unhealthy_series": unhealthy_now,
+            "availability": (healthy / observed) if observed > 0 else 1.0,
+            "mttr_seconds": (repair_total / repair_count) if repair_count else 0.0,
+            "mtbf_seconds": (tbf_total / tbf_count) if tbf_count else 0.0,
+            "remediation_outcomes": dict(remediation),
+            "flapping": flapping[:32],
+            "max_outbox_lag_seconds": max_lag,
+        }
+
+    def agents_page(self, offset: int = 0, limit: int = 50) -> Dict:
+        """One page of per-agent rollups (``GET /v1/fleet/agents``)."""
+        offset = max(0, int(offset))
+        limit = max(1, min(500, int(limit)))
+        return self._cached(
+            ("agents", offset, limit),
+            lambda: self._compute_agents_page(offset, limit),
+        )
+
+    def _compute_agents_page(self, offset: int, limit: int) -> Dict:
+        with self._lock:
+            ids = sorted(self._agents)
+            page_ids = ids[offset:offset + limit]
+            rollups = []
+            for aid in page_ids:
+                ar = self._agents[aid]
+                as_of = ar.last_ts
+                rollups.append({
+                    "agent": aid,
+                    "last_seq": ar.last_seq,
+                    "last_record_ts": ar.last_ts,
+                    "last_ingest": ar.last_ingest,
+                    "outbox_lag_seconds": ar.outbox_lag_seconds,
+                    "records_by_kind": dict(ar.records_by_kind),
+                    "remediation_outcomes": dict(ar.remediation_outcomes),
+                    "components": {
+                        comp: sr.snapshot(as_of)
+                        for comp, sr in sorted(ar.series.items())
+                    },
+                })
+            total = len(ids)
+        next_offset = offset + len(rollups)
+        return {
+            "agents": rollups,
+            "total": total,
+            "offset": offset,
+            "limit": limit,
+            "next_offset": next_offset if next_offset < total else None,
+        }
+
+    def agent_snapshot(self, agent_id: str) -> Optional[Dict]:
+        """Uncached single-agent rollup (expectation checks, tests)."""
+        with self._lock:
+            ar = self._agents.get(agent_id)
+            if ar is None:
+                return None
+            as_of = ar.last_ts
+            return {
+                "agent": agent_id,
+                "last_seq": ar.last_seq,
+                "records_by_kind": dict(ar.records_by_kind),
+                "remediation_outcomes": dict(ar.remediation_outcomes),
+                "components": {
+                    comp: sr.snapshot(as_of)
+                    for comp, sr in sorted(ar.series.items())
+                },
+            }
+
+    def history(
+        self,
+        agent_id: str,
+        since: float = 0.0,
+        limit: int = 100,
+        offset: int = 0,
+    ) -> Dict:
+        """Journaled record timeline for one agent
+        (``GET /v1/fleet/agents/{id}/history``), newest first."""
+        since = float(since)
+        limit = max(1, min(1000, int(limit)))
+        offset = max(0, int(offset))
+        return self._cached(
+            ("history", agent_id, since, limit, offset),
+            lambda: self._compute_history(agent_id, since, limit, offset),
+        )
+
+    def _compute_history(
+        self, agent_id: str, since: float, limit: int, offset: int
+    ) -> Dict:
+        total_row = self.db.query_one(
+            f"SELECT COUNT(*) FROM {TABLE} WHERE agent = ? AND ts >= ?",
+            (agent_id, since),
+        )
+        rows = self.db.query(
+            f"SELECT seq, ts, ingested, kind, dedupe_key, correlation_id, "
+            f"payload FROM {TABLE} WHERE agent = ? AND ts >= ? "
+            f"ORDER BY ts DESC, seq DESC LIMIT ? OFFSET ?",
+            (agent_id, since, limit, offset),
+        )
+        records = [_record_dict(r) for r in rows]
+        total = int(total_row[0]) if total_row else 0
+        next_offset = offset + len(records)
+        return {
+            "agent": agent_id,
+            "records": records,
+            "total": total,
+            "offset": offset,
+            "limit": limit,
+            "next_offset": next_offset if next_offset < total else None,
+        }
+
+    def traces(self, correlation_id: str, limit: int = 200) -> Dict:
+        """Every journaled fleet record stitched to one agent-side check
+        trace (``GET /v1/fleet/traces?correlation_id=``)."""
+        correlation_id = str(correlation_id)
+        limit = max(1, min(1000, int(limit)))
+        return self._cached(
+            ("traces", correlation_id, limit),
+            lambda: self._compute_traces(correlation_id, limit),
+        )
+
+    def _compute_traces(self, correlation_id: str, limit: int) -> Dict:
+        rows = self.db.query(
+            f"SELECT agent, seq, ts, ingested, kind, dedupe_key, "
+            f"correlation_id, payload FROM {TABLE} "
+            f"WHERE correlation_id = ? ORDER BY ts, seq LIMIT ?",
+            (correlation_id, limit),
+        )
+        records = []
+        for r in rows:
+            d = _record_dict(r[1:])
+            d["agent"] = r[0]
+            records.append(d)
+        return {
+            "correlation_id": correlation_id,
+            "records": records,
+            "count": len(records),
+        }
+
+    # -- maintenance -------------------------------------------------------
+    def purge(self) -> int:
+        """Bound the journal: delete the oldest rows past
+        ``max_journal_rows``. Rollups are NOT rebuilt — they summarize
+        all history ever ingested; the journal bound only caps what a
+        rebuild can recover (documented in docs/fleet.md)."""
+        self._barrier()
+        row = self.db.query_one(f"SELECT COUNT(*) FROM {TABLE}")
+        total = int(row[0]) if row else 0
+        excess = total - self.max_journal_rows
+        if excess <= 0:
+            return 0
+        self.db.execute(
+            f"DELETE FROM {TABLE} WHERE rowid IN "
+            f"(SELECT rowid FROM {TABLE} ORDER BY ts, seq LIMIT ?)",
+            (excess,),
+        )
+        logger.info("fleet journal purged %d rows (cap %d)",
+                    excess, self.max_journal_rows)
+        return excess
+
+    def journal_count(self) -> int:
+        self._barrier()
+        row = self.db.query_one(f"SELECT COUNT(*) FROM {TABLE}")
+        return int(row[0]) if row else 0
+
+    def records_total(self) -> int:
+        with self._lock:
+            return self._records_total
+
+    def agent_ids(self) -> List[str]:
+        with self._lock:
+            return sorted(self._agents)
+
+
+def _record_dict(row) -> Dict:
+    seq, ts, ingested, kind, key, cid, payload = row
+    return {
+        "seq": seq,
+        "ts": ts,
+        "ingested": ingested,
+        "kind": kind,
+        "dedupe_key": key,
+        "correlation_id": cid,
+        "payload": wire.unpack_obj(payload) if payload is not None else None,
+    }

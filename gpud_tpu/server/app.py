@@ -362,9 +362,12 @@ def build_app(srv: "Server") -> web.Application:
 
     async def debug_traces(req: web.Request) -> web.Response:
         """Recent spans from the in-process trace ring, newest first
-        (?component= filters, ?since= unix-ts floor, ?limit= caps; see
-        docs/observability.md). Malformed numeric params are a 400."""
+        (?component= filters, ?since= unix-ts floor, ?limit= caps,
+        ?correlation_id= matches the id a check run stamped on its root
+        span; see docs/observability.md). Malformed numeric params are a
+        400."""
         component = req.query.get("component", "") or None
+        correlation_id = req.query.get("correlation_id", "") or None
         limit = int(_qfloat(req, "limit", DEFAULT_TRACES_LIMIT))
         if limit < 0:
             limit = DEFAULT_TRACES_LIMIT
@@ -373,7 +376,8 @@ def build_app(srv: "Server") -> web.Application:
         return _json(
             {
                 "spans": srv.tracer.snapshot(
-                    component=component, limit=limit, since=since
+                    component=component, limit=limit, since=since,
+                    correlation_id=correlation_id,
                 ),
                 "stats": stats,
                 # surfaced at the envelope level: a consumer paging the ring

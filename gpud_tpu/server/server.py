@@ -358,19 +358,30 @@ class Server:
         journal: events, health transitions, remediation audit rows, and
         chaos campaign results (gossip publishes from its dispatch
         worker). Dedupe keys are derived from each record's natural
-        identity so the manager can collapse at-least-once redeliveries."""
+        identity so the manager can collapse at-least-once redeliveries.
+
+        Event/transition hooks fire synchronously on the check thread
+        that produced them, so the check wrapper's correlation id is
+        readable from the tracing thread-local — it rides the record to
+        the manager, which serves it back at /v1/fleet/traces."""
+        from gpud_tpu.tracing import current_correlation_id
+
         outbox = self.outbox
 
         def on_event(component: str, ev) -> None:
+            body = {
+                "component": component,
+                "time": ev.time,
+                "name": ev.name,
+                "type": ev.type,
+                "message": ev.message,
+            }
+            cid = current_correlation_id()
+            if cid:
+                body["correlation_id"] = cid
             outbox.publish(
                 "event",
-                {
-                    "component": component,
-                    "time": ev.time,
-                    "name": ev.name,
-                    "type": ev.type,
-                    "message": ev.message,
-                },
+                body,
                 dedupe_key=f"event:{component}:{ev.time}:{ev.name}",
             )
 
@@ -378,15 +389,19 @@ class Server:
             component: str, from_state: str, to_state: str,
             ts: float, reason: str,
         ) -> None:
+            body = {
+                "component": component,
+                "from": from_state,
+                "to": to_state,
+                "ts": ts,
+                "reason": reason,
+            }
+            cid = current_correlation_id()
+            if cid:
+                body["correlation_id"] = cid
             outbox.publish(
                 "transition",
-                {
-                    "component": component,
-                    "from": from_state,
-                    "to": to_state,
-                    "ts": ts,
-                    "reason": reason,
-                },
+                body,
                 dedupe_key=f"transition:{component}:{ts}:{to_state}",
             )
 

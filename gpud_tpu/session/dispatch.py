@@ -208,6 +208,20 @@ class Dispatcher:
         ms = self.server.metrics_store.read(since)
         return {"metrics": [m.to_dict() for m in ms]}
 
+    def _m_traces(self, req: Dict) -> Dict:
+        """Trace-ring snapshot for the control plane — the session twin
+        of ``GET /v1/debug/traces``. The manager uses the
+        ``correlation_id`` filter to fetch the live agent-side spans
+        behind a fleet record (docs/fleet.md)."""
+        tracer = self.server.tracer
+        spans = tracer.snapshot(
+            component=req.get("component", "") or None,
+            limit=int(req.get("limit", 64)),
+            since=float(req.get("since", 0.0)),
+            correlation_id=req.get("correlation_id", "") or None,
+        )
+        return {"spans": spans, "stats": tracer.stats()}
+
     def _m_gossip(self, req: Dict) -> Dict:
         # async: machine info can hang on NFS stat (reference:
         # session_process_request.go:64-84) — compute in a thread and

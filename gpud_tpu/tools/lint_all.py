@@ -1,0 +1,123 @@
+"""One entry point for every registration lint.
+
+Each lint guards a registry that silently drifts: a metric module left
+out of ``metrics_lint._METRIC_MODULES`` never gets linted, a store left
+out of ``storage_lint.STORE_MODULES`` can regress to per-row commits,
+and an HTTP route without a docstring ships an OpenAPI operation with
+no summary. Running them as one suite — and wiring that suite into
+tier-1 (tests/test_lint_all.py) — turns "forgot to register it" from a
+bench-only discovery into a failing unit test.
+
+Checks:
+
+- **metrics**: import every metric-defining module, lint the default
+  registry (prefix, help text, unit suffixes, reserved labels).
+- **storage**: AST-scan every SQLite-backed store's declared
+  ``HOT_WRITE_METHODS`` for writer routing.
+- **openapi**: build the node HTTP app against a throwaway unstarted
+  Server, render /openapi.json straight from the route table, and
+  check both parity directions plus a non-empty summary per operation.
+
+Run: ``python -m gpud_tpu.tools.lint_all`` (exit 1 on any problem).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+import tempfile
+from typing import List
+
+
+def openapi_parity_problems() -> List[str]:
+    """Route-table vs document parity without sockets: the openapi
+    handler ignores its request argument and reads only the router, so
+    it can run against an app that was built but never served."""
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.app import build_app
+    from gpud_tpu.server.server import Server
+
+    problems: List[str] = []
+    with tempfile.TemporaryDirectory(prefix="tpud-lint-") as tmp:
+        kmsg = os.path.join(tmp, "kmsg.fixture")
+        with open(kmsg, "w", encoding="utf-8"):
+            pass
+        cfg = default_config(
+            data_dir=os.path.join(tmp, "data"), port=0, tls=False,
+            kmsg_path=kmsg,
+        )
+        cfg.components_disabled = ["network-latency"]  # egress-free
+        srv = Server(config=cfg)
+        try:
+            app = build_app(srv)
+            handler = None
+            served = set()
+            for route in app.router.routes():
+                info = route.resource.get_info() if route.resource else {}
+                path = info.get("path") or info.get("formatter") or ""
+                method = route.method.lower()
+                if path == "/openapi.json" and method == "get":
+                    handler = route.handler
+                if not path or path == "/openapi.json" or method == "head":
+                    continue
+                served.add((path, method))
+            if handler is None:
+                return ["/openapi.json route is not registered"]
+            resp = asyncio.run(handler(None))
+            doc = json.loads(resp.body.decode())
+            documented = {
+                (path, method)
+                for path, methods in doc["paths"].items()
+                for method in methods
+            }
+            for path, method in sorted(served - documented):
+                problems.append(
+                    f"served but undocumented: {method.upper()} {path}"
+                )
+            for path, method in sorted(documented - served):
+                problems.append(
+                    f"documented but not served: {method.upper()} {path}"
+                )
+            for path, methods in sorted(doc["paths"].items()):
+                for method, op in methods.items():
+                    if not op.get("summary"):
+                        problems.append(
+                            f"{method.upper()} {path}: operation has no "
+                            "summary (handler docstring missing)"
+                        )
+        finally:
+            srv.stop()
+    return problems
+
+
+def run_all() -> List[str]:
+    """Every lint, one problem list; [] = clean. Problems are prefixed
+    with their lint's name so a CI log line is self-locating."""
+    from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
+    from gpud_tpu.tools import metrics_lint, storage_lint
+
+    problems: List[str] = []
+    metrics_lint.populate_default_registry()
+    problems.extend(
+        f"metrics: {p}" for p in metrics_lint.lint_registry(DEFAULT_REGISTRY)
+    )
+    problems.extend(f"storage: {p}" for p in storage_lint.run_lint())
+    problems.extend(f"openapi: {p}" for p in openapi_parity_problems())
+    return problems
+
+
+def main() -> int:
+    problems = run_all()
+    for p in problems:
+        print(f"lint-all: {p}", file=sys.stderr)
+    if problems:
+        print(f"lint-all: {len(problems)} problem(s)", file=sys.stderr)
+        return 1
+    print("lint-all: metrics + storage + openapi clean")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

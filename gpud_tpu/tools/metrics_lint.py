@@ -45,6 +45,8 @@ _METRIC_MODULES = (
     "gpud_tpu.components.base",
     "gpud_tpu.eventstore",
     "gpud_tpu.health_history",
+    "gpud_tpu.manager.exposition",
+    "gpud_tpu.manager.rollup",
     "gpud_tpu.scheduler.core",
     "gpud_tpu.server.app",
     "gpud_tpu.session.dispatch",

@@ -185,10 +185,15 @@ class Tracer:
 
     # -- reading -----------------------------------------------------------
     def snapshot(
-        self, component: Optional[str] = None, limit: int = 0, since: float = 0.0
+        self,
+        component: Optional[str] = None,
+        limit: int = 0,
+        since: float = 0.0,
+        correlation_id: Optional[str] = None,
     ) -> List[Dict]:
-        """Newest-first span dicts, optionally filtered by component and/or a
-        unix-timestamp floor on span start."""
+        """Newest-first span dicts, optionally filtered by component, a
+        unix-timestamp floor on span start, and/or the ``correlation_id``
+        attribute the check wrapper stamps on its root span."""
         with self._mu:
             spans = list(self._ring)
         spans.reverse()
@@ -197,6 +202,8 @@ class Tracer:
             if component and sp.component != component:
                 continue
             if since and sp.start_unix < since:
+                continue
+            if correlation_id and sp.attrs.get("correlation_id") != correlation_id:
                 continue
             out.append(sp.to_dict())
             if limit and len(out) >= limit:
@@ -233,3 +240,32 @@ DEFAULT_TRACER = Tracer()
 
 def span(name: str, component: str = "", attrs: Optional[Dict] = None):
     return DEFAULT_TRACER.span(name, component=component, attrs=attrs)
+
+
+# -- cross-node correlation --------------------------------------------------
+# The check wrapper (components/base.py) mints one id per check run,
+# stamps it on the root span, and holds it in this thread-local for the
+# whole run — including the ledger observe() that fires transition hooks
+# AFTER the span closes. The server's outbox producers read it to stamp
+# outgoing fleet records, so the manager can stitch a fleet event back
+# to the exact agent-side trace that produced it (docs/fleet.md).
+
+_correlation = threading.local()
+_cid_counter = itertools.count(1)
+
+
+def new_correlation_id() -> str:
+    """Process-unique, cheap, and grep-able: ``<unix-ms>-<seq>``."""
+    return f"c{int(time.time() * 1000):x}-{next(_cid_counter):x}"
+
+
+def set_correlation_id(cid: str) -> None:
+    _correlation.cid = cid
+
+
+def current_correlation_id() -> str:
+    return getattr(_correlation, "cid", "")
+
+
+def clear_correlation_id() -> None:
+    _correlation.cid = ""

@@ -334,6 +334,74 @@ def test_expect_plane_without_harness_fails(clock):
     assert not r.ok and "no fake control plane" in r.detail
 
 
+def _fleet_plane():
+    """A plane double with the ingest ledger the fleet expectation reads,
+    plus a real in-memory rollup store fed the same records."""
+    from gpud_tpu.manager.rollup import FleetRollupStore
+    from gpud_tpu.sqlite import DB
+
+    store = FleetRollupStore(DB(":memory:"), writer=None)
+    recs = [
+        (1, 10.0, "transition", "k1",
+         {"component": "c1", "from": "Healthy", "to": "Unhealthy",
+          "ts": 10.0}),
+        (2, 11.0, "transition", "k2",
+         {"component": "c1", "from": "Unhealthy", "to": "Healthy",
+          "ts": 11.0}),
+        (3, 12.0, "event", "k3", {"component": "c1", "name": "boom"}),
+    ]
+    store.ingest("m1", recs)
+    return SimpleNamespace(
+        outbox_keys={"k1", "k2", "k3"},
+        outbox_frames=[{"dedupe_key": k, "kind": kind}
+                       for _, _, kind, k, _ in recs],
+        rollup=store,
+    )
+
+
+def test_expect_fleet_consistent_and_kinds_match(clock):
+    ctx = _ctx(clock)
+    ctx.plane = _fleet_plane()
+    results = evaluate_phase(
+        _fake_server(),
+        {"fleet": {"consistent": True, "kinds_match": True}},
+        ctx,
+    )
+    assert [r.ok for r in results] == [True, True]
+    assert "3 record(s)" in results[0].detail
+
+
+def test_expect_fleet_divergence_times_out(clock):
+    ctx = _ctx(clock)
+    ctx.plane = _fleet_plane()
+    # the plane accepted a record the rollup never ingested (a torn
+    # ingest hook): consistency must fail, not hang
+    ctx.plane.outbox_keys.add("k-lost")
+    (r,) = evaluate_phase(
+        _fake_server(), {"fleet": {"within": 0.3}}, ctx)
+    assert not r.ok and r.timed_out and "divergence" in r.detail
+
+
+def test_expect_fleet_kind_mismatch_fails(clock):
+    ctx = _ctx(clock)
+    ctx.plane = _fleet_plane()
+    ctx.plane.outbox_frames[-1]["kind"] = "remediation_audit"
+    results = evaluate_phase(
+        _fake_server(),
+        {"fleet": {"consistent": False, "kinds_match": True}},
+        ctx,
+    )
+    (r,) = results
+    assert not r.ok and "mismatch" in r.detail
+
+
+def test_expect_fleet_without_rollup_fails(clock):
+    ctx = _ctx(clock)
+    ctx.plane = SimpleNamespace(outbox_keys=set(), outbox_frames=[])
+    (r,) = evaluate_phase(_fake_server(), {"fleet": {}}, ctx)
+    assert not r.ok and "no fleet rollup store" in r.detail
+
+
 def test_expectation_result_to_dict():
     d = ExpectationResult(
         "detect", True, detail="x", latency_seconds=0.1234567).to_dict()

@@ -124,6 +124,63 @@ def test_inject_fault_detected_via_manager(stack):
     raise AssertionError("injected fault never surfaced via the manager")
 
 
+def test_fleet_plane_correlation_end_to_end(stack):
+    """The full stitch: a real check run mints a correlation id, the
+    transition rides the outbox to the manager's rollup store, and the
+    id resolves BOTH ways — /v1/fleet/traces on the manager and the
+    ``traces`` session method against the live agent's ring."""
+    cp, _srv = stack
+    h = cp.agent("cp-agent-1")
+    # the inject test above forced a Healthy→Unhealthy transition inside
+    # a component check; wait for its outbox record to reach the rollup
+    cid = None
+    record = None
+    deadline = time.time() + 20
+    while time.time() < deadline and cid is None:
+        hist = requests.get(
+            f"{cp.endpoint}/v1/fleet/agents/cp-agent-1/history?limit=200",
+            timeout=10,
+        ).json()
+        for rec in hist["records"]:
+            if rec["kind"] == "transition" and rec["correlation_id"]:
+                cid, record = rec["correlation_id"], rec
+                break
+        if cid is None:
+            time.sleep(0.3)
+    assert cid, "no correlated transition reached the manager within 20s"
+
+    r = requests.get(
+        f"{cp.endpoint}/v1/fleet/traces?correlation_id={cid}", timeout=10
+    )
+    assert r.status_code == 200
+    stitched = r.json()
+    assert stitched["count"] >= 1
+    assert any(
+        rec["dedupe_key"] == record["dedupe_key"]
+        for rec in stitched["records"]
+    )
+
+    # ...and back down to the agent: the same id finds the originating
+    # check span in the live trace ring (if it hasn't aged out of the
+    # bounded ring under the stack's check churn, its attrs must match)
+    spans = h.request(
+        {"method": "traces", "correlation_id": cid, "limit": 16},
+        timeout=15,
+    )["spans"]
+    for sp in spans:
+        assert sp["attrs"]["correlation_id"] == cid
+        assert sp["component"] == record["payload"]["component"]
+
+    # the rollup view agrees the agent has transitioned
+    page = requests.get(
+        f"{cp.endpoint}/v1/fleet/agents?limit=10", timeout=10
+    ).json()
+    (agent,) = [a for a in page["agents"] if a["agent"] == "cp-agent-1"]
+    assert sum(
+        c["transitions"] for c in agent["components"].values()
+    ) >= 1
+
+
 # -- admin auth ------------------------------------------------------------
 
 

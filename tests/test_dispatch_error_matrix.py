@@ -111,6 +111,13 @@ MATRIX = [
     ("outboxAck", {"seq": -1}, "error"),
     ("outboxAck", {"seq": 0}, "ok"),
     ("outboxStatus", {}, "ok"),
+    # traces: ring snapshot; non-numeric filters error, filters that
+    # match nothing (unknown component / correlation id) are empty-ok
+    ("traces", {}, "ok"),
+    ("traces", {"since": "yesterday"}, "error"),
+    ("traces", {"limit": "lots"}, "error"),
+    ("traces", {"component": "no-such-component"}, "ok"),
+    ("traces", {"correlation_id": "no-such-cid"}, "ok"),
     # chaos: missing/unknown/garbage scenarios are clean errors; status
     # tolerates no filter but rejects a non-numeric limit
     ("chaosRun", {}, "error"),

@@ -1,0 +1,9 @@
+"""The combined registration lint is a tier-1 gate: a metric module,
+store module, or HTTP route that misses its registry fails the test
+suite here, not just a bench run."""
+
+from gpud_tpu.tools.lint_all import run_all
+
+
+def test_all_lints_clean():
+    assert run_all() == []
