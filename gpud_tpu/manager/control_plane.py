@@ -211,13 +211,14 @@ class AgentHandle:
             # slow agent's late responses age into `unsolicited` (bounded)
             while len(self._ack_req_ids) > 512:
                 self._ack_req_ids.popitem(last=False)
-        if not self._gone.is_set():
-            self.outbound.put(
-                {"req_id": ack_req_id,
-                 "data": {"method": "outboxAck", "seq": ack_seq}}
-            )
-        # after the ack is queued: the agent's latency is not gated on
-        # journaling, and a rollup failure must not kill the transport
+        # journal BEFORE queuing the ack: once the ack lands the agent
+        # prunes these records and can never replay them, so the only
+        # acceptable loss after this point is the BatchWriter's bounded
+        # durability window (docs/fleet.md), not a whole unjournaled
+        # batch. submit_many only buffers — the ack is not gated on a
+        # commit — and a rollup failure must not kill the transport, so
+        # ingest errors are logged and the ack still goes out (the
+        # cumulative ack would cover these seqs on the next frame anyway).
         cb = self.on_records
         if cb is not None and fresh:
             try:
@@ -226,6 +227,11 @@ class AgentHandle:
                 logger.exception(
                     "%s: fleet rollup ingest failed", self.machine_id
                 )
+        if not self._gone.is_set():
+            self.outbound.put(
+                {"req_id": ack_req_id,
+                 "data": {"method": "outboxAck", "seq": ack_seq}}
+            )
 
     def mark_gone(self) -> None:
         self._gone.set()
@@ -720,6 +726,14 @@ class ControlPlane:
 
         self._scheduler = Scheduler(workers=1)
         self.writer.start(self._scheduler)
+        # enforce the journal row cap: without this job purge() has no
+        # caller and a --data-dir manager's fleet.db grows without bound
+        self._scheduler.add_job(
+            "fleet-journal-purge",
+            self.rollup.purge,
+            interval=60.0,
+            initial_delay=60.0,
+        )
         self._scheduler.start()
 
         def run() -> None:

@@ -285,6 +285,17 @@ class FleetRollupStore:
             self._dedupe.clear()
             self._records_total = 0
             for agent, seq, ts, ingested, kind, key, payload in rows:
+                # reseed the replay-suppression LRU: after a restart agents
+                # replay journaled-but-unacked records, and the DB's INSERT
+                # OR IGNORE alone would let them double-count the in-memory
+                # aggregates. Rows arrive oldest-first per agent, so LRU
+                # eviction keeps the newest keys — the ones replays carry.
+                seen = self._dedupe.get(agent)
+                if seen is None:
+                    seen = self._dedupe[agent] = OrderedDict()
+                seen[key] = None
+                while len(seen) > self.dedupe_keys_max:
+                    seen.popitem(last=False)
                 body = wire.unpack_obj(payload) if payload is not None else {}
                 self._apply_locked(agent, seq, ts, ingested, kind, key, body)
             self._generation += 1
@@ -434,11 +445,6 @@ class FleetRollupStore:
         return self._cached(("rollup",), self._compute_fleet_rollup)
 
     def _compute_fleet_rollup(self) -> Dict:
-        with self._lock:
-            agents = {aid: ar for aid, ar in self._agents.items()}
-            gen = self._generation
-            records_total = self._records_total
-            duplicates = self._duplicates_total
         by_kind: _Counter = _Counter()
         remediation: _Counter = _Counter()
         transitions = 0
@@ -453,34 +459,42 @@ class FleetRollupStore:
         unhealthy_now = 0
         flapping: List[Dict] = []
         max_lag = 0.0
-        for aid, ar in sorted(agents.items()):
-            by_kind.update(ar.records_by_kind)
-            remediation.update(ar.remediation_outcomes)
-            max_lag = max(max_lag, ar.outbox_lag_seconds)
-            as_of = ar.last_ts
-            for comp, sr in sorted(ar.series.items()):
-                series += 1
-                snap = sr.snapshot(as_of)
-                transitions += sr.transitions
-                failures += sr.failures
-                repair_total += sr.repair_total
-                repair_count += sr.repair_count
-                tbf_total += sr.tbf_total
-                tbf_count += sr.tbf_count
-                healthy += snap["healthy_seconds"]
-                unhealthy += snap["unhealthy_seconds"]
-                if snap["state"] and snap["state"] != "Healthy":
-                    unhealthy_now += 1
-                if snap["flap_count"] >= 3:
-                    flapping.append(
-                        {"agent": aid, "component": comp,
-                         "flap_count": snap["flap_count"]}
-                    )
+        # hold the lock for the whole walk: per-series dicts and deques
+        # mutate under it on ingest, so iterating a shallow snapshot
+        # outside would race (RuntimeError mid-iteration, torn sums)
+        with self._lock:
+            gen = self._generation
+            records_total = self._records_total
+            duplicates = self._duplicates_total
+            agent_count = len(self._agents)
+            for aid, ar in sorted(self._agents.items()):
+                by_kind.update(ar.records_by_kind)
+                remediation.update(ar.remediation_outcomes)
+                max_lag = max(max_lag, ar.outbox_lag_seconds)
+                as_of = ar.last_ts
+                for comp, sr in sorted(ar.series.items()):
+                    series += 1
+                    snap = sr.snapshot(as_of)
+                    transitions += sr.transitions
+                    failures += sr.failures
+                    repair_total += sr.repair_total
+                    repair_count += sr.repair_count
+                    tbf_total += sr.tbf_total
+                    tbf_count += sr.tbf_count
+                    healthy += snap["healthy_seconds"]
+                    unhealthy += snap["unhealthy_seconds"]
+                    if snap["state"] and snap["state"] != "Healthy":
+                        unhealthy_now += 1
+                    if snap["flap_count"] >= 3:
+                        flapping.append(
+                            {"agent": aid, "component": comp,
+                             "flap_count": snap["flap_count"]}
+                        )
         flapping.sort(key=lambda f: -f["flap_count"])
         observed = healthy + unhealthy
         return {
             "generation": gen,
-            "agents": len(agents),
+            "agents": agent_count,
             "series": series,
             "records_total": records_total,
             "records_by_kind": dict(by_kind),

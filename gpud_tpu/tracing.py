@@ -18,6 +18,7 @@ thread-local parent stack would mis-attribute concurrent requests.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import time
 from collections import deque
@@ -252,11 +253,15 @@ def span(name: str, component: str = "", attrs: Optional[Dict] = None):
 
 _correlation = threading.local()
 _cid_counter = itertools.count(1)
+# per-process random component: timestamp+counter alone collide when two
+# agents boot in the same millisecond, and the fleet correlation index
+# would stitch their unrelated records together
+_cid_nonce = os.urandom(4).hex()
 
 
 def new_correlation_id() -> str:
-    """Process-unique, cheap, and grep-able: ``<unix-ms>-<seq>``."""
-    return f"c{int(time.time() * 1000):x}-{next(_cid_counter):x}"
+    """Fleet-unique, cheap, and grep-able: ``c<nonce>-<unix-ms>-<seq>``."""
+    return f"c{_cid_nonce}-{int(time.time() * 1000):x}-{next(_cid_counter):x}"
 
 
 def set_correlation_id(cid: str) -> None:

@@ -102,6 +102,98 @@ def test_journal_dedupe_survives_lru_eviction(store):
     assert store.journal_count() == 2  # journal layer caught it
 
 
+def test_restart_replay_does_not_double_count(store):
+    """After a manager restart, agents replay journaled-but-unacked
+    records. The rebuild must reseed the in-memory dedupe LRU from the
+    journal, or the replay double-counts every aggregate (the DB's
+    INSERT OR IGNORE only protects the journal)."""
+    t = 1000.0
+    recs = [
+        _transition(1, t), _transition(2, t + 10, frm="Unhealthy",
+                                       to="Healthy"),
+        _event(3, t + 11),
+    ]
+    store.ingest("a1", recs, now=t + 11)
+    store.writer.flush()
+    restarted = FleetRollupStore(store.db, None)
+    assert restarted.ingest("a1", recs, now=t + 12) == 0  # replay suppressed
+    roll = restarted.fleet_rollup()
+    assert roll["records_total"] == 3 == restarted.journal_count()
+    assert roll["transitions_total"] == 2
+    assert roll["records_by_kind"] == {"transition": 2, "event": 1}
+    snap = restarted.agent_snapshot("a1")["components"]["c0"]
+    assert snap["transitions"] == 2 and snap["failures"] == 1
+
+
+def test_rebuild_reseeds_only_newest_dedupe_keys(store):
+    """The reseeded LRU is bounded: oldest keys age out, and the journal
+    unique index still suppresses replays past the window."""
+    t = 1000.0
+    store.ingest("a1", [_event(i, t + i, name=f"e{i}") for i in range(1, 6)])
+    store.writer.flush()
+    restarted = FleetRollupStore(store.db, None, dedupe_keys_max=2)
+    assert len(restarted._dedupe["a1"]) == 2
+    assert list(restarted._dedupe["a1"]) == [
+        f"event:c0:{t + 4}:e4", f"event:c0:{t + 5}:e5"
+    ]
+    # replay of an aged-out key: journal layer still refuses the row
+    restarted.ingest("a1", [_event(1, t + 1, name="e1")])
+    assert restarted.journal_count() == 5
+
+
+def test_fleet_rollup_concurrent_with_ingest(store):
+    """fleet_rollup walks per-series dicts/deques that ingest mutates;
+    the walk must hold the store lock (torn sums / RuntimeError
+    otherwise)."""
+    import threading
+
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        seq = 0
+        t = 1000.0
+        while not stop.is_set():
+            seq += 1
+            comp = f"c{seq % 17}"
+            store.ingest(f"a{seq % 5}", [_transition(
+                seq, t + seq, comp=comp,
+                frm="Healthy" if seq % 2 else "Unhealthy",
+                to="Unhealthy" if seq % 2 else "Healthy",
+            )])
+
+    def read():
+        try:
+            while not stop.is_set():
+                store.fleet_rollup()
+                store.agents_page(0, 10)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn) for _ in range(2)]
+    threads += [threading.Thread(target=read) for _ in range(2)]
+    for th in threads:
+        th.start()
+    time.sleep(0.5)
+    stop.set()
+    for th in threads:
+        th.join(timeout=10)
+    assert not errors, errors
+
+
+# -- journal bound --------------------------------------------------------
+
+def test_purge_bounds_journal_keeps_newest(store):
+    store.max_journal_rows = 3
+    t = 1000.0
+    store.ingest("a1", [_event(i, t + i, name=f"e{i}") for i in range(1, 8)])
+    assert store.purge() == 4
+    assert store.journal_count() == 3
+    h = store.history("a1")
+    assert [r["seq"] for r in h["records"]] == [7, 6, 5]  # oldest trimmed
+    assert store.purge() == 0  # idempotent under the cap
+
+
 # -- rollup math ----------------------------------------------------------
 
 def test_mttr_mtbf_flaps_availability(store):
@@ -374,6 +466,13 @@ def test_http_traces_correlation_end_to_end(fleet_cp):
     assert body["records"][0]["payload"]["to"] == "Unhealthy"
     r = requests.get(f"{cp.endpoint}/v1/fleet/traces", timeout=10)
     assert r.status_code == 400  # correlation_id is required
+
+
+def test_manager_schedules_journal_purge(fleet_cp):
+    """max_journal_rows is only a bound if something calls purge():
+    the manager must own a periodic purge job."""
+    cp, _ = fleet_cp
+    assert "fleet-journal-purge" in cp._scheduler._jobs  # noqa: SLF001
 
 
 def test_http_federated_metrics(fleet_cp):
