@@ -495,6 +495,137 @@ def bench_chaos(scenario: str) -> int:
     return 0 if all_passed else 1
 
 
+PREDICT_FAULTED_COMPONENTS = (
+    "accelerator-tpu-temperature", "accelerator-tpu-error-kmsg",
+)
+PREDICT_CPU_LIMIT_PCT = 1.0
+PREDICT_RSS_LIMIT_MB = 150.0
+PREDICT_QUIET_SECONDS = 5.0
+
+
+def bench_predict() -> int:
+    """``--predict`` mode: boot a live daemon + fake control plane,
+    replay the slow-ramp and flap-burst faults (the shipped
+    precursor-ramp chaos scenario), and gate on the predict engine
+    proving its reason to exist: every campaign expectation green
+    (warning-before-fault ordering + per-fault lead floors + zero
+    warnings on un-faulted components), positive median measured lead
+    time vs the reactive detector, and the daemon holding the
+    steady-state CPU/RSS budget with the predict-scan job live."""
+    os.environ["TPUD_TPU_MOCK_ALL_SUCCESS"] = "1"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    from gpud_tpu.chaos.fake_plane import FakeControlPlane
+    from gpud_tpu.config import default_config
+    from gpud_tpu.server.server import Server
+
+    tmp = tempfile.mkdtemp(prefix="tpud-predict-bench-")
+    kmsg = os.path.join(tmp, "kmsg.fixture")
+    open(kmsg, "w").close()
+    cp = FakeControlPlane()
+    cp.attach_rollup()
+    cp.start()
+    cfg = default_config(
+        data_dir=os.path.join(tmp, "data"),
+        port=0,
+        tls=False,
+        kmsg_path=kmsg,
+        endpoint=f"http://127.0.0.1:{cp.port}",
+        token="predict-bench-token",
+        machine_id="predict-bench-1",
+        # 1s scan so the scheduler-driven path (not just the campaign's
+        # pinned predict_scan steps) demonstrably runs inside the
+        # footprint window below
+        predict_interval_seconds=1.0,
+    )
+    srv = Server(config=cfg)
+    srv.start()
+    res = {}
+    err = ""
+    cpu_pct = rss = None
+    try:
+        if not cp.connected.wait(15):
+            print("[predict] WARNING: session never connected; outbox "
+                  "publish counts will read zero", file=sys.stderr)
+        srv.chaos.plane = cp
+        res, err = srv.chaos.run_campaign("precursor-ramp", wait=True)
+        res = res or {}
+        if err:
+            print(f"[predict] campaign ERROR: {err}", file=sys.stderr)
+        # steady-state footprint with the predict-scan job ticking: the
+        # early-warning plane must ride the existing budget, not buy a
+        # new one
+        t0, w0 = os.times(), time.monotonic()
+        time.sleep(PREDICT_QUIET_SECONDS)
+        t1, w1 = os.times(), time.monotonic()
+        busy = (t1.user + t1.system) - (t0.user + t0.system)
+        cpu_pct = 100.0 * busy / max(1e-9, w1 - w0)
+        rss = _rss_mb()
+        scores = (
+            srv.predictor.scores() if srv.predictor is not None
+            else {"components": {}}
+        )
+    finally:
+        srv.stop()
+        cp.stop()
+
+    for ph in res.get("phases", []):
+        for exp in ph.get("expectations", []):
+            if not exp.get("ok"):
+                print(
+                    f"[predict]   FAIL {ph.get('name', '?')} "
+                    f"{exp.get('kind', '?')}: {exp.get('detail', '')}",
+                    file=sys.stderr,
+                )
+    leads = []
+    false_positives = []
+    for name, d in sorted(scores.get("components", {}).items()):
+        if d.get("warnings", 0) and name not in PREDICT_FAULTED_COMPONENTS:
+            false_positives.append(name)
+        if d.get("lead_seconds") is not None:
+            leads.append(d["lead_seconds"])
+            print(
+                f"[predict] {name}: warned at score "
+                f"{d.get('warn_score', 0):.3f}, lead "
+                f"{d['lead_seconds']:.3f}s before the reactive detector",
+                file=sys.stderr,
+            )
+    published = sum(
+        1 for f in getattr(cp, "outbox_frames", [])
+        if f.get("kind") == "predict_score"
+    )
+    lead_p50 = statistics.median(leads) if leads else 0.0
+    print(
+        f"[predict] leads: n={len(leads)} median={lead_p50:.3f}s "
+        f"(gate > 0); false positives: "
+        f"{false_positives or 'none'} (gate: none); "
+        f"{published} predict_score record(s) reached the plane",
+        file=sys.stderr,
+    )
+    print(
+        f"[predict] steady-state with 1s predict-scan: cpu={cpu_pct:.2f}% "
+        f"(gate < {PREDICT_CPU_LIMIT_PCT:g}%) rss={rss:.1f}MB "
+        f"(gate < {PREDICT_RSS_LIMIT_MB:g}MB)",
+        file=sys.stderr,
+    )
+    ok = (
+        not err
+        and bool(res.get("passed"))
+        and len(leads) >= 2
+        and lead_p50 > 0.0
+        and not false_positives
+        and cpu_pct is not None and cpu_pct < PREDICT_CPU_LIMIT_PCT
+        and rss is not None and rss < PREDICT_RSS_LIMIT_MB
+    )
+    print(json.dumps({
+        "metric": "predict warning lead time (median)",
+        "value": round(lead_p50, 3),
+        "unit": "s",
+        "vs_baseline": 1.0 if ok else 0.0,
+    }))
+    return 0 if ok else 1
+
+
 INGEST_TARGET_OBS_PER_SEC = 100_000
 
 
@@ -1168,6 +1299,13 @@ def main(argv=None) -> int:
              "standard bench; a shipped scenario name, or 'all'",
     )
     ap.add_argument(
+        "--predict", action="store_true",
+        help="run the predictive-health bench (slow-ramp + flap-burst "
+             "replay against a live daemon; gates on warning lead time, "
+             "zero false positives, CPU/RSS) instead of the standard "
+             "bench",
+    )
+    ap.add_argument(
         "--ingest", action="store_true",
         help="run the storage-ingest firehose bench (write-behind commit "
              "layer) instead of the standard bench",
@@ -1209,6 +1347,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.fleet:
         return bench_fleet(agents=args.fleet_agents)
+    if args.predict:
+        return bench_predict()
     if args.chaos:
         return bench_chaos(args.chaos)
     if args.ingest:

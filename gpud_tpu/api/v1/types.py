@@ -46,6 +46,10 @@ class RepairActionType:
     REBOOT_SYSTEM = "REBOOT_SYSTEM"
     HARDWARE_INSPECTION = "HARDWARE_INSPECTION"
     CHECK_USER_APP_AND_TPU = "CHECK_USER_APP_AND_TPU"
+    # minted by the predict engine (gpud_tpu/predict/) ahead of a hard
+    # fault; advisory only — map_suggested_action never resolves it to an
+    # executable action, so it can never leave dry-run
+    PREDICTED_DEGRADATION = "PREDICTED_DEGRADATION"
 
 
 @dataclass

@@ -19,6 +19,9 @@ invariants that hold the daemon itself to account:
   fleet:        the manager-side rollup store (manager/rollup.py) agrees
                 with the plane's ingest ledger — one row per accepted
                 record, redeliveries deduped, per-kind counts matching
+  predict:      the predict engine warned before the reactive hard
+                signal (ordering + lead-time floor), and stayed silent
+                on un-faulted components
   invariants:   zero unhandled worker exceptions (scheduler failure +
                 watchdog counters flat), un-faulted job cadence within
                 slack, thread-count and RSS gates
@@ -550,6 +553,215 @@ def _eval_fleet(server, spec: Dict, ctx) -> List[ExpectationResult]:
     return out
 
 
+def _eval_predict(server, specs: List[Dict], ctx) -> List[ExpectationResult]:
+    """Predictive-health assertions (gpud_tpu/predict/, docs/predict.md):
+
+      warned: true   a ``predicted_degraded`` warning for the component
+                     appears within the bound (the engine is poked each
+                     poll so the scan cadence never gates a campaign)
+      before:        state name (e.g. Unhealthy) — the warning's event
+                     timestamp must precede the phase's first ledger
+                     transition INTO that state (warning-before-fault
+                     ordering, the subsystem's reason to exist)
+      before_event:  event name (e.g. health_flapping) — same ordering
+                     against the reactive detector's own event
+      before_flap:   true — the warning must precede the IN-PHASE
+                     transition that carries the ledger past the
+                     reactive flap threshold (transition records have no
+                     emission cooldown, so this ordering stays valid
+                     when an earlier campaign already tripped the
+                     flap event's cooldown)
+      lead_min:      floor on the engine's measured lead time (seconds
+                     from warning to the first reactive hard signal)
+      warned: false  NO predictive warning for the component since the
+                     campaign started — the zero-false-positive gate
+    """
+    eng = getattr(server, "predictor", None)
+    if eng is None:
+        return [ExpectationResult(
+            "predict", False, detail="predict engine disabled",
+        )]
+    from gpud_tpu.predict.engine import EVENT_NAME_PREDICTED
+
+    out: List[ExpectationResult] = []
+    since = ctx.phase_start - SINCE_SLACK
+    campaign_since = getattr(ctx, "campaign_start", ctx.phase_start) - SINCE_SLACK
+
+    def first_warn_ts(component: str, lookback: float) -> Optional[float]:
+        ts = None
+        for e in server.event_store.bucket(component).get(lookback):
+            if e.name == EVENT_NAME_PREDICTED:
+                ts = e.time if ts is None else min(ts, e.time)
+        return ts
+
+    for spec in specs:
+        component = spec.get("component", "")
+        within = float(spec.get("within", ctx.detect_timeout))
+
+        if not spec.get("warned", True):
+            # negative gate, evaluated after the phase timeline drained:
+            # one extra synchronous scan, then zero tolerance
+            eng.poke()
+            ts = first_warn_ts(component, campaign_since)
+            ok = ts is None
+            out.append(ExpectationResult(
+                "predict", ok,
+                detail=(
+                    f"{component}: no predictive warning (un-faulted)"
+                    if ok
+                    else f"{component}: unexpected predictive warning at {ts:.3f}"
+                ),
+            ))
+            continue
+
+        deadline = ctx.time_fn() + within
+
+        def warned(c=component):
+            eng.poke()  # scan cadence must never gate a campaign
+            ts = first_warn_ts(c, since)
+            return (ts,) if ts is not None else None
+
+        got = _poll(warned, deadline, ctx)
+        if got is None:
+            out.append(ExpectationResult(
+                "predict", False, timed_out=True,
+                detail=f"{component}: no predictive warning within {within:g}s",
+            ))
+            continue
+        warn_ts = got[0]
+        out.append(ExpectationResult(
+            "predict", True,
+            detail=f"{component}: predictive warning at +"
+                   f"{max(0.0, warn_ts - ctx.phase_start):.3f}s",
+        ))
+
+        before_state = spec.get("before", "")
+        if before_state:
+            def hard_fault(c=component, st=before_state):
+                rows = [
+                    t["time"]
+                    for t in server.health_ledger.history(
+                        component=c, since=since
+                    )
+                    if t["to"] == st
+                ]
+                return (min(rows),) if rows else None
+
+            hit = _poll(hard_fault, deadline, ctx)
+            if hit is None:
+                out.append(ExpectationResult(
+                    "predict", False, timed_out=True,
+                    detail=(
+                        f"{component}: no transition→{before_state} to "
+                        f"order the warning against"
+                    ),
+                ))
+            else:
+                ok = warn_ts <= hit[0]
+                out.append(ExpectationResult(
+                    "predict", ok,
+                    detail=(
+                        f"{component}: warning preceded {before_state} by "
+                        f"{hit[0] - warn_ts:.3f}s"
+                        if ok
+                        else f"{component}: warning came {warn_ts - hit[0]:.3f}s "
+                             f"AFTER {before_state}"
+                    ),
+                ))
+
+        before_event = spec.get("before_event", "")
+        if before_event:
+            def reactive_event(c=component, nm=before_event):
+                rows = [
+                    e.time
+                    for e in server.event_store.bucket(c).get(since)
+                    if e.name == nm
+                ]
+                return (min(rows),) if rows else None
+
+            hit = _poll(reactive_event, deadline, ctx)
+            if hit is None:
+                out.append(ExpectationResult(
+                    "predict", False, timed_out=True,
+                    detail=f"{component}: reactive event {before_event} absent",
+                ))
+            else:
+                ok = warn_ts <= hit[0]
+                out.append(ExpectationResult(
+                    "predict", ok,
+                    detail=(
+                        f"{component}: warning preceded {before_event} by "
+                        f"{hit[0] - warn_ts:.3f}s"
+                        if ok
+                        else f"{component}: warning came {warn_ts - hit[0]:.3f}s "
+                             f"AFTER {before_event}"
+                    ),
+                ))
+
+        if spec.get("before_flap", False):
+            thr = int(server.health_ledger.flap_threshold)
+
+            def flap_crossing(c=component, n=thr):
+                rows = sorted(
+                    t["time"]
+                    for t in server.health_ledger.history(
+                        component=c, since=since
+                    )
+                )
+                return (rows[n - 1],) if len(rows) >= n else None
+
+            hit = _poll(flap_crossing, deadline, ctx)
+            if hit is None:
+                out.append(ExpectationResult(
+                    "predict", False, timed_out=True,
+                    detail=(
+                        f"{component}: fewer than {thr} in-phase "
+                        f"transitions — flap threshold never crossed"
+                    ),
+                ))
+            else:
+                ok = warn_ts <= hit[0]
+                out.append(ExpectationResult(
+                    "predict", ok,
+                    detail=(
+                        f"{component}: warning preceded the flap-threshold "
+                        f"crossing by {hit[0] - warn_ts:.3f}s"
+                        if ok
+                        else f"{component}: warning came "
+                             f"{warn_ts - hit[0]:.3f}s AFTER the "
+                             f"flap-threshold crossing"
+                    ),
+                ))
+
+        lead_min = spec.get("lead_min")
+        if lead_min is not None:
+            def measured(c=component):
+                eng.poke()
+                d = eng.scores(component=c)["components"].get(c) or {}
+                lead = d.get("lead_seconds")
+                return (lead,) if lead is not None else None
+
+            hit = _poll(measured, deadline, ctx)
+            if hit is None:
+                out.append(ExpectationResult(
+                    "predict", False, timed_out=True,
+                    detail=(
+                        f"{component}: lead time never measured within "
+                        f"{within:g}s"
+                    ),
+                ))
+            else:
+                ok = hit[0] >= float(lead_min)
+                out.append(ExpectationResult(
+                    "predict", ok,
+                    detail=(
+                        f"{component}: lead {hit[0]:.3f}s "
+                        f"(floor {float(lead_min):g}s)"
+                    ),
+                ))
+    return out
+
+
 def _eval_invariants(server, spec: Dict, ctx) -> List[ExpectationResult]:
     out = []
     reg = server.metrics_registry
@@ -639,6 +851,8 @@ def evaluate_phase(server, expect: Dict, ctx) -> List[ExpectationResult]:
         results.extend(_eval_outbox(server, expect["outbox"] or {}, ctx))
     if "fleet" in expect:
         results.extend(_eval_fleet(server, expect["fleet"] or {}, ctx))
+    if "predict" in expect:
+        results.extend(_eval_predict(server, expect["predict"] or [], ctx))
     if "invariants" in expect:
         results.extend(_eval_invariants(server, expect["invariants"] or {}, ctx))
     return results

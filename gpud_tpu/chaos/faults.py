@@ -23,7 +23,8 @@ Fault classes beyond the classic one-shot kmsg write:
                        plane harness (disconnect/reconnect storms)
 
 plus campaign helpers: ``trigger`` (poke a check), ``set_healthy``,
-``remediation_scan`` (poke the engine), ``purge`` (retention pass now).
+``remediation_scan`` (poke the engine), ``predict_scan`` (synchronous
+precursor-scoring tick), ``purge`` (retention pass now).
 """
 
 from __future__ import annotations
@@ -226,6 +227,27 @@ def act_remediation_scan(server, step: Dict, ctx) -> Optional[str]:
     return None
 
 
+def act_predict_scan(server, step: Dict, ctx) -> Optional[str]:
+    """Run a precursor-scoring tick now: campaigns pin the scan timeline
+    to the fault timeline instead of racing the configured cadence."""
+    eng = getattr(server, "predictor", None)
+    if eng is None:
+        return "predict engine disabled"
+    eng.tick_once()
+    return None
+
+
+def act_predict_reset(server, step: Dict, ctx) -> Optional[str]:
+    """Drop the predictor's in-memory scorer state for ``component`` (or
+    all components): campaign isolation — a drill must not inherit armed
+    warnings from faults an earlier campaign injected."""
+    eng = getattr(server, "predictor", None)
+    if eng is None:
+        return "predict engine disabled"
+    eng.reset(component=str(step.get("component", "")))
+    return None
+
+
 def act_purge(server, step: Dict, ctx) -> Optional[str]:
     fn = getattr(server, "_purge_retention", None)
     if fn is None:
@@ -322,6 +344,8 @@ ACTIONS: Dict[str, Callable] = {
     "trigger": act_trigger,
     "set_healthy": act_set_healthy,
     "remediation_scan": act_remediation_scan,
+    "predict_scan": act_predict_scan,
+    "predict_reset": act_predict_reset,
     "purge": act_purge,
     "ingest_burst": act_ingest_burst,
     "storage_flush": act_storage_flush,

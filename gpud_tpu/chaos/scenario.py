@@ -58,6 +58,8 @@ KNOWN_ACTIONS = (
     "trigger",         # poke a component check to the front of the heap
     "set_healthy",     # clear a component's sticky state
     "remediation_scan",  # poke the remediation engine's scan job
+    "predict_scan",    # run a synchronous precursor-scoring tick now
+    "predict_reset",   # drop predictor scorer state (campaign isolation)
     "purge",           # run the consolidated retention purge now
     "ingest_burst",    # observation firehose: `count` events + metric rows
     "storage_flush",   # write-behind flush barrier (pre-crash durability line)
@@ -67,7 +69,7 @@ KNOWN_ACTIONS = (
 # expectation kinds evaluated after each phase (gpud_tpu/chaos/expectations.py)
 KNOWN_EXPECTATIONS = (
     "detect", "ledger", "remediation", "events", "invariants", "plane",
-    "outbox", "fleet",
+    "outbox", "fleet", "predict",
 )
 
 MAX_STEP_OCCURRENCES = 1000  # per phase — runaway `count` backstop

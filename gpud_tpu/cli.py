@@ -498,6 +498,53 @@ def cmd_session(args) -> int:
     return 0
 
 
+def cmd_predict(args) -> int:
+    """Show the predict engine's precursor scores: fused score, feature
+    breakdown, armed/warned state, and measured lead times."""
+    from gpud_tpu.client.v1 import Client, ClientError
+
+    scheme = "http" if getattr(args, "no_tls", False) else "https"
+    c = Client(
+        base_url=f"{scheme}://localhost:{args.port}",
+        timeout=float(args.timeout),
+    )
+    try:
+        out = c.get_predict_scores(
+            component=args.component, history=args.history or None
+        )
+    except ClientError as e:
+        print(f"error: {e.body[:500]}", file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001
+        print(f"tpud unreachable on port {args.port}: {e}", file=sys.stderr)
+        return 1
+    if getattr(args, "as_json", False):
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+    status = out.get("status") or {}
+    print(
+        f"predict: threshold={status.get('threshold', '?')}  "
+        f"hysteresis={status.get('hysteresis', '?')}  "
+        f"ticks={status.get('ticks', 0)}  "
+        f"warnings={status.get('warnings_total', 0)}"
+    )
+    comps = out.get("components") or {}
+    if not comps:
+        print("no components scored yet")
+        return 0
+    for name, d in sorted(comps.items()):
+        mark = " ARMED" if d.get("armed") else ""
+        lead = d.get("lead_seconds")
+        lead_s = f"  lead={lead:.1f}s" if lead is not None else ""
+        feats = d.get("features") or {}
+        feat_s = " ".join(f"{k}={v:g}" for k, v in sorted(feats.items()))
+        print(
+            f"  {name}: score={d.get('score', 0):.3f}"
+            f"{mark}{lead_s}  [{feat_s}]"
+        )
+    return 0
+
+
 def cmd_machine_info(args) -> int:
     from gpud_tpu.machine_info import get_machine_info
     from gpud_tpu.tpu.instance import new_instance
@@ -1014,6 +1061,20 @@ def build_parser() -> argparse.ArgumentParser:
     cl.add_argument("--timeout", type=float, default=30.0)
     cl.add_argument("--json", action="store_true", dest="as_json")
     cl.set_defaults(fn=cmd_chaos)
+
+    ppr = sub.add_parser(
+        "predict",
+        help="predictive health: per-component precursor scores",
+    )
+    ppr.add_argument("--component", default="", help="filter to one component")
+    ppr.add_argument("--history", type=int, default=0,
+                     help="append the last N score points per component")
+    ppr.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
+    ppr.add_argument("--no-tls", action="store_true")
+    ppr.add_argument("--timeout", type=float, default=30.0)
+    ppr.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable scores + status")
+    ppr.set_defaults(fn=cmd_predict)
 
     pse = sub.add_parser(
         "session", help="control-plane session / outbox health"

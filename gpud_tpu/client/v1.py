@@ -171,6 +171,22 @@ class Client:
             params["limit"] = limit
         return self._req("GET", "/v1/remediation/audit", params=params or None)
 
+    def get_predict_scores(
+        self,
+        component: str = "",
+        history: Optional[int] = None,
+    ) -> Dict:
+        """Precursor scores (``/v1/predict/scores``): per-component fused
+        score, feature breakdown, armed/warned state, and measured lead
+        times; ``history=N`` appends the last N in-memory score points
+        per component."""
+        params: Dict = {}
+        if component:
+            params["component"] = component
+        if history is not None:
+            params["history"] = history
+        return self._req("GET", "/v1/predict/scores", params=params or None)
+
     def get_remediation_policy(self) -> Dict:
         """Current remediation policy + guard state."""
         return self._req("GET", "/v1/remediation/policy")

@@ -30,6 +30,16 @@ DEFAULT_REMEDIATION_MAX_REBOOTS = 2      # reboots allowed inside the window
 DEFAULT_REMEDIATION_REBOOT_WINDOW = 3600
 DEFAULT_REMEDIATION_ESCALATION_THRESHOLD = 3  # failed soft repairs => escalate
 DEFAULT_REMEDIATION_ESCALATION_WINDOW = 3600
+# predictive health: online precursor scoring (docs/predict.md)
+DEFAULT_PREDICT_INTERVAL = 15.0          # predict-scan cadence
+DEFAULT_PREDICT_THRESHOLD = 0.6          # fused score that arms a warning
+DEFAULT_PREDICT_HYSTERESIS = 0.15        # clear band below the threshold
+DEFAULT_PREDICT_ARM_TICKS = 2            # consecutive ticks above to warn
+DEFAULT_PREDICT_CLEAR_TICKS = 3          # consecutive ticks below to clear
+DEFAULT_PREDICT_WINDOW = 600.0           # feature lookback window (s)
+DEFAULT_PREDICT_HISTORY_LIMIT = 256      # in-memory score points / component
+DEFAULT_PREDICT_WARN_COOLDOWN = 300.0    # predicted-warning audit-row cooldown
+DEFAULT_PREDICT_PUBLISH_INTERVAL = 60.0  # armed-score outbox snapshot cadence
 # unified check scheduler (docs/scheduler.md): bounded worker pool +
 # deadline heap replacing per-component poller threads
 DEFAULT_SCHEDULER_WORKERS = 4
@@ -113,6 +123,20 @@ class Config:
         DEFAULT_REMEDIATION_ESCALATION_WINDOW
     )
     remediation_runtime_unit: str = ""   # empty = tpu-runtime.service
+    # predictive health (docs/predict.md): precursor scoring over
+    # check-latency drift, transition cadence, state trajectory, and kmsg
+    # error-class novelty. Warnings are advisory — annotation + dry-run
+    # audit row + outbox publish — never an executed action.
+    predict_enabled: bool = True
+    predict_interval_seconds: float = DEFAULT_PREDICT_INTERVAL
+    predict_threshold: float = DEFAULT_PREDICT_THRESHOLD
+    predict_hysteresis: float = DEFAULT_PREDICT_HYSTERESIS
+    predict_arm_ticks: int = DEFAULT_PREDICT_ARM_TICKS
+    predict_clear_ticks: int = DEFAULT_PREDICT_CLEAR_TICKS
+    predict_window_seconds: float = DEFAULT_PREDICT_WINDOW
+    predict_history_limit: int = DEFAULT_PREDICT_HISTORY_LIMIT
+    predict_warn_cooldown_seconds: float = DEFAULT_PREDICT_WARN_COOLDOWN
+    predict_publish_interval_seconds: float = DEFAULT_PREDICT_PUBLISH_INTERVAL
     # chaos campaign runner (docs/chaos.md): enabled by default — running
     # a campaign still takes an explicit API/CLI call, and every fault is
     # software-injected and undone on campaign exit
@@ -236,6 +260,24 @@ class Config:
             return "remediation escalation threshold must be >= 1"
         if self.remediation_escalation_window_seconds < 60:
             return "remediation escalation window must be >= 60s"
+        if self.predict_interval_seconds <= 0:
+            return "predict interval must be > 0s"
+        if not 0.0 < self.predict_threshold <= 1.0:
+            return "predict threshold must be in (0, 1]"
+        if not 0.0 <= self.predict_hysteresis < self.predict_threshold:
+            return "predict hysteresis must be in [0, threshold)"
+        if self.predict_arm_ticks < 1:
+            return "predict arm ticks must be >= 1"
+        if self.predict_clear_ticks < 1:
+            return "predict clear ticks must be >= 1"
+        if self.predict_window_seconds < 1:
+            return "predict window must be >= 1s"
+        if self.predict_history_limit < 1:
+            return "predict history limit must be >= 1"
+        if self.predict_warn_cooldown_seconds < 0:
+            return "predict warn cooldown must be >= 0s"
+        if self.predict_publish_interval_seconds < 0:
+            return "predict publish interval must be >= 0s"
         if self.chaos_max_campaign_seconds < 1:
             return "chaos max campaign seconds must be >= 1"
         if self.chaos_history_limit < 1:

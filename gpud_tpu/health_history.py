@@ -138,10 +138,15 @@ class HealthLedger:
         # component -> [state, episode_since, last_seen, first_seen]
         self._last: Dict[str, list] = {}
         self._last_flap_event: Dict[str, float] = {}
-        # component -> recent transition timestamps (flap-window cache):
-        # lets observe() count flaps without a read — and therefore
-        # without a flush barrier — on the hot path
+        # component -> recent (ts, from, to, reason) tuples (flap-window
+        # cache): lets observe() count flaps — and the predict scorer pull
+        # cadence features — without a read, and therefore without a flush
+        # barrier, on the hot path
         self._tx_recent: Dict[str, deque] = {}
+        # component -> externally-owned annotation dict (e.g. the predict
+        # engine's {"predicted": "true"}), merged into observe()'s
+        # returned annotations alongside the flap marker
+        self._annotations: Dict[str, Dict[str, str]] = {}
         import time as _time
 
         self.time_now_fn = _time.time
@@ -209,6 +214,9 @@ class HealthLedger:
                 STATE_CODES.get(state, -1.0), {"component": component}
             )
             ann = self._flap_check(component, ts)
+            ext = self._annotations.get(component)
+            if ext:
+                ann = {**ext, **ann}
             self._refresh_derived(component, ts)
         return ann
 
@@ -229,11 +237,12 @@ class HealthLedger:
             (component,),
         )
         # seed the in-memory flap window from persisted history so a
-        # restart mid-flap still detects it
+        # restart mid-flap still detects it (full tuples: the predict
+        # scorer reads cadence shape, not just counts)
         self._tx_recent[component] = deque(
-            r[0]
+            (r[0], r[1], r[2], r[3] or "")
             for r in self.db.query(
-                f"SELECT timestamp FROM {TABLE} "
+                f"SELECT timestamp, from_state, to_state, reason FROM {TABLE} "
                 "WHERE component=? AND timestamp>? ORDER BY timestamp ASC",
                 (component, ts - self.flap_window),
             )
@@ -281,7 +290,7 @@ class HealthLedger:
         else:
             self.db.execute(sql, params)
         recent = self._tx_recent.setdefault(component, deque())
-        recent.append(ts)
+        recent.append((ts, from_state, to_state, reason or ""))
         _c_transitions.inc(
             labels={"component": component, "from": from_state, "to": to_state}
         )
@@ -338,7 +347,7 @@ class HealthLedger:
             # transition): the observe() hot path never reads the DB, so
             # it never needs the flush barrier
             try:
-                while recent and recent[0] <= cutoff:
+                while recent and recent[0][0] <= cutoff:
                     recent.popleft()
             except IndexError:  # concurrent prune emptied it under us
                 pass
@@ -351,6 +360,54 @@ class HealthLedger:
             (component, cutoff),
         )
         return int(row[0]) if row else 0
+
+    def recent_transitions(self, component: str, limit: int = 0) -> List[Dict]:
+        """Newest-first transitions from the in-memory flap-window cache.
+
+        Bulk accessor for the predict scorer's hot tick: reads ONLY the
+        per-component deque (bounded by the flap window), never the DB,
+        and therefore never the BatchWriter flush barrier. Use
+        :meth:`history` when the full persisted timeline matters.
+        """
+        with self._mu:
+            recent = self._tx_recent.get(component)
+            if not recent:
+                return []
+            rows = list(recent)
+        if limit:
+            rows = rows[-limit:]
+        return [
+            {"component": component, "time": r[0], "from": r[1],
+             "to": r[2], "reason": r[3]}
+            for r in reversed(rows)
+        ]
+
+    def last_state(self, component: str) -> Optional[Dict]:
+        """Barrier-free current-episode view from the in-memory map:
+        ``{"state", "since", "last_seen"}`` — None before the component's
+        first observe() of this process."""
+        with self._mu:
+            ep = self._last.get(component)
+            if ep is None:
+                return None
+            return {"state": ep[0], "since": ep[1], "last_seen": ep[2]}
+
+    # -- external annotations (predict engine) ------------------------------
+    def set_annotation(self, component: str, key: str, value: str) -> None:
+        """Attach a marker that rides every subsequent observe() of the
+        component (merged into the returned annotation dict, flap marker
+        winning key collisions). Owned by external subsystems — the
+        predict engine stamps ``predicted`` here."""
+        with self._mu:
+            self._annotations.setdefault(component, {})[key] = value
+
+    def clear_annotation(self, component: str, key: str) -> None:
+        with self._mu:
+            ext = self._annotations.get(component)
+            if ext is not None:
+                ext.pop(key, None)
+                if not ext:
+                    self._annotations.pop(component, None)
 
     def _refresh_derived(self, component: str, now: float) -> None:
         # barrier=False: these run inside every observe(); forcing a

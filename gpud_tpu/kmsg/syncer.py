@@ -51,6 +51,10 @@ class Syncer:
         if self.deduper.seen_before(msg.message, msg.time):
             return None
         extra.update({"kmsg": msg.message, "priority": msg.priority_name})
+        # stable error taxonomy stamped at ingest: downstream featurizers
+        # (predict n-gram novelty) read this instead of re-regexing raw
+        # lines; a match_fn-supplied class wins
+        extra.setdefault("error_class", name)
         ev = Event(
             component=self.bucket.name(),
             time=msg.time,

@@ -1,0 +1,5 @@
+"""Predictive health: online precursor scoring (docs/predict.md)."""
+
+from gpud_tpu.predict.engine import PredictEngine
+
+__all__ = ["PredictEngine"]

@@ -187,13 +187,26 @@ class AuditStore:
             for r in self.db.query(sql, params)
         ]
 
-    def last_attempt_time(self, component: str) -> Optional[float]:
-        """Newest audit row for the component — the cooldown anchor."""
+    def last_attempt_time(
+        self, component: str, action: Optional[str] = None,
+        exclude_action: Optional[str] = None,
+    ) -> Optional[float]:
+        """Newest audit row for the component — the cooldown anchor.
+
+        ``action`` narrows to one action's lane (the predict engine
+        anchors its warning cooldown on its own rows); ``exclude_action``
+        carves a lane out (the reactive engine excludes predicted rows so
+        an early warning never defers the repair it predicted)."""
         self.flush()
-        row = self.db.query_one(
-            f"SELECT MAX(timestamp) FROM {TABLE} WHERE component=?",
-            (component,),
-        )
+        sql = f"SELECT MAX(timestamp) FROM {TABLE} WHERE component=?"
+        params: list = [component]
+        if action:
+            sql += " AND action=?"
+            params.append(action)
+        if exclude_action:
+            sql += " AND action<>?"
+            params.append(exclude_action)
+        row = self.db.query_one(sql, params)
         return row[0] if row and row[0] is not None else None
 
     def count(

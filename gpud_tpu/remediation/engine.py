@@ -42,6 +42,7 @@ from gpud_tpu.remediation.actions import Executors
 from gpud_tpu.remediation.audit import DEFAULT_RETENTION, AuditStore
 from gpud_tpu.remediation.policy import (
     ACTION_INSPECTION,
+    ACTION_PREDICTED,
     ACTION_REBOOT,
     ACTION_RESTART_RUNTIME,
     DECISION_BLOCKED_RATE_LIMIT,
@@ -262,7 +263,9 @@ class RemediationEngine:
     ) -> Optional[Dict]:
         if name in self._escalated:
             return None  # escalated: stop retrying until Healthy
-        last = self.audit.last_attempt_time(name)
+        last = self.audit.last_attempt_time(
+            name, exclude_action=ACTION_PREDICTED
+        )
         if last is not None and now - last < self.policy.cooldown_seconds:
             return None  # in cooldown — not a new attempt, no audit noise
         t0 = time.monotonic()

@@ -30,6 +30,10 @@ ACTION_SET_HEALTHY = "set_healthy"
 ACTION_RESTART_RUNTIME = "restart_runtime"
 ACTION_REBOOT = "reboot_system"
 ACTION_INSPECTION = "hardware_inspection"
+# advisory marker written by the predict engine's early warnings; never
+# executable, and deliberately its own cooldown lane — a prediction must
+# never defer the reactive repair of the fault it predicted
+ACTION_PREDICTED = "predicted_warning"
 
 # actions an operator can allowlist; INSPECTION is a manual marker and
 # never executes, so allowlisting it would be meaningless
@@ -183,6 +187,10 @@ def map_suggested_action(
     from gpud_tpu.api.v1.types import RepairActionType
 
     if repair_action == RepairActionType.IGNORE_NO_ACTION_REQUIRED:
+        return None
+    if repair_action == RepairActionType.PREDICTED_DEGRADATION:
+        # the predict engine's own warning path audits these as dry_run;
+        # a component echoing the suggestion must still never execute
         return None
     if repair_action == RepairActionType.CHECK_USER_APP_AND_TPU:
         return ACTION_RETRIGGER_CHECK

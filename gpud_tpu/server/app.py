@@ -323,6 +323,22 @@ def build_app(srv: "Server") -> web.Application:
             }
         )
 
+    async def predict_scores(req: web.Request) -> web.Response:
+        """Precursor scores (docs/predict.md): per-component fused score,
+        feature breakdown, armed/warned state, and measured lead times
+        (?component= narrows; ?history=N appends the last N in-memory
+        score points per component)."""
+        eng = srv.predictor
+        if eng is None:
+            return _json({"error": "predict engine disabled"}, 404)
+        component = req.query.get("component", "")
+        history = int(_qfloat(req, "history", 0.0))
+        if history < 0:
+            history = 0
+        out = eng.scores(component=component, history_limit=history)
+        out["status"] = eng.status()
+        return _json(out)
+
     async def remediation_policy_get(_req: web.Request) -> web.Response:
         """Current remediation policy and guard state (allowlist,
         cooldown, rate limit, reboot-window, escalation)."""
@@ -602,6 +618,7 @@ def build_app(srv: "Server") -> web.Application:
     r.add_post("/v1/components/set-healthy", set_healthy)
     r.add_get("/v1/states", states)
     r.add_get("/v1/states/history", states_history)
+    r.add_get("/v1/predict/scores", predict_scores)
     r.add_get("/v1/remediation/audit", remediation_audit)
     r.add_get("/v1/remediation/policy", remediation_policy_get)
     r.add_post("/v1/remediation/policy", remediation_policy_post)

@@ -138,6 +138,34 @@ class Server:
                 writer=self.storage_writer,
             )
 
+        # predictive health engine: online precursor scoring that warns
+        # before hard faults (gpud_tpu/predict/, docs/predict.md).
+        # Advisory only — warnings annotate states, write dry-run audit
+        # rows, and publish to the outbox; nothing executes.
+        from gpud_tpu.predict import PredictEngine
+
+        self.predictor: Optional[PredictEngine] = None
+        if self.config.predict_enabled:
+            self.predictor = PredictEngine(
+                registry=None,  # attached below once the registry exists
+                ledger=self.health_ledger,
+                event_store=self.event_store,
+                remediation=self.remediation,
+                interval_seconds=float(self.config.predict_interval_seconds),
+                threshold=float(self.config.predict_threshold),
+                hysteresis=float(self.config.predict_hysteresis),
+                arm_ticks=self.config.predict_arm_ticks,
+                clear_ticks=self.config.predict_clear_ticks,
+                window_seconds=float(self.config.predict_window_seconds),
+                history_limit=self.config.predict_history_limit,
+                warn_cooldown_seconds=float(
+                    self.config.predict_warn_cooldown_seconds
+                ),
+                publish_interval_seconds=float(
+                    self.config.predict_publish_interval_seconds
+                ),
+            )
+
         # metrics pipeline (reference: server.go:223-242)
         self.metrics_registry = metrics_registry or DEFAULT_REGISTRY
         # in-process trace ring (served at /v1/debug/traces)
@@ -258,6 +286,8 @@ class Server:
             # fully-populated registry
             self.remediation.registry = self.registry
             self.remediation.executors.registry = self.registry
+        if self.predictor is not None:
+            self.predictor.registry = self.registry
 
         # shared kmsg watcher: one reader feeding every kmsg-consuming
         # component (reference hot-loop #2, SURVEY §3.1)
@@ -427,12 +457,24 @@ class Server:
                 dedupe_key=f"chaos:{result.get('scenario')}:{result.get('id')}",
             )
 
+        def on_predict(body: dict) -> None:
+            outbox.publish(
+                "predict_score",
+                body,
+                dedupe_key=(
+                    f"predict:{body.get('component')}:{body.get('event')}:"
+                    f"{body.get('ts')}"
+                ),
+            )
+
         self.event_store.on_insert = on_event
         self.health_ledger.on_transition = on_transition
         if self.remediation is not None:
             self.remediation.audit.on_record = on_audit
         if self.chaos is not None:
             self.chaos.on_result = on_chaos_result
+        if self.predictor is not None:
+            self.predictor.on_publish = on_predict
 
     def _outbox_replay_tick(self) -> int:
         """Scheduler job "session-outbox-replay": drain one batch of
@@ -544,6 +586,8 @@ class Server:
                 )
             if self.remediation is not None:
                 self.remediation.start(self.scheduler)
+            if self.predictor is not None:
+                self.predictor.start(self.scheduler)
             self.metrics_syncer.start(self.scheduler)
             self.self_metrics.start(self.scheduler)
             self.package_manager.start()
@@ -649,6 +693,8 @@ class Server:
                 logger.exception("component %s close failed", comp.name())
         if self.remediation is not None:
             self.remediation.close()
+        if self.predictor is not None:
+            self.predictor.close()
         if self.chaos is not None:
             # aborts any in-flight campaign's sleeps before the pool the
             # campaign runs on is drained

@@ -170,6 +170,22 @@ class Dispatcher:
                 out["availability"] = av
         return out
 
+    def _m_predictStatus(self, req: Dict) -> Dict:
+        """Predict engine rollup for the control plane: config + run
+        state plus per-component precursor scores (``component`` narrows,
+        ``history`` appends bounded score history) — the session twin of
+        ``GET /v1/predict/scores``."""
+        eng = getattr(self.server, "predictor", None)
+        if eng is None:
+            return {"error": "predict engine disabled"}
+        component = req.get("component", "")
+        history = int(req.get("history", 0))
+        out = eng.scores(
+            component=component, history_limit=max(0, history)
+        )
+        out["status"] = eng.status()
+        return out
+
     def _m_remediationStatus(self, req: Dict) -> Dict:
         """Remediation engine rollup for the control plane: policy + guard
         state plus the most recent audit rows (``limit``, ``since``,

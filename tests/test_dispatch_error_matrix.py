@@ -100,6 +100,11 @@ MATRIX = [
     ("remediationStatus", {"since": "yesterday"}, "error"),
     ("remediationStatus", {"limit": "lots"}, "error"),
     ("remediationStatus", {"component": "no-such-component"}, "ok"),
+    # predict: bad history type errors; unknown component is empty-ok
+    ("predictStatus", {}, "ok"),
+    ("predictStatus", {"history": "lots"}, "error"),
+    ("predictStatus", {"history": 4}, "ok"),
+    ("predictStatus", {"component": "no-such-component"}, "ok"),
     ("remediationPolicy", {}, "ok"),
     ("remediationPolicy", {"policy": "not-a-dict"}, "no-crash"),
     ("remediationPolicy", {"policy": {"enforce_actions": ["bogus"]}}, "no-crash"),

@@ -43,6 +43,7 @@ ROUTES_GET = [
     "/v1/events", "/v1/metrics", "/v1/info", "/v1/plugins", "/metrics",
     "/machine-info", "/admin/config", "/admin/packages",
     "/v1/components/trigger-check?componentName=cpu",
+    "/v1/predict/scores", "/v1/predict/scores?component=cpu&history=4",
 ]
 
 

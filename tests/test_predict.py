@@ -1,0 +1,553 @@
+"""Predict subsystem (gpud_tpu/predict/): seeded deterministic feature
+extractors (EWMA + CUSUM changepoint, cadence, trajectory, n-gram
+novelty), noisy-OR fusion bounds, the engine's hysteresis no-flap
+property, warn/clear lifecycle under a fake clock, lead-time
+measurement, and the predicted-action dry-run invariant in the
+remediation audit ledger."""
+
+import math
+
+import pytest
+
+from gpud_tpu.api.v1.types import (
+    Event,
+    EventType,
+    HealthStateType,
+    RepairActionType,
+)
+from gpud_tpu.predict import PredictEngine
+from gpud_tpu.predict.engine import EVENT_NAME_PREDICTED
+from gpud_tpu.predict.features import (
+    FEATURE_WEIGHTS,
+    Ewma,
+    LatencyDrift,
+    NgramNovelty,
+    cadence_score,
+    clamp01,
+    fuse,
+    trajectory_score,
+)
+from gpud_tpu.remediation.audit import AuditStore
+from gpud_tpu.remediation.policy import (
+    ACTION_PREDICTED,
+    DECISION_DRY_RUN,
+    OUTCOME_DRY_RUN,
+    map_suggested_action,
+)
+
+
+@pytest.fixture()
+def clock():
+    state = {"now": 1000.0}
+
+    def now():
+        return state["now"]
+
+    now.advance = lambda dt: state.__setitem__("now", state["now"] + dt)
+    now.set = lambda t: state.__setitem__("now", t)
+    return now
+
+
+# -- fusion ------------------------------------------------------------------
+
+
+def test_fuse_bounds_and_weights():
+    assert fuse({}) == 0.0
+    assert fuse({k: 0.0 for k in FEATURE_WEIGHTS}) == 0.0
+    full = fuse({k: 1.0 for k in FEATURE_WEIGHTS})
+    assert 0.0 < full < 1.0  # noisy-OR never saturates to exactly 1
+    # each feature alone contributes exactly its weight
+    for name, w in FEATURE_WEIGHTS.items():
+        assert fuse({name: 1.0}) == pytest.approx(w)
+    # structural zero-false-positive guard: latency drift alone can
+    # never cross the default 0.6 warning threshold
+    assert fuse({"latency": 1.0}) < 0.6
+
+
+def test_fuse_monotone_and_hostile_inputs():
+    base = {"latency": 0.3, "cadence": 0.4, "trajectory": 0.2, "ngram": 0.1}
+    prev = fuse(base)
+    for step in (0.5, 0.8, 1.0):
+        cur = fuse({**base, "cadence": step})
+        assert cur >= prev
+        prev = cur
+    # NaN / out-of-range evidence is neutralized, not propagated
+    assert fuse({"cadence": float("nan")}) == 0.0
+    assert fuse({"cadence": 7.0}) == fuse({"cadence": 1.0})
+    assert fuse({"cadence": -3.0}) == 0.0
+    assert clamp01(float("nan")) == 0.0
+
+
+# -- EWMA + latency changepoint ---------------------------------------------
+
+
+def test_ewma_deterministic_replay():
+    series = [0.1, 0.12, 0.11, 0.13, 0.1, 0.5, 0.52]
+    a, b = Ewma(alpha=0.3), Ewma(alpha=0.3)
+    for x in series:
+        a.update(x)
+        b.update(x)
+    assert a.mean == b.mean and a.var == b.var  # bit-identical replay
+    assert a.z(0.11) < a.z(5.0)
+    assert Ewma().z(99.0) == 0.0  # no baseline yet → no score
+
+
+def test_latency_drift_warmup_then_changepoint():
+    d = LatencyDrift(warmup=5)
+    total_sum, total_count = 0.0, 0
+    # warmup + stable phase: 10ms checks, never scores
+    for _ in range(12):
+        total_sum += 0.010
+        total_count += 1
+        assert d.update(total_sum, total_count) == 0.0
+    # persistent 10x drift accumulates through the CUSUM
+    scores = []
+    for _ in range(10):
+        total_sum += 0.100
+        total_count += 1
+        scores.append(d.update(total_sum, total_count))
+    assert scores[-1] > 0.5
+    assert scores == sorted(scores)  # monotone ramp under sustained drift
+
+
+def test_latency_drift_holds_and_resets():
+    d = LatencyDrift(warmup=2)
+    total_sum, total_count = 0.0, 0
+    for _ in range(8):
+        total_sum += 0.010
+        total_count += 1
+        d.update(total_sum, total_count)
+    for _ in range(6):
+        total_sum += 0.200
+        total_count += 1
+        last = d.update(total_sum, total_count)
+    assert last > 0.0
+    # no new checks landed → hold the score, don't decay through a stall
+    assert d.update(total_sum, total_count) == last
+    # cumulative counters going backwards (registry reset) → full reset
+    assert d.update(0.0, 0) == last  # count delta <= 0: still a hold
+    assert d.update(total_sum - 1.0, total_count + 1) == 0.0
+
+
+def test_latency_drift_single_spike_forgiven():
+    d = LatencyDrift(warmup=5)
+    total_sum, total_count = 0.0, 0
+    for _ in range(10):
+        total_sum += 0.010
+        total_count += 1
+        d.update(total_sum, total_count)
+    total_sum += 0.500  # one slow check
+    total_count += 1
+    spike = d.update(total_sum, total_count)
+    for _ in range(6):
+        total_sum += 0.010
+        total_count += 1
+        calm = d.update(total_sum, total_count)
+    assert calm <= spike  # CUSUM drains back on a return to baseline
+
+
+# -- cadence / trajectory ----------------------------------------------------
+
+
+def test_cadence_score_threshold_proximity_and_accel():
+    now, window = 1000.0, 600.0
+    assert cadence_score([], now, window, saturation=5) == 0.0
+    assert cadence_score([100.0], now, window, saturation=5) == 0.0  # aged out
+    # three old-half transitions: pure proximity, no acceleration bonus
+    old = [500.0, 550.0, 600.0]
+    assert cadence_score(old, now, window, saturation=5) == pytest.approx(0.6)
+    # same count in the recent half-window → +0.2 acceleration
+    fresh = [900.0, 950.0, 990.0]
+    assert cadence_score(fresh, now, window, saturation=5) == pytest.approx(0.8)
+    assert cadence_score([now - i for i in range(20)], now, window) == 1.0
+
+
+def test_trajectory_requires_fresh_deterioration(clock):
+    now, window = 1000.0, 600.0
+    degraded = HealthStateType.DEGRADED
+    healthy = HealthStateType.HEALTHY
+    # chronically degraded with no in-window transition scores ZERO —
+    # steady-state badness is the reactive detector's business
+    assert trajectory_score(degraded, [], now, window) == 0.0
+    assert (
+        trajectory_score(
+            degraded, [(100.0, healthy, degraded)], now, window
+        )
+        == 0.0
+    )
+    # fresh transition into a bad state while still bad → full evidence
+    assert (
+        trajectory_score(
+            degraded, [(950.0, healthy, degraded)], now, window
+        )
+        == 1.0
+    )
+    # recovered: decayed evidence from the newest in-window excursion
+    s = trajectory_score(healthy, [(950.0, healthy, degraded)], now, window)
+    assert 0.0 < s <= 0.6
+    assert s == pytest.approx(0.6 * math.exp(-50.0 / 150.0))
+    # transitions INTO healthy are not deterioration
+    assert (
+        trajectory_score(healthy, [(990.0, degraded, healthy)], now, window)
+        == 0.0
+    )
+
+
+# -- n-gram novelty ----------------------------------------------------------
+
+
+def test_ngram_novelty_watermark_and_decay():
+    ng = NgramNovelty(hold_decay=0.5)
+    first = ng.update([(10.0, "tpu_ici_link_down")])
+    assert first > 0.0
+    # replaying the SAME window (ts <= watermark) mints nothing new and
+    # the held score decays instead of re-spiking
+    second = ng.update([(10.0, "tpu_ici_link_down")])
+    assert second < first
+    # a never-seen class at a newer ts is news again
+    third = ng.update(
+        [(10.0, "tpu_ici_link_down"), (20.0, "tpu_hbm_ecc_error")]
+    )
+    assert third > second
+    # decay floors to exactly zero, not a forever-epsilon
+    for _ in range(30):
+        last = ng.update([])
+    assert last == 0.0
+
+
+def test_ngram_novelty_known_sequence_scores_below_novel():
+    a = NgramNovelty()
+    a.update([(1.0, "x"), (2.0, "y")])
+    for _ in range(40):
+        a.update([])  # drain the hold
+    known = a.update([(100.0, "x"), (101.0, "y")])
+    novel = NgramNovelty().update([(100.0, "x"), (101.0, "y")])
+    assert known < novel
+
+
+# -- engine: stubs -----------------------------------------------------------
+
+
+class StubRegistry:
+    def __init__(self, *names):
+        self._names = list(names)
+
+    def names(self):
+        return list(self._names)
+
+
+class StubLedger:
+    flap_threshold = 5
+
+    def __init__(self):
+        self.transitions = []
+        self.state = None
+        self.annotations = {}
+
+    def recent_transitions(self, component, limit=0):
+        rows = list(self.transitions)
+        if limit:
+            rows = rows[-limit:]
+        return rows
+
+    def last_state(self, component):
+        return {"state": self.state, "since": 0.0, "last_seen": 0.0} \
+            if self.state else None
+
+    def set_annotation(self, component, key, value):
+        self.annotations.setdefault(component, {})[key] = value
+
+    def clear_annotation(self, component, key):
+        self.annotations.get(component, {}).pop(key, None)
+
+
+class StubBucket:
+    def __init__(self):
+        self.events = []
+
+    def get(self, since):
+        return [e for e in self.events if e.time >= since]
+
+    def insert(self, ev):
+        self.events.append(ev)
+
+
+class StubEventStore:
+    def __init__(self):
+        self.buckets = {}
+
+    def bucket(self, name):
+        return self.buckets.setdefault(name, StubBucket())
+
+
+def _engine(clock, scripted_scores=None, monkeypatch=None, **kw):
+    """Engine over stub collaborators; optionally replaces the fusion
+    with a scripted score sequence to drive hysteresis directly."""
+    kw.setdefault("registry", StubRegistry("c0"))
+    kw.setdefault("ledger", StubLedger())
+    kw.setdefault("event_store", StubEventStore())
+    kw.setdefault("arm_ticks", 2)
+    kw.setdefault("clear_ticks", 3)
+    kw.setdefault("threshold", 0.6)
+    kw.setdefault("hysteresis", 0.15)
+    eng = PredictEngine(**kw)
+    eng.time_now_fn = clock
+    if scripted_scores is not None:
+        it = iter(scripted_scores)
+        monkeypatch.setattr(
+            "gpud_tpu.predict.engine.fuse", lambda features: next(it)
+        )
+    return eng
+
+
+# -- engine: hysteresis no-flap property ------------------------------------
+
+
+def test_hysteresis_dead_band_neither_arms_nor_clears(clock, monkeypatch):
+    # dead band is (threshold - hysteresis, threshold) = (0.45, 0.6):
+    # a score dithering inside it must not arm, and once armed must
+    # not clear — the no-flap property
+    script = (
+        [0.55, 0.50, 0.58, 0.46, 0.59, 0.55]   # dither below arm line
+        + [0.70, 0.70]                         # arm (arm_ticks=2)
+        + [0.50, 0.46, 0.58, 0.55, 0.50, 0.59]  # dither: stays armed
+        + [0.30, 0.30, 0.30]                   # clear (clear_ticks=3)
+    )
+    eng = _engine(clock, script, monkeypatch)
+    events = []
+    eng.on_publish = lambda body: events.append(body["event"])
+
+    for _ in range(6):
+        eng.tick_once()
+        clock.advance(1.0)
+    assert eng.scores()["components"]["c0"]["armed"] is False
+    for _ in range(2):
+        eng.tick_once()
+        clock.advance(1.0)
+    assert eng.scores()["components"]["c0"]["armed"] is True
+    for _ in range(6):
+        eng.tick_once()
+        clock.advance(1.0)
+    assert eng.scores()["components"]["c0"]["armed"] is True  # no flap
+    for _ in range(3):
+        eng.tick_once()
+        clock.advance(1.0)
+    snap = eng.scores()["components"]["c0"]
+    assert snap["armed"] is False
+    assert snap["warnings"] == 1  # exactly one warn over the whole dither
+    assert events.count("warn") == 1 and events.count("clear") == 1
+
+
+def test_single_spike_does_not_arm(clock, monkeypatch):
+    eng = _engine(clock, [0.2, 0.9, 0.2, 0.9, 0.2, 0.9], monkeypatch)
+    for _ in range(6):
+        eng.tick_once()
+        clock.advance(1.0)
+    snap = eng.scores()["components"]["c0"]
+    assert snap["armed"] is False and snap["warnings"] == 0
+
+
+# -- engine: warn/clear lifecycle -------------------------------------------
+
+
+def test_warn_emits_event_annotation_and_publish(clock, monkeypatch):
+    ledger = StubLedger()
+    store = StubEventStore()
+    eng = _engine(
+        clock, [0.8, 0.8, 0.8, 0.1, 0.1, 0.1], monkeypatch,
+        ledger=ledger, event_store=store,
+    )
+    bodies = []
+    eng.on_publish = bodies.append
+    for _ in range(2):
+        eng.tick_once()
+        clock.advance(1.0)
+    # warned: ledger annotation set, Warning event in the bucket
+    assert ledger.annotations["c0"]["predicted"] == "true"
+    evs = store.bucket("c0").events
+    predicted = [e for e in evs if e.name == EVENT_NAME_PREDICTED]
+    assert len(predicted) == 1
+    assert predicted[0].type == EventType.WARNING
+    assert float(predicted[0].extra_info["score"]) >= 0.6
+    assert bodies and bodies[0]["event"] == "warn"
+    assert bodies[0]["component"] == "c0" and bodies[0]["armed"] is True
+    # armed ticks refresh the live score annotation
+    eng.tick_once()
+    clock.advance(1.0)
+    assert "predicted_score" in ledger.annotations["c0"]
+    # clear: annotations dropped, clear published
+    for _ in range(3):
+        eng.tick_once()
+        clock.advance(1.0)
+    assert ledger.annotations["c0"] == {}
+    assert [b["event"] for b in bodies][-1] == "clear"
+
+
+def test_reset_drops_state_and_annotations(clock, monkeypatch):
+    ledger = StubLedger()
+    eng = _engine(clock, [0.8, 0.8], monkeypatch, ledger=ledger)
+    for _ in range(2):
+        eng.tick_once()
+        clock.advance(1.0)
+    assert eng.scores()["components"]["c0"]["armed"] is True
+    eng.reset(component="c0")
+    assert "c0" not in eng.scores()["components"]
+    assert ledger.annotations.get("c0", {}) == {}
+
+
+def test_lead_measured_once_per_episode(clock, monkeypatch):
+    ledger = StubLedger()
+    eng = _engine(
+        clock, [0.8] * 6, monkeypatch, ledger=ledger,
+    )
+    leads = []
+    eng.on_publish = lambda b: leads.append(b) if b["event"] == "lead" else None
+    for _ in range(2):
+        eng.tick_once()
+        clock.advance(1.0)
+    warned_at = eng.scores()["components"]["c0"]["warned_at"]
+    assert warned_at is not None
+    # the reactive detector trips 5s after the warning
+    ledger.transitions = [{
+        "component": "c0", "time": warned_at + 5.0,
+        "from": HealthStateType.DEGRADED, "to": HealthStateType.UNHEALTHY,
+        "reason": "hard fault",
+    }]
+    clock.set(warned_at + 6.0)
+    eng.tick_once()
+    snap = eng.scores()["components"]["c0"]
+    assert snap["lead_seconds"] == pytest.approx(5.0)
+    assert len(leads) == 1
+    # further ticks do not re-measure the same episode
+    eng.tick_once()
+    assert len(leads) == 1
+    assert eng.scores()["components"]["c0"]["lead_seconds"] == pytest.approx(5.0)
+
+
+def test_transitions_before_warning_never_measure_lead(clock, monkeypatch):
+    ledger = StubLedger()
+    # an Unhealthy transition that happened BEFORE the warning is not a
+    # "predicted" fault — the measurement must wait for the next one
+    ledger.transitions = [{
+        "component": "c0", "time": clock() - 10.0,
+        "from": HealthStateType.HEALTHY, "to": HealthStateType.UNHEALTHY,
+        "reason": "old fault",
+    }]
+    eng = _engine(clock, [0.8] * 4, monkeypatch, ledger=ledger)
+    for _ in range(4):
+        eng.tick_once()
+        clock.advance(1.0)
+    assert eng.scores()["components"]["c0"]["lead_seconds"] is None
+
+
+# -- predicted-action dry-run invariant -------------------------------------
+
+
+def test_predicted_audit_rows_are_dry_run_only(clock, monkeypatch, tmp_db):
+    audit = AuditStore(tmp_db)
+    audit.time_now_fn = clock
+
+    class StubRemediation:
+        pass
+
+    rem = StubRemediation()
+    rem.audit = audit
+    eng = _engine(
+        clock, [0.8] * 2, monkeypatch,
+        remediation=rem, warn_cooldown_seconds=300.0,
+    )
+    for _ in range(2):
+        eng.tick_once()
+        clock.advance(1.0)
+    rows = audit.read(component="c0")
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["action"] == ACTION_PREDICTED
+    assert row["suggested"] == RepairActionType.PREDICTED_DEGRADATION
+    assert row["decision"] == DECISION_DRY_RUN
+    assert row["outcome"] == OUTCOME_DRY_RUN
+    # the suggestion is unmappable by design: no executor path exists
+    assert map_suggested_action(
+        RepairActionType.PREDICTED_DEGRADATION, None
+    ) is None
+    # lane isolation: the predicted row anchors ONLY the predict lane —
+    # the reactive engine's cooldown anchor must not see it
+    assert audit.last_attempt_time("c0", action=ACTION_PREDICTED) is not None
+    assert audit.last_attempt_time(
+        "c0", exclude_action=ACTION_PREDICTED
+    ) is None
+
+
+def test_predicted_warn_cooldown_limits_audit_rows(clock, monkeypatch, tmp_db):
+    audit = AuditStore(tmp_db)
+    audit.time_now_fn = clock
+
+    class StubRemediation:
+        pass
+
+    rem = StubRemediation()
+    rem.audit = audit
+    # arm → clear → re-arm inside the cooldown window: one audit row;
+    # re-arm after the window: a second row
+    script = [0.8, 0.8, 0.1, 0.1, 0.1, 0.8, 0.8, 0.1, 0.1, 0.1, 0.8, 0.8]
+    eng = _engine(
+        clock, script, monkeypatch,
+        remediation=rem, warn_cooldown_seconds=300.0,
+    )
+    for _ in range(10):
+        eng.tick_once()
+        clock.advance(1.0)
+    assert len(audit.read(component="c0")) == 1  # second warn suppressed
+    assert eng.scores()["components"]["c0"]["warnings"] == 2  # but counted
+    clock.advance(400.0)  # cooldown expires
+    for _ in range(2):
+        eng.tick_once()
+        clock.advance(1.0)
+    assert len(audit.read(component="c0")) == 2
+
+
+# -- engine robustness -------------------------------------------------------
+
+
+def test_one_component_failure_does_not_stop_the_scan(clock, monkeypatch):
+    class ExplodingLedger(StubLedger):
+        def recent_transitions(self, component, limit=0):
+            if component == "bad":
+                raise RuntimeError("boom")
+            return super().recent_transitions(component, limit)
+
+    eng = _engine(
+        clock, None, monkeypatch,
+        registry=StubRegistry("bad", "good"), ledger=ExplodingLedger(),
+    )
+    out = eng.tick_once()
+    assert "good" in out and "bad" not in out
+    assert eng.status()["ticks"] == 1
+
+
+def test_disabled_engine_is_inert(clock):
+    eng = PredictEngine(enabled=False, registry=StubRegistry("c0"))
+    eng.time_now_fn = clock
+    assert eng.tick_once() == {}
+    eng.poke()  # must not raise, must not tick
+    assert eng.status()["ticks"] == 0
+
+
+def test_scores_view_shapes(clock, monkeypatch):
+    eng = _engine(clock, [0.3, 0.3], monkeypatch)
+    for _ in range(2):
+        eng.tick_once()
+        clock.advance(1.0)
+    full = eng.scores(history_limit=8)
+    comp = full["components"]["c0"]
+    assert set(comp) >= {
+        "score", "features", "armed", "warned_at", "lead_seconds",
+        "warnings", "history",
+    }
+    assert len(comp["history"]) == 2
+    assert [h["score"] for h in comp["history"]] == [0.3, 0.3]
+    # unknown-component filter is empty-ok, not an error
+    assert eng.scores(component="nope")["components"] == {}
+    st = eng.status()
+    assert st["components_tracked"] == 1 and st["armed"] == []
+    assert st["feature_weights"] == FEATURE_WEIGHTS
