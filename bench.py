@@ -1112,7 +1112,10 @@ def bench_fleet(agents: int = FLEET_TARGET_AGENTS,
     from gpud_tpu.session import wire
 
     tmp = tempfile.mkdtemp(prefix="tpud-fleet-")
-    cp = ControlPlane(data_dir=os.path.join(tmp, "manager"))
+    # single shard + inline ingest (below): the PR-12 configuration, so
+    # these numbers stay comparable release over release; the sharded
+    # real-socket path has its own bench + gates (--fleet --socket)
+    cp = ControlPlane(data_dir=os.path.join(tmp, "manager"), shards=1)
     cp.start()
     base = cp.endpoint
     sess = requests.Session()
@@ -1139,6 +1142,9 @@ def bench_fleet(agents: int = FLEET_TARGET_AGENTS,
         # so keep the per-handle tail small to bound manager memory
         h.outbox_records_max = 64
         cp._register(h)
+        # run ingest inline on resolve() like PR 12 did, so the measured
+        # rate is the store's own throughput, not enqueue speed
+        h.ingest_executor = None
         handles.append(h)
 
     components = ["tpu-hbm", "tpu-ici", "tpu-kmsg", "tpu-runtime"]
@@ -1287,6 +1293,378 @@ def bench_fleet(agents: int = FLEET_TARGET_AGENTS,
     return 0 if ok else 1
 
 
+FLEET_SOCKET_AGENTS = 2048
+FLEET_SOCKET_RECORDS_PER_AGENT = 120
+FLEET_SOCKET_TARGET_INGEST_PER_SEC = 80_000
+FLEET_SOCKET_COLD_P95_MS = 500.0
+FLEET_SOCKET_CACHED_P95_MS = 50.0
+FLEET_SOCKET_MAX_RSS_DELTA_MB = 400.0
+FLEET_SOCKET_READER_STALL_P95_MS = 50.0
+FLEET_SOCKET_CONCURRENCY = 48  # < manager max_v2_agents (64): no queueing
+FLEET_REBUILD_MIN_ROWS = 200_000
+# The absolute ingest target assumes a reference CI box with this many
+# cores; on smaller hosts the gate scales linearly (client, server, and
+# storage all share the same cores in this bench, so aggregate rec/s is
+# CPU-bound — a 1-core container physically cannot clear the 8-core
+# number, and a fixed absolute gate would only measure the host).
+FLEET_SOCKET_REFERENCE_CORES = 8
+
+
+def _usable_cores() -> int:
+    try:
+        return max(1, len(os.sched_getaffinity(0)))
+    except AttributeError:
+        return max(1, os.cpu_count() or 1)
+
+
+def bench_fleet_socket(agents: int = FLEET_SOCKET_AGENTS,
+                       records_per_agent: int = FLEET_SOCKET_RECORDS_PER_AGENT,
+                       shards: int = 0) -> int:
+    """``--fleet --socket`` mode: drive thousands of simulated agents
+    through the REAL v2 gRPC Frame tunnel (rev-3 wire path: Hello/
+    HelloAck negotiation, delta-encoded ``outbox_batch`` frames, the
+    manager's per-stream reader offloading onto the sharded ingest
+    executor, cumulative ``outboxAck`` frames back) — not in-process
+    ``AgentHandle`` calls. Gates: aggregate ingest records/sec, cold and
+    cached rollup p95, reader-thread stall p95 (the executor enqueue
+    latency — if this grows, the offload regressed to inline), manager
+    RSS delta, zero loss. The ingest gate is stated for an 8-core
+    reference box and scales linearly down on smaller hosts (driver,
+    server, and storage share this machine's cores). Afterwards, the
+    journal (≥200k rows) is replayed twice — serial and parallel — and
+    both replays must produce byte-identical rollups; on a multi-core
+    host the parallel replay must also be faster."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import shutil
+    import threading
+
+    import grpc
+    import requests
+
+    from gpud_tpu.manager.control_plane import ControlPlane
+    from gpud_tpu.manager.rollup import FleetRollupStore
+    from gpud_tpu.session import wire
+    from gpud_tpu.session.v2 import session_pb2 as pb
+    from gpud_tpu.session.v2.client import METHOD
+    from gpud_tpu.sqlite import DB
+
+    tmp = tempfile.mkdtemp(prefix="tpud-fleet-sock-")
+    data_dir = os.path.join(tmp, "manager")
+    concurrency = min(
+        int(os.environ.get("TPUD_BENCH_CONC", str(FLEET_SOCKET_CONCURRENCY))),
+        agents,
+    )
+    # every live v2 stream pins one server pool thread, so the pool is
+    # sized for the driver concurrency (each driver cycles its agents
+    # through one stream at a time), with headroom for stream-close tails
+    cp = ControlPlane(
+        data_dir=data_dir, shards=shards or None,
+        max_v2_agents=concurrency + 16,
+    )
+    cp.start()
+    base = cp.endpoint
+    target = f"127.0.0.1:{cp.grpc_port}"
+    sess = requests.Session()
+
+    # -- pre-encode every agent's frames OUTSIDE the measured window: the
+    # bench gates the manager's ingest plane, not the simulator's encode
+    # loop (a real fleet encodes on 2048 separate machines)
+    components = ["tpu-hbm", "tpu-ici", "tpu-kmsg", "tpu-runtime"]
+    batch_size = int(os.environ.get("TPUD_BENCH_BATCH", "60"))
+    t_base = time.time()
+    total = agents * records_per_agent
+    agent_work = []  # (machine_id, [AgentPacket frames], last_seq)
+    for i in range(agents):
+        machine_id = f"sock-{i:04d}"
+        enc = wire.DeltaEncoder()
+        frames = []
+        recs = []
+        for n in range(records_per_agent):
+            comp = components[n % len(components)]
+            to = "Unhealthy" if n % 2 == 0 else "Healthy"
+            frm = "Healthy" if to == "Unhealthy" else "Unhealthy"
+            ts = t_base + n * 0.001
+            payload = {"component": comp, "from": frm, "to": to,
+                       "ts": ts, "reason": "bench"}
+            if i == 0 and n == 0:
+                payload["correlation_id"] = "bench-cid-socket"
+            recs.append(enc.encode_record(
+                n + 1, ts, "transition",
+                f"transition:{comp}:{ts}:{to}", payload,
+            ))
+            if len(recs) >= batch_size or n == records_per_agent - 1:
+                pkt = pb.AgentPacket()
+                pkt.frame.req_id = f"outbox-{n + 1}"
+                pkt.frame.data = wire.encode_payload(wire.build_batch(recs))
+                frames.append(pkt)
+                recs = []
+        agent_work.append((machine_id, frames, records_per_agent))
+
+    ingest_done = threading.Event()
+    cold_lat_ms: list = []
+    read_errors: list = []
+
+    def _operator_load() -> None:
+        # a dashboard polling the plane mid-burst: throttled, because the
+        # point is measuring read latency UNDER ingest, not turning the
+        # operator API itself into the dominant load on the box
+        while not ingest_done.is_set():
+            for path in ("/v1/fleet/rollup", "/v1/fleet/agents?limit=100"):
+                t = time.monotonic()
+                try:
+                    r = sess.get(f"{base}{path}", timeout=30)
+                    if r.status_code != 200:
+                        read_errors.append(f"{path}: HTTP {r.status_code}")
+                        return
+                except Exception as e:  # noqa: BLE001
+                    read_errors.append(f"{path}: {e}")
+                    return
+                cold_lat_ms.append((time.monotonic() - t) * 1000.0)
+            time.sleep(0.4)
+
+    failures: list = []
+    import queue as _q
+    driven = [0]
+
+    def _drive_agent(stream, machine_id, frames, last_seq) -> None:
+        """One agent session over the live tunnel: Hello/HelloAck, every
+        outbox frame, block until the manager's cumulative ack covers the
+        final seq (acks only queue after the shard journals — PR-12
+        contract), then half-close."""
+        out_q: "_q.Queue" = _q.Queue()
+        hello = pb.AgentPacket()
+        hello.hello.machine_id = machine_id
+        hello.hello.token = "bench"
+        hello.hello.revision = 1
+        hello.hello.min_revision = 1
+        hello.hello.max_revision = 3
+        out_q.put(hello)
+        for f in frames:
+            out_q.put(f)
+        call = stream(iter(out_q.get, None), timeout=120.0)
+        acked = False
+        for mpkt in call:
+            kind = mpkt.WhichOneof("payload")
+            if kind == "hello_ack":
+                if not mpkt.hello_ack.accepted:
+                    failures.append(f"{machine_id}: {mpkt.hello_ack.reason}")
+                    out_q.put(None)
+                    return
+                if mpkt.hello_ack.revision < 3:
+                    failures.append(f"{machine_id}: negotiated rev "
+                                    f"{mpkt.hello_ack.revision} < 3")
+            elif kind == "frame":
+                # outboxAck is outside the typed rev-2 method set, so the
+                # manager sends it through the Frame tunnel: rev-3
+                # wire-codec bytes carrying {"method": "outboxAck", ...}
+                try:
+                    data = wire.decode_payload(mpkt.frame.data)
+                except ValueError:
+                    continue
+                if (not acked and isinstance(data, dict)
+                        and data.get("method") == "outboxAck"
+                        and int(data.get("seq", 0)) >= last_seq):
+                    acked = True
+                    out_q.put(None)  # half-close; server ends the stream
+        if acked:
+            driven[0] += 1
+        else:
+            failures.append(f"{machine_id}: stream ended before final ack")
+
+    def _worker(work_slice) -> None:
+        channel = grpc.insecure_channel(target)
+        stream = channel.stream_stream(
+            METHOD,
+            request_serializer=pb.AgentPacket.SerializeToString,
+            response_deserializer=pb.ManagerPacket.FromString,
+        )
+        try:
+            for machine_id, frames, last_seq in work_slice:
+                try:
+                    _drive_agent(stream, machine_id, frames, last_seq)
+                except grpc.RpcError as e:
+                    failures.append(f"{machine_id}: {e.code()}")
+        finally:
+            channel.close()
+
+    slices = [agent_work[w::concurrency] for w in range(concurrency)]
+    rss0 = _rss_mb()
+    reader = threading.Thread(target=_operator_load, daemon=True)
+    reader.start()
+    workers = [threading.Thread(target=_worker, args=(s,), daemon=True)
+               for s in slices]
+    t0 = time.monotonic()
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=600)
+    elapsed = time.monotonic() - t0
+    ingest_done.set()
+    reader.join(timeout=60)
+    rate = total / elapsed if elapsed else 0.0
+
+    # every agent waited for its final cumulative ack, and acks only
+    # queue after the shard journals — so the journal already holds
+    # everything; the flushes below are just read barriers
+    exec_ok = cp.ingest_executor.flush(timeout=60)
+    if not cp.writer.flush(timeout=60.0):
+        print("[fleet-socket] WARNING: journal flush barrier timed out",
+              file=sys.stderr)
+    exec_stats = cp.ingest_executor.stats()
+    stall_p95 = exec_stats["submit_p95_ms"]
+    dropped = sum(exec_stats["dropped"])
+
+    cached_lat_ms = []
+    rollup = None
+    for _ in range(40):
+        for path in ("/v1/fleet/rollup", "/v1/fleet/agents?limit=100"):
+            t = time.monotonic()
+            r = sess.get(f"{base}{path}", timeout=30)
+            cached_lat_ms.append((time.monotonic() - t) * 1000.0)
+            if path == "/v1/fleet/rollup":
+                rollup = r.json()
+    traces = sess.get(
+        f"{base}/v1/fleet/traces?correlation_id=bench-cid-socket", timeout=30
+    ).json()
+    shard_metrics = [
+        line for line in sess.get(f"{base}/metrics", timeout=30).text.splitlines()
+        if line.startswith("tpud_fleet_shard_records{")
+    ]
+    rss_delta = _rss_mb() - rss0
+
+    cold_p95 = (statistics.quantiles(cold_lat_ms, n=20)[-1]
+                if len(cold_lat_ms) >= 2 else float("inf"))
+    cached_p95 = (statistics.quantiles(cached_lat_ms, n=20)[-1]
+                  if len(cached_lat_ms) >= 2 else float("inf"))
+    journaled = cp.rollup.journal_count()
+    shard_count = cp.rollup.shard_count
+    zero_loss = (
+        rollup is not None
+        and rollup["records_total"] == total
+        and journaled == total
+        and rollup["agents"] == agents
+        and driven[0] == agents
+        and not failures
+        and dropped == 0
+    )
+    correlated = traces.get("count", 0) >= 1
+    cp.stop()
+
+    # -- rebuild comparison on the journal this run wrote: serial replay
+    # vs one worker per shard, same shard count, byte-identical output
+    db = DB(os.path.join(data_dir, "fleet.db"))
+    try:
+        st_serial = FleetRollupStore(
+            db, None, shard_count=shard_count, rebuild_parallel=False
+        )
+        serial_s = st_serial.last_rebuild_seconds
+        roll_serial = st_serial.fleet_rollup()
+        st_par = FleetRollupStore(
+            db, None, shard_count=shard_count, rebuild_parallel=True
+        )
+        parallel_s = st_par.last_rebuild_seconds
+        roll_par = st_par.fleet_rollup()
+    finally:
+        db.close()
+    rebuild_identical = (
+        json.dumps(roll_serial, sort_keys=True)
+        == json.dumps(roll_par, sort_keys=True)
+    )
+    rebuild_speedup = serial_s / parallel_s if parallel_s > 0 else 0.0
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    cores = _usable_cores()
+    ingest_target = FLEET_SOCKET_TARGET_INGEST_PER_SEC * min(
+        1.0, cores / FLEET_SOCKET_REFERENCE_CORES
+    )
+    print(
+        f"[fleet-socket] ingest: {rate:,.0f} records/sec aggregate "
+        f"({total:,} records from {agents} agents over the v2 Frame "
+        f"tunnel in {elapsed:.2f}s, {shard_count} shards, "
+        f"{concurrency} drivers) [target >= {ingest_target:,.0f}: "
+        f"{FLEET_SOCKET_TARGET_INGEST_PER_SEC:,} @ "
+        f"{FLEET_SOCKET_REFERENCE_CORES} cores, host has {cores}]",
+        file=sys.stderr,
+    )
+    print(
+        f"[fleet-socket] rollup p95: cold {cold_p95:.1f}ms over "
+        f"{len(cold_lat_ms)} reads under ingest "
+        f"[<= {FLEET_SOCKET_COLD_P95_MS:g}], cached {cached_p95:.1f}ms "
+        f"[<= {FLEET_SOCKET_CACHED_P95_MS:g}]; reader-stall p95 "
+        f"{stall_p95:.3f}ms [<= {FLEET_SOCKET_READER_STALL_P95_MS:g}], "
+        f"backpressure drops {dropped}",
+        file=sys.stderr,
+    )
+    print(
+        f"[fleet-socket] journal: {journaled:,} rows "
+        f"(zero_loss={zero_loss}, failures={len(failures)}), "
+        f"correlation stitch={'ok' if correlated else 'MISSING'}, "
+        f"RSS delta {rss_delta:.1f}MB [<= {FLEET_SOCKET_MAX_RSS_DELTA_MB:g}], "
+        f"shard series exposed={len(shard_metrics)}",
+        file=sys.stderr,
+    )
+    # On >1 core the parallel replay must actually win; on a 1-core host
+    # the store degrades to serial replay internally (rollup._rebuild
+    # caps its pool at the core count), so the honest gate there is
+    # "parallel adds no material overhead", not a speedup it cannot have.
+    rebuild_ok = rebuild_identical and (
+        parallel_s < serial_s if cores > 1 else parallel_s <= serial_s * 1.25
+    )
+    print(
+        f"[fleet-socket] rebuild ({journaled:,}-row journal, "
+        f"{shard_count} shards): serial {serial_s:.3f}s vs parallel "
+        f"{parallel_s:.3f}s ({rebuild_speedup:.2f}x on {cores} core(s)) "
+        f"byte-identical={rebuild_identical} "
+        f"[{'parallel < serial' if cores > 1 else 'parallel <= 1.25x serial'}]",
+        file=sys.stderr,
+    )
+    if failures:
+        print(f"[fleet-socket] FAILURES: {failures[:5]}", file=sys.stderr)
+    if read_errors:
+        print(f"[fleet-socket] READ ERRORS: {read_errors[:5]}",
+              file=sys.stderr)
+    ok = (
+        rate >= ingest_target
+        and cold_p95 <= FLEET_SOCKET_COLD_P95_MS
+        and cached_p95 <= FLEET_SOCKET_CACHED_P95_MS
+        and stall_p95 <= FLEET_SOCKET_READER_STALL_P95_MS
+        and rss_delta <= FLEET_SOCKET_MAX_RSS_DELTA_MB
+        and zero_loss
+        and correlated
+        and exec_ok
+        and not read_errors
+        and (journaled < FLEET_REBUILD_MIN_ROWS or rebuild_ok)
+    )
+    print(json.dumps({
+        "metric": "fleet socket ingest throughput",
+        "value": round(rate, 1),
+        "unit": "records/sec",
+        "vs_baseline": round(rate / FLEET_SOCKET_TARGET_INGEST_PER_SEC, 2),
+        "detail": {
+            "agents": agents,
+            "records_total": total,
+            "cores": cores,
+            "ingest_target": round(ingest_target, 1),
+            "shards": shard_count,
+            "elapsed_s": round(elapsed, 3),
+            "cold_p95_ms": round(cold_p95, 2),
+            "cached_p95_ms": round(cached_p95, 2),
+            "reader_stall_p95_ms": round(stall_p95, 4),
+            "backpressure_drops": dropped,
+            "rss_delta_mb": round(rss_delta, 1),
+            "journal_rows": journaled,
+            "zero_loss": zero_loss,
+            "rebuild_serial_s": round(serial_s, 3),
+            "rebuild_parallel_s": round(parallel_s, 3),
+            "rebuild_speedup": round(rebuild_speedup, 2),
+            "rebuild_identical": rebuild_identical,
+            "pass": ok,
+        },
+    }))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -1344,7 +1722,34 @@ def main(argv=None) -> int:
         help="simulated agents to enroll for --fleet (default "
              f"{FLEET_TARGET_AGENTS})",
     )
+    ap.add_argument(
+        "--socket", action="store_true",
+        help="with --fleet: drive the agents through the real v2 gRPC "
+             "Frame tunnel (rev-3 wire path, sharded ingest executor) "
+             f"instead of in-process handles; defaults to "
+             f"{FLEET_SOCKET_AGENTS} agents and gates ingest rate, "
+             "rollup p95s, reader-stall p95, RSS, zero loss, and the "
+             "serial-vs-parallel journal rebuild",
+    )
+    ap.add_argument(
+        "--fleet-records", type=int, default=FLEET_SOCKET_RECORDS_PER_AGENT,
+        help="records per agent for --fleet --socket (default "
+             f"{FLEET_SOCKET_RECORDS_PER_AGENT})",
+    )
+    ap.add_argument(
+        "--fleet-shards", type=int, default=0,
+        help="manager shard count for --fleet --socket (default: the "
+             "manager's own default)",
+    )
     args = ap.parse_args(argv)
+    if args.fleet and args.socket:
+        return bench_fleet_socket(
+            agents=(args.fleet_agents
+                    if args.fleet_agents != FLEET_TARGET_AGENTS
+                    else FLEET_SOCKET_AGENTS),
+            records_per_agent=args.fleet_records,
+            shards=args.fleet_shards,
+        )
     if args.fleet:
         return bench_fleet(agents=args.fleet_agents)
     if args.predict:

@@ -66,14 +66,29 @@ class FakeControlPlane:
         # can assert rollup/ingest consistency (`fleet` expectations)
         self.rollup = None
 
-    def attach_rollup(self):
-        """Attach an in-memory FleetRollupStore fed by the outbox ingest
-        path; returns the store. Synchronous writes (no BatchWriter) —
-        chaos asserts consistency, not throughput."""
+    def attach_rollup(self, data_dir=None, shard_count=None):
+        """Attach a FleetRollupStore fed by the outbox ingest path;
+        returns the store. Synchronous writes (no BatchWriter) — chaos
+        asserts consistency, not throughput — which also means every
+        journaled row is durable the instant ``ingest`` returns, so the
+        ``manager_kill_rebuild`` fault can rebuild from the same DB at
+        any point with zero durability window. ``data_dir`` persists
+        the journal to ``<data_dir>/fleet.db`` (default in-memory);
+        ``shard_count`` overrides the default shard striping."""
+        import os
+
         from gpud_tpu.manager.rollup import FleetRollupStore
+        from gpud_tpu.manager.shard import DEFAULT_SHARD_COUNT
         from gpud_tpu.sqlite import DB
 
-        self.rollup = FleetRollupStore(DB(":memory:"), writer=None)
+        db_path = ":memory:"
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            db_path = os.path.join(data_dir, "fleet.db")
+        self.rollup = FleetRollupStore(
+            DB(db_path), writer=None,
+            shard_count=shard_count or DEFAULT_SHARD_COUNT,
+        )
         return self.rollup
 
     # -- server ------------------------------------------------------------

@@ -311,6 +311,65 @@ def act_storage_crash(server, step: Dict, ctx) -> Optional[str]:
     return None
 
 
+def act_manager_kill_rebuild(server, step: Dict, ctx) -> Optional[str]:
+    """SIGKILL-style manager restart mid-ingest: throw away every
+    in-memory rollup aggregate and dedupe LRU, then rebuild a fresh
+    ``FleetRollupStore`` from the *same* journal DB via the parallel
+    per-shard replay — exactly what a manager restart against the same
+    ``--data-dir`` does. ``shards: N`` on the step restarts with a
+    different shard count (the journal's stable crc32 slot column makes
+    that safe; this is the re-partitioning oracle).
+
+    The swap runs ON the fake plane's event loop, which is also where
+    outbox ingest runs — so it is atomic with respect to ingest (no
+    record can land in the dying store after the rebuild snapshotted
+    the journal), and the loop blocking for the rebuild's duration IS
+    the manager's dead window: deliveries queue in the socket buffers
+    and ingest resumes against the rebuilt store, deduped by the
+    reseeded LRUs + the journal's unique index."""
+    import asyncio
+
+    from gpud_tpu.manager.rollup import FleetRollupStore
+
+    plane = ctx.plane
+    if plane is None:
+        return "no fake control plane attached to this campaign"
+    rollup = getattr(plane, "rollup", None)
+    if rollup is None:
+        return "no fleet rollup store attached (plane.attach_rollup())"
+    loop = getattr(plane, "_loop", None)
+    if loop is None or not loop.is_running():
+        return "fake control plane loop not running"
+    shards = int(step.get("shards", 0)) or rollup.shard_count
+
+    async def _kill_and_rebuild():
+        old = plane.rollup
+        writer = getattr(old, "writer", None)
+        if writer is not None:
+            # the kill window: buffered-but-uncommitted rows die with
+            # the process (same loss model as act_storage_crash)
+            writer.drop_pending(reason="chaos_manager_kill")
+        plane.rollup = FleetRollupStore(
+            old.db, writer,
+            cache_ttl_seconds=old.cache_ttl,
+            dedupe_keys_max=old.dedupe_keys_max,
+            max_journal_rows=old.max_journal_rows,
+            shard_count=shards,
+        )
+        return plane.rollup.records_total()
+
+    try:
+        fut = asyncio.run_coroutine_threadsafe(_kill_and_rebuild(), loop)
+        recovered = fut.result(timeout=30.0)
+    except Exception as e:  # noqa: BLE001 — the failure is the finding
+        return f"manager kill/rebuild failed: {e}"
+    logger.info(
+        "chaos: manager killed and rebuilt from journal — %d records "
+        "recovered across %d shard(s)", recovered, shards,
+    )
+    return None
+
+
 def _poke(comp, server, block: bool = False) -> None:
     """Run the component's check now: poked to the front of the heap when
     scheduler-driven, else a direct (or one-shot) check."""
@@ -350,4 +409,5 @@ ACTIONS: Dict[str, Callable] = {
     "ingest_burst": act_ingest_burst,
     "storage_flush": act_storage_flush,
     "storage_crash": act_storage_crash,
+    "manager_kill_rebuild": act_manager_kill_rebuild,
 }

@@ -64,6 +64,7 @@ KNOWN_ACTIONS = (
     "ingest_burst",    # observation firehose: `count` events + metric rows
     "storage_flush",   # write-behind flush barrier (pre-crash durability line)
     "storage_crash",   # discard the write-behind buffer uncommitted (SIGKILL sim)
+    "manager_kill_rebuild",  # SIGKILL the manager: rebuild rollups from journal
 )
 
 # expectation kinds evaluated after each phase (gpud_tpu/chaos/expectations.py)

@@ -790,6 +790,7 @@ def cmd_manager(args) -> int:
             session_token=args.session_token or None,
             admin_token=args.admin_token or None,
             data_dir=args.data_dir or None,
+            shards=args.shards or None,
         )
         # handlers go in before the endpoint line: the printed JSON is the
         # readiness contract, and a supervisor may SIGTERM immediately after
@@ -1151,6 +1152,11 @@ def build_parser() -> argparse.ArgumentParser:
     ms.add_argument("--data-dir", default="",
                     help="persist the fleet rollup journal here "
                          "(default: in-memory)")
+    ms.add_argument("--shards", type=int, default=0,
+                    help="ingest/rollup shard count "
+                         "(default: 8; agents hash to shards by stable "
+                         "crc32 slots, so this is safe to change between "
+                         "restarts)")
     ms.set_defaults(fn=cmd_manager)
     mm = msub.add_parser("machines", help="list connected agents")
     mm.add_argument("--endpoint", default="http://127.0.0.1:15135")

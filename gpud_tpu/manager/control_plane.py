@@ -84,6 +84,14 @@ class AgentHandle:
         # store's ingest so every fresh decoded record is journaled +
         # rolled up; the handle itself stays transport-only
         self.on_records = None
+        # when set (ControlPlane._register), outbox frames are handed to
+        # the per-shard ingest executor instead of running inline on the
+        # session reader thread — delta decode, dedupe, journal submit,
+        # and the ack all happen on the agent's shard worker, in FIFO
+        # order, so a slow BatchWriter flush can no longer stall the
+        # next frame's read. Standalone handles (unit tests, chaos
+        # harnesses) keep the inline path.
+        self.ingest_executor = None
         self._ack_req_ids: "OrderedDict[str, bool]" = OrderedDict()
         # per-connection delta decoder for batched delivery frames: the
         # agent resets its encoder on reconnect, so a fresh handle always
@@ -121,6 +129,15 @@ class AgentHandle:
             isinstance(payload, dict)
             and ("outbox_seq" in payload or "outbox_batch" in payload)
         ):
+            ex = self.ingest_executor
+            if ex is not None:
+                # reader thread only enqueues; a saturated shard drops the
+                # frame UN-acked (backpressure is counted) and the agent's
+                # durable outbox redelivers it keyframe-anchored later
+                ex.submit(
+                    self.machine_id, lambda: self._ingest_outbox(payload)
+                )
+                return
             self._ingest_outbox(payload)
             return
         with self._lock:
@@ -272,6 +289,8 @@ class ControlPlane:
         instance_id: Optional[str] = None,
         data_dir: Optional[str] = None,
         rollup_cache_ttl: float = 2.0,
+        shards: Optional[int] = None,
+        max_v2_agents: int = 64,
     ) -> None:
         self.port = port
         self.grpc_port = grpc_port
@@ -306,6 +325,7 @@ class ControlPlane:
         from concurrent.futures import ThreadPoolExecutor
 
         self.max_v1_agents = 64
+        self.max_v2_agents = max(1, int(max_v2_agents))
         self._stream_pool = ThreadPoolExecutor(
             max_workers=self.max_v1_agents, thread_name_prefix="tpud-mgr-stream"
         )
@@ -316,6 +336,10 @@ class ControlPlane:
         # write-behind layer. data_dir=None keeps everything in memory
         # (tests, dev) — same code path, no durability
         from gpud_tpu.manager.rollup import FleetRollupStore
+        from gpud_tpu.manager.shard import (
+            DEFAULT_SHARD_COUNT,
+            ShardIngestExecutor,
+        )
         from gpud_tpu.sqlite import DB
         from gpud_tpu.storage.writer import BatchWriter
 
@@ -326,9 +350,14 @@ class ControlPlane:
             db_path = os.path.join(data_dir, "fleet.db")
         self.db = DB(db_path)
         self.writer = BatchWriter(self.db)
+        self.shards = int(shards) if shards else DEFAULT_SHARD_COUNT
         self.rollup = FleetRollupStore(
-            self.db, self.writer, cache_ttl_seconds=rollup_cache_ttl
+            self.db, self.writer, cache_ttl_seconds=rollup_cache_ttl,
+            shard_count=self.shards,
         )
+        # lock-striped offload for wire decode + rollup ingest: session
+        # reader threads enqueue, shard workers journal + ack
+        self.ingest_executor = ShardIngestExecutor(self.shards)
         self._scheduler = None
 
     # -- registry ----------------------------------------------------------
@@ -336,6 +365,7 @@ class ControlPlane:
         # point the transport's outbox hook at the rollup store before
         # the handle is visible, so the very first frame is journaled
         handle.on_records = self.rollup.ingest
+        handle.ingest_executor = self.ingest_executor
         with self._lock:
             old = self.agents.get(handle.machine_id)
             if old is not None:
@@ -675,7 +705,10 @@ class ControlPlane:
         from gpud_tpu.manager.exposition import render_fleet_metrics
 
         body = await asyncio.get_event_loop().run_in_executor(
-            self._op_pool, lambda: render_fleet_metrics(self.rollup)
+            self._op_pool,
+            lambda: render_fleet_metrics(
+                self.rollup, ingest_executor=self.ingest_executor
+            ),
         )
         return web.Response(
             text=body, content_type="text/plain", charset="utf-8"
@@ -792,8 +825,9 @@ class ControlPlane:
             "tpud.session.v2.Session", {"Connect": handler}
         )
         # each v2 Connect stream pins one handler thread for its lifetime
-        # — this is the v2 fleet-size cap for the dev manager
-        self.max_v2_agents = 64
+        # — this is the v2 fleet-size cap (constructor `max_v2_agents`;
+        # raise it to hold a multi-thousand-agent fleet of persistent
+        # streams, each costing one mostly-idle pool thread)
         self._grpc_server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=self.max_v2_agents),
             # without this, Linux SO_REUSEPORT lets a second manager bind
@@ -887,6 +921,11 @@ class ControlPlane:
                 pass
             finally:
                 stop.set()
+                # wake the response generator NOW: it polls outbound with a
+                # 0.2s timeout, and that linger holds a gRPC pool slot per
+                # closed stream — at fleet churn rates (thousands of short
+                # sessions) the idle tail, not real work, becomes the cap
+                handle.outbound.put(None)
 
         threading.Thread(
             target=drain_responses,
@@ -969,6 +1008,9 @@ class ControlPlane:
             self._thread = None
         self._stream_pool.shutdown(wait=False, cancel_futures=True)
         self._op_pool.shutdown(wait=False, cancel_futures=True)
+        # drain the shard workers before storage teardown: anything a
+        # reader enqueued before its stream died still journals + acks
+        self.ingest_executor.stop()
         # storage last: the final writer.close() barrier commits whatever
         # the torn-down transports journaled on their way out
         if self._scheduler is not None:

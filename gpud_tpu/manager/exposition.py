@@ -68,10 +68,19 @@ def _escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
 
-def render_fleet_metrics(rollup_store, max_agents: int = DEFAULT_MAX_AGENTS) -> str:
+def render_fleet_metrics(
+    rollup_store,
+    max_agents: int = DEFAULT_MAX_AGENTS,
+    ingest_executor=None,
+) -> str:
     """The manager's full /metrics body: global registry + bounded
     per-agent federation block."""
     t0 = time.monotonic()
+    # refresh the per-shard gauges (cardinality bounded by shard count,
+    # not fleet size) before the registry renders them
+    from gpud_tpu.manager.shard import update_shard_gauges
+
+    update_shard_gauges(rollup_store, ingest_executor)
     parts: List[str] = [DEFAULT_REGISTRY.render_prometheus()]
     # walk the paginated view (cached + flush-barriered like any other
     # operator read) instead of a private fast path

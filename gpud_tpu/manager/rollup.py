@@ -21,6 +21,17 @@ Design:
   rebuilt from the journal at construction — a SIGKILL can lose at
   most the writer's durability window and can never tear an aggregate,
   because aggregates are never persisted, only recomputed.
+- **Lock-striped shards.** In-memory state is partitioned into
+  ``shard_count`` stripes keyed by a stable crc32 slot hash of the
+  agent id (``gpud_tpu/manager/shard.py``). Each shard has its own
+  lock, per-agent dedupe LRUs, and aggregates, so ingest for agent A
+  never contends with ingest for agent B on another shard, and the
+  fleet rollup walk takes one shard lock at a time instead of freezing
+  the whole plane. The journal persists the *slot* (``shard`` column),
+  not the shard index, so a restart with a different shard count still
+  partitions the journal correctly and ``_rebuild()`` replays shards
+  in parallel — per-agent ordering (the only ordering ingest relies
+  on) is preserved because an agent lives in exactly one slot.
 - **Read-your-own-writes.** Every read path runs the writer's
   ``flush()`` barrier before touching SQLite, so batching is invisible
   to operators.
@@ -37,13 +48,22 @@ Design:
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import Counter as _Counter
 from collections import OrderedDict, deque
+from concurrent.futures import ThreadPoolExecutor, as_completed
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from gpud_tpu.log import get_logger
+from gpud_tpu.manager.shard import (
+    DEFAULT_SHARD_COUNT,
+    SHARD_SLOTS,
+    RollupShard,
+    shard_slots,
+    slot_of,
+)
 from gpud_tpu.metrics.registry import counter, gauge, histogram
 from gpud_tpu.session import wire
 
@@ -63,8 +83,8 @@ DEFAULT_MAX_JOURNAL_ROWS = 500_000
 
 _INSERT_SQL = (
     f"INSERT OR IGNORE INTO {TABLE} "
-    "(agent, seq, ts, ingested, kind, dedupe_key, correlation_id, payload) "
-    "VALUES (?, ?, ?, ?, ?, ?, ?, ?)"
+    "(agent, seq, ts, ingested, kind, dedupe_key, correlation_id, payload, "
+    "shard) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)"
 )
 
 _c_records = counter(
@@ -215,9 +235,12 @@ class _AgentRollup:
 class FleetRollupStore:
     """Manager-side fleet journal + materialized rollups (module docstring).
 
-    Thread-safe: ``ingest`` may be called from any agent connection's
-    reader thread; reads run on the operator pool. The in-memory state
-    is guarded by one lock; SQLite work happens outside it.
+    Thread-safe: ``ingest`` may be called from any shard-executor worker
+    (or reader thread when no executor is wired); reads run on the
+    operator pool. In-memory state is striped across ``shard_count``
+    locks keyed by a stable hash of the agent id; cache/generation
+    bookkeeping sits under a separate meta lock; SQLite work happens
+    outside all of them.
     """
 
     def __init__(
@@ -227,24 +250,36 @@ class FleetRollupStore:
         cache_ttl_seconds: float = DEFAULT_CACHE_TTL,
         dedupe_keys_max: int = DEFAULT_DEDUPE_KEYS,
         max_journal_rows: int = DEFAULT_MAX_JOURNAL_ROWS,
+        shard_count: int = DEFAULT_SHARD_COUNT,
+        rebuild_parallel: bool = True,
     ) -> None:
         self.db = db
         self.writer = writer
         self.cache_ttl = float(cache_ttl_seconds)
         self.dedupe_keys_max = int(dedupe_keys_max)
         self.max_journal_rows = int(max_journal_rows)
-        self._lock = threading.Lock()
-        self._agents: Dict[str, _AgentRollup] = {}
-        self._dedupe: Dict[str, OrderedDict] = {}
+        self.shard_count = max(1, min(int(shard_count), SHARD_SLOTS))
+        self.rebuild_parallel = bool(rebuild_parallel)
+        self._shards: List[RollupShard] = [
+            RollupShard(i) for i in range(self.shard_count)
+        ]
+        # meta lock: generation + response cache + cache counters only —
+        # never held while a shard lock is held
+        self._meta = threading.Lock()
         self._generation = 0
-        self._records_total = 0
-        self._duplicates_total = 0
         # cache key -> (generation, monotonic deadline, value)
         self._cache: Dict[tuple, tuple] = {}
         self._cache_hits = 0
         self._cache_misses = 0
+        self.last_rebuild_seconds = 0.0
         self._ensure_schema()
         self._rebuild()
+
+    def _shard_for(self, agent_id: str) -> RollupShard:
+        return self._shards[slot_of(agent_id) % self.shard_count]
+
+    def shards(self) -> List[RollupShard]:
+        return self._shards
 
     # -- schema / rebuild --------------------------------------------------
     def _ensure_schema(self) -> None:
@@ -258,9 +293,28 @@ class FleetRollupStore:
                 dedupe_key     TEXT NOT NULL,
                 correlation_id TEXT NOT NULL DEFAULT '',
                 payload        BLOB,
+                shard          INTEGER NOT NULL DEFAULT -1,
                 UNIQUE (agent, dedupe_key)
             )"""
         )
+        cols = {r[1] for r in self.db.query(f"PRAGMA table_info({TABLE})")}
+        if "shard" not in cols:
+            # pre-sharding journal: widen, then backfill below
+            self.db.execute(
+                f"ALTER TABLE {TABLE} "
+                f"ADD COLUMN shard INTEGER NOT NULL DEFAULT -1"
+            )
+        # backfill the derived slot for legacy rows (one-time migration;
+        # slot_of is a pure function of the agent id, so this is safe to
+        # re-run and converges immediately)
+        stale = self.db.query(
+            f"SELECT DISTINCT agent FROM {TABLE} WHERE shard < 0"
+        )
+        if stale:
+            self.db.executemany(
+                f"UPDATE {TABLE} SET shard = ? WHERE agent = ? AND shard < 0",
+                [(slot_of(agent), agent) for (agent,) in stale],
+            )
         self.db.execute(
             f"CREATE INDEX IF NOT EXISTS idx_fleet_agent_ts "
             f"ON {TABLE} (agent, ts)"
@@ -269,43 +323,127 @@ class FleetRollupStore:
             f"CREATE INDEX IF NOT EXISTS idx_fleet_correlation "
             f"ON {TABLE} (correlation_id) WHERE correlation_id != ''"
         )
+        # covering order for per-shard replay: each rebuild worker walks
+        # its slots in index order, no sort step
+        self.db.execute(
+            f"CREATE INDEX IF NOT EXISTS idx_fleet_shard "
+            f"ON {TABLE} (shard, agent, ts, seq)"
+        )
 
     def _rebuild(self) -> None:
         """Recompute every rollup from the journal (boot / crash recovery).
 
         The journal is the only durable state; aggregates are a pure
         function of it, so a SIGKILL between group commits can shorten
-        the journal but never tear a rollup."""
-        rows = self.db.query(
-            f"SELECT agent, seq, ts, ingested, kind, dedupe_key, payload "
-            f"FROM {TABLE} ORDER BY agent, ts, seq"
-        )
-        with self._lock:
-            self._agents.clear()
-            self._dedupe.clear()
-            self._records_total = 0
-            for agent, seq, ts, ingested, kind, key, payload in rows:
-                # reseed the replay-suppression LRU: after a restart agents
-                # replay journaled-but-unacked records, and the DB's INSERT
-                # OR IGNORE alone would let them double-count the in-memory
-                # aggregates. Rows arrive oldest-first per agent, so LRU
-                # eviction keeps the newest keys — the ones replays carry.
-                seen = self._dedupe.get(agent)
-                if seen is None:
-                    seen = self._dedupe[agent] = OrderedDict()
-                seen[key] = None
-                while len(seen) > self.dedupe_keys_max:
-                    seen.popitem(last=False)
-                body = wire.unpack_obj(payload) if payload is not None else {}
-                self._apply_locked(agent, seq, ts, ingested, kind, key, body)
+        the journal but never tear a rollup. Each shard replays only
+        its own slots (the persisted ``shard`` column), so replay runs
+        one worker per shard — per-agent ordering holds because an
+        agent's rows all live in one slot.
+
+        The fetch pool is capped at the host's usable core count: on a
+        single-core host extra fetch threads only convoy on the GIL, so
+        replay degrades to the plain serial loop there rather than
+        paying thread overhead for no concurrency."""
+        t0 = time.monotonic()
+        try:
+            cores = max(1, len(os.sched_getaffinity(0)))
+        except AttributeError:
+            cores = max(1, os.cpu_count() or 1)
+        workers = min(self.shard_count, cores)
+        if self.rebuild_parallel and workers > 1:
+            # fetch/apply pipeline: one FETCH worker per shard (SQLite
+            # index walk + msgpack unpack — the C-heavy part, which runs
+            # with the GIL dropped during VDBE steps), while the calling
+            # thread APPLIES each shard the moment its rows land. Running
+            # the Python apply loops on N threads instead would convoy on
+            # the GIL and come out *slower* than serial.
+            counts = []
+            with ThreadPoolExecutor(
+                max_workers=workers,
+                thread_name_prefix="tpud-fleet-rebuild",
+            ) as ex:
+                futs = {
+                    ex.submit(self._fetch_shard_rows, s): s
+                    for s in self._shards
+                }
+                for fut in as_completed(futs):
+                    counts.append(
+                        self._apply_shard_rows(futs[fut], fut.result())
+                    )
+        else:
+            counts = [
+                self._apply_shard_rows(s, self._fetch_shard_rows(s))
+                for s in self._shards
+            ]
+        with self._meta:
             self._generation += 1
             self._cache.clear()
-            self._update_gauges_locked()
-        if rows:
+        self._update_gauges()
+        self.last_rebuild_seconds = time.monotonic() - t0
+        total = sum(counts)
+        if total:
             logger.info(
                 "fleet rollup store rebuilt from journal: %d records, "
-                "%d agents", len(rows), len(self._agents),
+                "%d agents, %d shards, %.3fs (%s)",
+                total, sum(len(s.agents) for s in self._shards),
+                self.shard_count, self.last_rebuild_seconds,
+                "parallel" if self.rebuild_parallel and workers > 1
+                else "serial",
             )
+
+    def _fetch_shard_rows(self, shard: RollupShard) -> list:
+        """Pull + decode one shard's journal slice (no shard state touched,
+        safe on any thread). Returns ``(agent, seq, ts, ingested, kind,
+        key, body)`` rows in replay order."""
+        slots = shard_slots(shard.index, self.shard_count)
+        placeholders = ",".join("?" * len(slots))
+        # ORDER BY walks idx_fleet_shard — per-slot, per-agent (ts, seq)
+        # order with no sort pass; cross-agent order is irrelevant
+        rows = self.db.query(
+            f"SELECT agent, seq, ts, ingested, kind, dedupe_key, payload "
+            f"FROM {TABLE} WHERE shard IN ({placeholders}) "
+            f"ORDER BY shard, agent, ts, seq",
+            tuple(slots),
+        )
+        unpack = wire.unpack_obj
+        return [
+            (agent, seq, ts, ingested, kind, key,
+             unpack(payload) if payload is not None else {})
+            for agent, seq, ts, ingested, kind, key, payload in rows
+        ]
+
+    def _apply_shard_rows(self, shard: RollupShard, rows: list) -> int:
+        apply_one = self._apply_shard_locked
+        keys_max = self.dedupe_keys_max
+        with shard.lock:
+            shard.agents.clear()
+            shard.dedupe.clear()
+            shard.records_total = 0
+            shard.duplicates_total = 0
+            shard.series_total = 0
+            dedupe = shard.dedupe
+            run_agent = None
+            run_keys: List[str] = []
+            for agent, seq, ts, ingested, kind, key, body in rows:
+                if agent != run_agent:
+                    # reseed the replay-suppression LRU: after a restart
+                    # agents replay journaled-but-unacked records, and the
+                    # DB's INSERT OR IGNORE alone would let them double-
+                    # count the in-memory aggregates. Keys are UNIQUE per
+                    # agent and arrive oldest-first, so "insert each,
+                    # evict past the cap" reduces to keeping the newest
+                    # `keys_max` in order — seeded per agent run below.
+                    if run_agent is not None:
+                        dedupe[run_agent] = OrderedDict.fromkeys(
+                            run_keys[-keys_max:]
+                        )
+                    run_agent = agent
+                    run_keys = []
+                run_keys.append(key)
+                apply_one(shard, agent, seq, ts, ingested, kind, key, body)
+            if run_agent is not None:
+                dedupe[run_agent] = OrderedDict.fromkeys(run_keys[-keys_max:])
+            return shard.records_total
 
     # -- ingest ------------------------------------------------------------
     def ingest(
@@ -323,18 +461,21 @@ class FleetRollupStore:
         durable state even past the LRU window). Returns the number of
         fresh records applied."""
         wall = time.time() if now is None else now
+        slot = slot_of(agent_id)
+        shard = self._shards[slot % self.shard_count]
         rows: List[tuple] = []
         fresh: List[tuple] = []
-        with self._lock:
-            seen = self._dedupe.get(agent_id)
+        dup = 0
+        pack = wire.pack_obj
+        with shard.lock:
+            seen = shard.dedupe.get(agent_id)
             if seen is None:
-                seen = self._dedupe[agent_id] = OrderedDict()
+                seen = shard.dedupe[agent_id] = OrderedDict()
             for seq, ts, kind, key, payload in records:
                 key = key or f"seq:{seq}"
                 if key in seen:
                     seen.move_to_end(key)
-                    self._duplicates_total += 1
-                    _c_duplicates.inc()
+                    dup += 1
                     continue
                 seen[key] = None
                 while len(seen) > self.dedupe_keys_max:
@@ -343,34 +484,48 @@ class FleetRollupStore:
                 cid = str(body.get("correlation_id", "") or "")
                 rows.append(
                     (agent_id, seq, ts, wall, kind, key, cid,
-                     wire.pack_obj(payload))
+                     pack(payload), slot)
                 )
                 fresh.append((seq, ts, kind, key, body))
             for seq, ts, kind, key, body in fresh:
-                self._apply_locked(agent_id, seq, ts, wall, kind, key, body)
+                self._apply_shard_locked(
+                    shard, agent_id, seq, ts, wall, kind, key, body
+                )
+            if dup:
+                shard.duplicates_total += dup
             if fresh:
-                self._generation += 1
-                self._update_gauges_locked()
+                shard.ingest_lag = max(0.0, wall - fresh[-1][1])
+        if dup:
+            _c_duplicates.inc(dup)
         if not rows:
             return 0
+        # generation bumps before the journal submit, exactly as the
+        # single-lock store did: readers invalidate immediately, the
+        # barrier on the miss path makes the rows visible to SQL reads
+        with self._meta:
+            self._generation += 1
+        self._update_gauges()
         if self.writer is not None:
             self.writer.submit_many("fleet", _INSERT_SQL, rows)
         else:
             self.db.executemany(_INSERT_SQL, rows)
-        for _, ts, kind, _, _ in fresh:
-            _c_records.inc(labels={"kind": kind})
+        kind_counts: Dict[str, int] = {}
+        for _, _, kind, _, _ in fresh:
+            kind_counts[kind] = kind_counts.get(kind, 0) + 1
+        for kind, n in kind_counts.items():
+            _c_records.inc(n, labels={"kind": kind})
         _g_ingest_lag.set(max(0.0, wall - fresh[-1][1]))
         return len(fresh)
 
-    def _apply_locked(
-        self, agent_id: str, seq: int, ts: float, ingested: float,
-        kind: str, key: str, body: Dict,
+    def _apply_shard_locked(
+        self, shard: RollupShard, agent_id: str, seq: int, ts: float,
+        ingested: float, kind: str, key: str, body: Dict,
     ) -> None:
-        ar = self._agents.get(agent_id)
+        ar = shard.agents.get(agent_id)
         if ar is None:
-            ar = self._agents[agent_id] = _AgentRollup()
+            ar = shard.agents[agent_id] = _AgentRollup()
         ar.records_by_kind[kind] += 1
-        self._records_total += 1
+        shard.records_total += 1
         if seq > ar.last_seq:
             ar.last_seq = seq
         if ts >= ar.last_ts:
@@ -385,6 +540,7 @@ class FleetRollupStore:
             sr = ar.series.get(comp)
             if sr is None:
                 sr = ar.series[comp] = _SeriesRollup()
+                shard.series_total += 1
             sr.apply(
                 str(body.get("from", "") or ""),
                 str(body.get("to", "") or ""),
@@ -393,18 +549,20 @@ class FleetRollupStore:
         elif kind == "remediation_audit":
             ar.remediation_outcomes[str(body.get("outcome", "") or "unknown")] += 1
 
-    def _update_gauges_locked(self) -> None:
-        _g_agents.set(len(self._agents))
-        _g_series.set(sum(len(a.series) for a in self._agents.values()))
+    def _update_gauges(self) -> None:
+        # per-shard counters are plain ints; summing without the shard
+        # locks reads a consistent-enough snapshot for gauges
+        _g_agents.set(sum(len(s.agents) for s in self._shards))
+        _g_series.set(sum(s.series_total for s in self._shards))
 
     # -- cache plumbing ----------------------------------------------------
     def _barrier(self) -> None:
         if self.writer is not None:
             self.writer.flush()
 
-    def _cached(self, key: tuple, compute) -> object:
+    def _cached(self, key: tuple, compute, sql: bool = True) -> object:
         now = time.monotonic()
-        with self._lock:
+        with self._meta:
             ent = self._cache.get(key)
             if ent is not None and ent[0] == self._generation and now < ent[1]:
                 self._cache_hits += 1
@@ -413,12 +571,17 @@ class FleetRollupStore:
             gen = self._generation
             self._cache_misses += 1
         _c_cache_misses.inc()
-        # miss path: barrier first so SQLite-backed computations see
-        # every record journaled before this read began
-        self._barrier()
+        # miss path: barrier first so SQLite-backed computations see every
+        # record journaled before this read began. Pure in-memory computes
+        # (``sql=False``) skip it — shard state is applied BEFORE the
+        # journal submit, so memory is always at least as new as the DB,
+        # and waiting out the write-behind backlog would put the whole
+        # ingest burst in the operator's read latency for nothing.
+        if sql:
+            self._barrier()
         with _h_refresh.time():
             value = compute()
-        with self._lock:
+        with self._meta:
             # only cache what was computed against the still-current
             # generation — an ingest racing the compute wins
             if gen == self._generation:
@@ -426,12 +589,12 @@ class FleetRollupStore:
         return value
 
     def invalidate_cache(self) -> None:
-        with self._lock:
+        with self._meta:
             self._cache.clear()
             self._generation += 1
 
     def cache_stats(self) -> Dict:
-        with self._lock:
+        with self._meta:
             return {
                 "hits": self._cache_hits,
                 "misses": self._cache_misses,
@@ -442,11 +605,40 @@ class FleetRollupStore:
     # -- read paths --------------------------------------------------------
     def fleet_rollup(self) -> Dict:
         """Fleet-wide aggregates (``GET /v1/fleet/rollup``)."""
-        return self._cached(("rollup",), self._compute_fleet_rollup)
+        return self._cached(("rollup",), self._compute_fleet_rollup, sql=False)
 
     def _compute_fleet_rollup(self) -> Dict:
         by_kind: _Counter = _Counter()
         remediation: _Counter = _Counter()
+        agent_count = 0
+        records_total = 0
+        duplicates = 0
+        max_lag = 0.0
+        # one shard lock at a time: snapshot each stripe, then merge.
+        # Accumulation runs over a globally sorted series list so the
+        # float sums are identical for any shard count (byte-identical
+        # rollups across N=1 / N=8 / rebuild-with-new-N).
+        snaps: List[tuple] = []
+        with self._meta:
+            gen = self._generation
+        for shard in self._shards:
+            with shard.lock:
+                records_total += shard.records_total
+                duplicates += shard.duplicates_total
+                agent_count += len(shard.agents)
+                for aid, ar in shard.agents.items():
+                    by_kind.update(ar.records_by_kind)
+                    remediation.update(ar.remediation_outcomes)
+                    if ar.outbox_lag_seconds > max_lag:
+                        max_lag = ar.outbox_lag_seconds
+                    as_of = ar.last_ts
+                    for comp, sr in ar.series.items():
+                        snaps.append((
+                            aid, comp, sr.snapshot(as_of), sr.transitions,
+                            sr.failures, sr.repair_total, sr.repair_count,
+                            sr.tbf_total, sr.tbf_count,
+                        ))
+        snaps.sort(key=lambda s: (s[0], s[1]))
         transitions = 0
         failures = 0
         repair_total = 0.0
@@ -455,49 +647,32 @@ class FleetRollupStore:
         tbf_count = 0
         healthy = 0.0
         unhealthy = 0.0
-        series = 0
         unhealthy_now = 0
         flapping: List[Dict] = []
-        max_lag = 0.0
-        # hold the lock for the whole walk: per-series dicts and deques
-        # mutate under it on ingest, so iterating a shallow snapshot
-        # outside would race (RuntimeError mid-iteration, torn sums)
-        with self._lock:
-            gen = self._generation
-            records_total = self._records_total
-            duplicates = self._duplicates_total
-            agent_count = len(self._agents)
-            for aid, ar in sorted(self._agents.items()):
-                by_kind.update(ar.records_by_kind)
-                remediation.update(ar.remediation_outcomes)
-                max_lag = max(max_lag, ar.outbox_lag_seconds)
-                as_of = ar.last_ts
-                for comp, sr in sorted(ar.series.items()):
-                    series += 1
-                    snap = sr.snapshot(as_of)
-                    transitions += sr.transitions
-                    failures += sr.failures
-                    repair_total += sr.repair_total
-                    repair_count += sr.repair_count
-                    tbf_total += sr.tbf_total
-                    tbf_count += sr.tbf_count
-                    healthy += snap["healthy_seconds"]
-                    unhealthy += snap["unhealthy_seconds"]
-                    if snap["state"] and snap["state"] != "Healthy":
-                        unhealthy_now += 1
-                    if snap["flap_count"] >= 3:
-                        flapping.append(
-                            {"agent": aid, "component": comp,
-                             "flap_count": snap["flap_count"]}
-                        )
-        flapping.sort(key=lambda f: -f["flap_count"])
+        for aid, comp, snap, s_tr, s_fail, s_rt, s_rc, s_tt, s_tc in snaps:
+            transitions += s_tr
+            failures += s_fail
+            repair_total += s_rt
+            repair_count += s_rc
+            tbf_total += s_tt
+            tbf_count += s_tc
+            healthy += snap["healthy_seconds"]
+            unhealthy += snap["unhealthy_seconds"]
+            if snap["state"] and snap["state"] != "Healthy":
+                unhealthy_now += 1
+            if snap["flap_count"] >= 3:
+                flapping.append(
+                    {"agent": aid, "component": comp,
+                     "flap_count": snap["flap_count"]}
+                )
+        flapping.sort(key=lambda f: (-f["flap_count"], f["agent"], f["component"]))
         observed = healthy + unhealthy
         return {
             "generation": gen,
             "agents": agent_count,
-            "series": series,
+            "series": len(snaps),
             "records_total": records_total,
-            "records_by_kind": dict(by_kind),
+            "records_by_kind": dict(sorted(by_kind.items())),
             "duplicates_suppressed": duplicates,
             "transitions_total": transitions,
             "failures_total": failures,
@@ -505,7 +680,7 @@ class FleetRollupStore:
             "availability": (healthy / observed) if observed > 0 else 1.0,
             "mttr_seconds": (repair_total / repair_count) if repair_count else 0.0,
             "mtbf_seconds": (tbf_total / tbf_count) if tbf_count else 0.0,
-            "remediation_outcomes": dict(remediation),
+            "remediation_outcomes": dict(sorted(remediation.items())),
             "flapping": flapping[:32],
             "max_outbox_lag_seconds": max_lag,
         }
@@ -517,15 +692,19 @@ class FleetRollupStore:
         return self._cached(
             ("agents", offset, limit),
             lambda: self._compute_agents_page(offset, limit),
+            sql=False,
         )
 
     def _compute_agents_page(self, offset: int, limit: int) -> Dict:
-        with self._lock:
-            ids = sorted(self._agents)
-            page_ids = ids[offset:offset + limit]
-            rollups = []
-            for aid in page_ids:
-                ar = self._agents[aid]
+        ids = self.agent_ids()
+        page_ids = ids[offset:offset + limit]
+        rollups = []
+        for aid in page_ids:
+            shard = self._shard_for(aid)
+            with shard.lock:
+                ar = shard.agents.get(aid)
+                if ar is None:
+                    continue  # raced a rebuild; agents are never removed
                 as_of = ar.last_ts
                 rollups.append({
                     "agent": aid,
@@ -540,7 +719,7 @@ class FleetRollupStore:
                         for comp, sr in sorted(ar.series.items())
                     },
                 })
-            total = len(ids)
+        total = len(ids)
         next_offset = offset + len(rollups)
         return {
             "agents": rollups,
@@ -552,8 +731,9 @@ class FleetRollupStore:
 
     def agent_snapshot(self, agent_id: str) -> Optional[Dict]:
         """Uncached single-agent rollup (expectation checks, tests)."""
-        with self._lock:
-            ar = self._agents.get(agent_id)
+        shard = self._shard_for(agent_id)
+        with shard.lock:
+            ar = shard.agents.get(agent_id)
             if ar is None:
                 return None
             as_of = ar.last_ts
@@ -567,6 +747,29 @@ class FleetRollupStore:
                     for comp, sr in sorted(ar.series.items())
                 },
             }
+
+    def dedupe_snapshot(self, agent_id: str) -> List[str]:
+        """The agent's replay-suppression LRU keys, oldest first (tests)."""
+        shard = self._shard_for(agent_id)
+        with shard.lock:
+            seen = shard.dedupe.get(agent_id)
+            return list(seen) if seen else []
+
+    def shard_stats(self) -> List[Dict]:
+        """Per-shard occupancy/lag snapshot (metrics + bench)."""
+        out = []
+        for shard in self._shards:
+            with shard.lock:
+                out.append({
+                    "index": shard.index,
+                    "agents": len(shard.agents),
+                    "series": shard.series_total,
+                    "records_total": shard.records_total,
+                    "duplicates_total": shard.duplicates_total,
+                    "dedupe_keys": sum(len(d) for d in shard.dedupe.values()),
+                    "ingest_lag_seconds": shard.ingest_lag,
+                })
+        return out
 
     def history(
         self,
@@ -665,12 +868,18 @@ class FleetRollupStore:
         return int(row[0]) if row else 0
 
     def records_total(self) -> int:
-        with self._lock:
-            return self._records_total
+        return sum(s.records_total for s in self._shards)
+
+    def duplicates_total(self) -> int:
+        return sum(s.duplicates_total for s in self._shards)
 
     def agent_ids(self) -> List[str]:
-        with self._lock:
-            return sorted(self._agents)
+        ids: List[str] = []
+        for shard in self._shards:
+            with shard.lock:
+                ids.extend(shard.agents)
+        ids.sort()
+        return ids
 
 
 def _record_dict(row) -> Dict:

@@ -47,6 +47,7 @@ _METRIC_MODULES = (
     "gpud_tpu.health_history",
     "gpud_tpu.manager.exposition",
     "gpud_tpu.manager.rollup",
+    "gpud_tpu.manager.shard",
     "gpud_tpu.predict.engine",
     "gpud_tpu.scheduler.core",
     "gpud_tpu.server.app",

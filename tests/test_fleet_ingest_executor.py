@@ -1,0 +1,166 @@
+"""Sharded ingest executor: the offload between session readers and the
+fleet rollup store.
+
+Covers the contracts bench.py --fleet --socket gates at scale: per-agent
+FIFO ordering (same agent → same shard queue), stable-hash routing,
+bounded queues with counted backpressure (a full shard drops UN-acked —
+the agent's outbox redelivers, so a drop costs latency, never data), and
+the reader-stall regression: ``AgentHandle.resolve`` must only enqueue,
+so a stalled shard writer can no longer leak latency into the session
+reader loop the way PR 12's inline ``_ingest_outbox`` did.
+"""
+
+import threading
+import time
+from collections import Counter
+
+from gpud_tpu.manager.control_plane import AgentHandle
+from gpud_tpu.manager.shard import ShardIngestExecutor, shard_index
+
+
+def _outbox(seq):
+    return {"outbox_seq": seq, "ts": 1000.0 + seq, "kind": "event",
+            "dedupe_key": f"k{seq}", "payload": {"component": "c0"}}
+
+
+def _wait_queue_empty(ex, shard=0, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while ex.queue_depths()[shard]:
+        assert time.monotonic() < deadline, "shard queue never drained"
+        time.sleep(0.005)
+
+
+def test_per_agent_fifo_order():
+    ex = ShardIngestExecutor(shard_count=4)
+    try:
+        order = []
+        lock = threading.Lock()
+
+        def mk(i):
+            def fn():
+                with lock:
+                    order.append(i)
+            return fn
+
+        for i in range(500):
+            assert ex.submit("same-agent", mk(i))
+        assert ex.flush(timeout=10)
+        assert order == list(range(500))
+    finally:
+        ex.stop()
+
+
+def test_routing_follows_stable_hash():
+    ex = ShardIngestExecutor(shard_count=4)
+    try:
+        agents = [f"m{i}" for i in range(32)]
+        for a in agents:
+            assert ex.submit(a, lambda: None)
+        assert ex.flush(timeout=10)
+        expected = Counter(shard_index(a, 4) for a in agents)
+        assert ex.stats()["accepted"] == [expected.get(i, 0) for i in range(4)]
+    finally:
+        ex.stop()
+
+
+def test_backpressure_full_shard_drops_and_counts():
+    ex = ShardIngestExecutor(shard_count=1, max_queue_per_shard=4)
+    try:
+        release = threading.Event()
+        assert ex.submit("a", release.wait)  # parks the only worker
+        _wait_queue_empty(ex)
+        for _ in range(4):
+            assert ex.submit("a", lambda: None)
+        assert not ex.submit("a", lambda: None)  # full → counted drop
+        st = ex.stats()
+        assert st["dropped"] == [1] and st["accepted"] == [5]
+        release.set()
+        assert ex.flush(timeout=10)
+        assert ex.stats()["errors"] == 0
+    finally:
+        release.set()
+        ex.stop()
+
+
+def test_stopped_executor_refuses_work():
+    ex = ShardIngestExecutor(shard_count=2)
+    ex.stop()
+    assert not ex.submit("a", lambda: None)
+    assert sum(ex.stats()["dropped"]) == 1
+
+
+def test_dropped_frame_is_never_acked():
+    """The ack-vs-durability contract under backpressure: a frame the
+    shard rejected must not be acked — the agent's at-least-once outbox
+    only prunes on ack, so the un-acked frame redelivers later."""
+    ex = ShardIngestExecutor(shard_count=1, max_queue_per_shard=1)
+    release = threading.Event()
+    try:
+        assert ex.submit("m1", release.wait)
+        _wait_queue_empty(ex)
+        h = AgentHandle("m1", "v1")
+        h.ingest_executor = ex
+        h.resolve("outbox-1", _outbox(1))  # queued behind the stall
+        h.resolve("outbox-2", _outbox(2))  # queue full → dropped
+        assert ex.stats()["dropped"] == [1]
+        release.set()
+        assert ex.flush(timeout=10)
+        assert h.outbox_acked == 1  # seq 2 never ingested, never acked
+        acks = []
+        while not h.outbound.empty():
+            acks.append(h.outbound.get_nowait())
+        assert [a["data"]["seq"] for a in acks] == [1]
+    finally:
+        release.set()
+        ex.stop()
+
+
+def test_reader_latency_flat_while_shard_writer_stalled():
+    """Regression for PR 12's inline-ingest latency leak: decode, dedupe,
+    and journal submit ran on the session reader thread inside
+    ``resolve()``, so one slow rollup/journal write stalled every
+    subsequent frame read on that stream. With the executor wired in,
+    ``resolve()`` only enqueues — a shard worker parked indefinitely must
+    not move reader-visible latency at all, and agents on *other* shards
+    must keep ingesting and acking."""
+    ex = ShardIngestExecutor(shard_count=2, max_queue_per_shard=1024)
+    release = threading.Event()
+    try:
+        stalled_agent = next(
+            f"m{i}" for i in range(256) if shard_index(f"m{i}", 2) == 0
+        )
+        other_agent = next(
+            f"m{i}" for i in range(256) if shard_index(f"m{i}", 2) == 1
+        )
+        assert ex.submit(stalled_agent, release.wait)  # shard 0 parked
+        _wait_queue_empty(ex, shard=0)
+
+        h_stalled = AgentHandle(stalled_agent, "v1")
+        h_stalled.ingest_executor = ex
+        h_other = AgentHandle(other_agent, "v1")
+        h_other.ingest_executor = ex
+
+        worst = 0.0
+        for seq in range(1, 201):
+            t0 = time.monotonic()
+            h_stalled.resolve(f"outbox-{seq}", _outbox(seq))
+            worst = max(worst, time.monotonic() - t0)
+        # enqueue-only: even the worst call stays far under a single
+        # journal write; the inline path would block behind the stall
+        assert worst < 0.05, f"reader-visible stall: {worst * 1000:.1f}ms"
+        assert h_stalled.outbox_acked == 0  # nothing ingested → no acks
+
+        h_other.resolve("outbox-1", _outbox(1))
+        deadline = time.monotonic() + 5.0
+        while h_other.outbox_acked < 1:
+            assert time.monotonic() < deadline, \
+                "healthy shard starved by a stalled sibling"
+            time.sleep(0.005)
+
+        release.set()
+        assert ex.flush(timeout=10)
+        assert h_stalled.outbox_acked == 200  # everything landed post-stall
+        assert ex.stats()["errors"] == 0
+    finally:
+        release.set()
+        ex.stop()

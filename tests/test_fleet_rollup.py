@@ -9,6 +9,7 @@ journal can lose a durability window but never tears an aggregate),
 and the full HTTP surface on a live ControlPlane including
 correlation-id stitching at /v1/fleet/traces."""
 
+import json
 import os
 import signal
 import sqlite3
@@ -19,6 +20,7 @@ import time
 import pytest
 
 from gpud_tpu.manager.rollup import TABLE, FleetRollupStore
+from gpud_tpu.manager.shard import shard_index, slot_of
 from gpud_tpu.sqlite import DB
 from gpud_tpu.storage.writer import BatchWriter
 
@@ -132,8 +134,7 @@ def test_rebuild_reseeds_only_newest_dedupe_keys(store):
     store.ingest("a1", [_event(i, t + i, name=f"e{i}") for i in range(1, 6)])
     store.writer.flush()
     restarted = FleetRollupStore(store.db, None, dedupe_keys_max=2)
-    assert len(restarted._dedupe["a1"]) == 2
-    assert list(restarted._dedupe["a1"]) == [
+    assert restarted.dedupe_snapshot("a1") == [
         f"event:c0:{t + 4}:e4", f"event:c0:{t + 5}:e5"
     ]
     # replay of an aged-out key: journal layer still refuses the row
@@ -425,6 +426,9 @@ def fleet_cp():
             f"transition:c0:{t + seq}:{to}", body,
         ))
     handle.resolve("outbox-1", wire.build_batch(recs))
+    # ingest runs on the shard executor now, not inline on resolve():
+    # drain it so the HTTP assertions below see the journaled state
+    assert cp.ingest_executor.flush(timeout=10)
     yield cp, requests
     cp.stop()
 
@@ -483,3 +487,111 @@ def test_http_federated_metrics(fleet_cp):
     assert 'tpud_fleet_agent_transitions{agent="fleet-m1"} 2' in text
     assert "tpud_fleet_ingest_records_total" in text
     assert "tpud_fleet_agents" in text
+
+
+# -- sharding: stable slots, re-partitioning, parallel replay -------------
+
+def _seed_fleet(st, agents=12, per_agent=9):
+    t = 1000.0
+    for i in range(agents):
+        aid = f"agent-{i:03d}"
+        recs = []
+        for n in range(1, per_agent + 1):
+            if n % 3:
+                to = "Unhealthy" if n % 2 else "Healthy"
+                frm = "Healthy" if to == "Unhealthy" else "Unhealthy"
+                recs.append(_transition(n, t + n, comp=f"c{n % 3}",
+                                        frm=frm, to=to))
+            else:
+                recs.append(_event(n, t + n, comp=f"c{n % 3}", name=f"e{n}"))
+        st.ingest(aid, recs, now=t + per_agent)
+    return agents * per_agent
+
+
+def _comparable(st):
+    """Everything an operator can observe, minus the store-local
+    generation counter — the byte-identity oracle for replays."""
+    roll = dict(st.fleet_rollup())
+    roll.pop("generation", None)
+    return json.dumps(
+        {"rollup": roll, "agents": st.agents_page(0, 500)["agents"]},
+        sort_keys=True,
+    )
+
+
+def test_shard_assignment_is_stable_across_restarts(store):
+    """The journal persists the agent's crc32 *slot*, not the runtime
+    shard index — so the partition key never depends on config."""
+    total = _seed_fleet(store)
+    store.writer.flush()
+    rows = store.db.query(f"SELECT DISTINCT agent, shard FROM {TABLE}")
+    assert len(rows) == 12 and sum(1 for _ in rows)  # one slot per agent
+    for agent, slot in rows:
+        assert slot == slot_of(agent)
+    restarted = FleetRollupStore(store.db, None, shard_count=4)
+    assert restarted.journal_count() == total
+    # every agent landed on the shard its slot derives, nowhere else
+    for agent, slot in rows:
+        for shard in restarted.shards():
+            has = agent in shard.agents
+            assert has == (shard.index == slot % 4)
+
+
+def test_rebuild_with_changed_shard_count_identical(store):
+    """Restarting with a different shard count re-partitions the same
+    journal and must yield byte-identical operator-visible state."""
+    _seed_fleet(store)
+    store.writer.flush()
+    baseline = _comparable(FleetRollupStore(store.db, None, shard_count=1))
+    for n in (2, 3, 8):
+        st = FleetRollupStore(store.db, None, shard_count=n)
+        assert _comparable(st) == baseline, f"shard_count={n} diverged"
+        assert sum(s.records_total for s in st.shards()) == st.journal_count()
+
+
+def test_parallel_and_serial_rebuild_identical(store):
+    _seed_fleet(store, agents=24)
+    store.writer.flush()
+    serial = FleetRollupStore(
+        store.db, None, shard_count=8, rebuild_parallel=False
+    )
+    parallel = FleetRollupStore(
+        store.db, None, shard_count=8, rebuild_parallel=True
+    )
+    assert _comparable(serial) == _comparable(parallel)
+
+
+def test_dedupe_reseed_parity_across_shard_counts(store):
+    """The reseeded replay-suppression window must not depend on how the
+    journal is partitioned: same agent, same newest-N keys, same replay
+    outcome whether the store restarts with 1 shard or 8."""
+    t = 1000.0
+    for aid in ("a-left", "a-right"):
+        store.ingest(
+            aid, [_event(i, t + i, name=f"e{i}") for i in range(1, 6)]
+        )
+    store.writer.flush()
+    replay = [_event(5, t + 5, name="e5")]  # newest key: inside any window
+    for n in (1, 8):
+        st = FleetRollupStore(store.db, None, shard_count=n,
+                              dedupe_keys_max=2)
+        for aid in ("a-left", "a-right"):
+            assert st.dedupe_snapshot(aid) == [
+                f"event:c0:{t + 4}:e4", f"event:c0:{t + 5}:e5"
+            ], f"shard_count={n}"
+            assert st.ingest(aid, replay) == 0
+        assert st.journal_count() == 10
+
+
+def test_legacy_journal_rows_backfill_shard_column(store):
+    """Rows journaled before the shard column existed (DEFAULT -1) get
+    their slot backfilled at boot and replay into the right shard."""
+    t = 1000.0
+    store.ingest("a1", [_transition(1, t)])
+    store.writer.flush()
+    store.db.execute(f"UPDATE {TABLE} SET shard = -1")
+    st = FleetRollupStore(store.db, None, shard_count=8)
+    rows = store.db.query(f"SELECT agent, shard FROM {TABLE}")
+    assert rows and all(slot == slot_of(a) for a, slot in rows)
+    assert st.fleet_rollup()["records_total"] == 1
+    assert "a1" in st.shards()[shard_index("a1", 8)].agents
