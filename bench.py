@@ -495,6 +495,199 @@ def bench_chaos(scenario: str) -> int:
     return 0 if all_passed else 1
 
 
+def _nondaemon_threads(baseline_idents=None):
+    """Live non-daemon threads beyond the baseline set (by ident). The
+    daemon's own workers are all daemon=True by policy (guard-linted
+    modules), so any non-daemon survivor is a leak, not a singleton."""
+    import threading
+
+    baseline_idents = baseline_idents or set()
+    return [
+        t for t in threading.enumerate()
+        if t.is_alive() and not t.daemon
+        and t is not threading.main_thread()
+        and t.ident not in baseline_idents
+    ]
+
+
+def _rss_mb() -> float:
+    try:
+        with open("/proc/self/status", encoding="utf-8") as f:
+            for ln in f:
+                if ln.startswith("VmRSS:"):
+                    return int(ln.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+def bench_race() -> int:
+    """``--race`` mode: the chaos suite under concurrency instrumentation
+    — the closest Python gets to running the campaigns under ``go test
+    -race``. Boots the daemon with every lock tracked by
+    :class:`LockOrderDetector`, shrinks the GIL switch interval to 10µs
+    so thread interleavings are maximally hostile, runs ALL chaos
+    scenarios, and audits non-daemon threads + RSS between scenarios.
+
+    Exit gate (all must hold): every scenario completes without a runner
+    error, the global lock-order graph is acyclic, zero self-deadlocks,
+    and zero leaked non-daemon threads after shutdown. Chaos
+    *expectation* failures are reported but NOT gated — timing windows
+    are not the property under test here.
+    """
+    os.environ["TPUD_TPU_MOCK_ALL_SUCCESS"] = "1"
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import threading
+
+    from gpud_tpu.chaos.fake_plane import FakeControlPlane
+    from gpud_tpu.config import default_config
+    from gpud_tpu.tools.lockcheck import LockOrderDetector
+
+    det = LockOrderDetector()
+    # collect-don't-raise: a DeadlockError inside a daemon worker would
+    # kill that thread and turn a diagnosable report into a hung campaign
+    det.raise_on_self_deadlock = False
+
+    # wrap the module-global locks that predate install() so their
+    # nestings appear in the graph (mirrors tests/test_lockorder.py)
+    import gpud_tpu.log as logmod
+    import gpud_tpu.sqlite as sqlmod
+    from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
+
+    det.wrap_attr(sqlmod, "_stats_mu", "sqlite._stats_mu")
+    det.wrap_attr(logmod, "_mu", "log._mu")
+    det.wrap_attr(DEFAULT_REGISTRY, "_mu", "metrics.Registry._mu")
+    for metric in list(DEFAULT_REGISTRY._metrics.values()):
+        det.wrap_attr(metric, "_mu", f"metric[{metric.name}]._mu")
+
+    baseline = {t.ident for t in threading.enumerate() if not t.daemon}
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(1e-5)  # GIL-preemption amplifier
+
+    tmp = tempfile.mkdtemp(prefix="tpud-race-bench-")
+    kmsg = os.path.join(tmp, "kmsg.fixture")
+    open(kmsg, "w").close()
+
+    results = []
+    leaked: list = []
+    t0 = time.monotonic()
+    det.install()
+    try:
+        # everything below — fake plane, Server, session, outbox, shards
+        # — creates its locks under instrumentation
+        from gpud_tpu.server.server import Server
+
+        cp = FakeControlPlane()
+        cp.attach_rollup()
+        cp.start()
+        cfg = default_config(
+            data_dir=os.path.join(tmp, "data"),
+            port=0,
+            tls=False,
+            kmsg_path=kmsg,
+            endpoint=f"http://127.0.0.1:{cp.port}",
+            token="race-bench-token",
+            machine_id="race-bench-1",
+            # same tightened knobs as --chaos so the outbox-replay walk
+            # fits its windows even though expectations are not gated
+            session_circuit_failure_threshold=3,
+            session_circuit_open_seconds=6.0,
+            outbox_replay_interval_seconds=0.5,
+            outbox_replay_jitter_seconds=0.5,
+        )
+        srv = Server(config=cfg)
+        srv.start()
+        try:
+            if not cp.connected.wait(15):
+                print("[race] WARNING: session never connected; plane "
+                      "expectations will fail (not gated)", file=sys.stderr)
+            srv.chaos.plane = cp
+            rss0 = _rss_mb()
+            for name in sorted(srv.chaos.list_scenarios()):
+                res, err = srv.chaos.run_campaign(name, wait=True)
+                if err:
+                    results.append({"scenario": name, "passed": False,
+                                    "error": err})
+                else:
+                    results.append(res)
+                # between-scenario audit: thread + RSS leak trend
+                stray = _nondaemon_threads(baseline)
+                if stray:
+                    leaked.extend(f"{name}: {t.name}" for t in stray)
+                rss = _rss_mb()
+                print(
+                    f"[race] {name}: "
+                    f"{'ok' if not err else 'ERROR ' + str(err)} "
+                    f"edges={len(det.edges)} "
+                    f"self_deadlocks={len(det.self_deadlocks)} "
+                    f"nondaemon_leaks={len(stray)} rss={rss:.1f}MB "
+                    f"(+{rss - rss0:.1f})",
+                    file=sys.stderr,
+                )
+        finally:
+            srv.stop()
+            cp.stop()
+    finally:
+        det.uninstall()
+        det.unwrap_all()
+        sys.setswitchinterval(old_interval)
+    wall = time.monotonic() - t0
+
+    # post-shutdown audit: give workers a joining grace, then anything
+    # non-daemon still alive leaked past stop()
+    deadline = time.monotonic() + 5.0
+    while _nondaemon_threads(baseline) and time.monotonic() < deadline:
+        time.sleep(0.05)
+    for t in _nondaemon_threads(baseline):
+        leaked.append(f"post-stop: {t.name}")
+
+    cycles = det.cycles()
+    completed = [r for r in results if not r.get("error")]
+    expect_total = expect_passed = 0
+    for res in results:
+        for ph in res.get("phases", []):
+            for exp in ph.get("expectations", []):
+                expect_total += 1
+                expect_passed += 1 if exp.get("ok") else 0
+    print(
+        f"[race] {len(completed)}/{len(results)} scenario(s) completed, "
+        f"expectations {expect_passed}/{expect_total} (not gated), "
+        f"{len(det.edges)} lock-order edges, {len(cycles)} cycle(s), "
+        f"{len(det.self_deadlocks)} self-deadlock(s), "
+        f"{len(leaked)} leaked non-daemon thread(s), "
+        f"wall={wall:.1f}s",
+        file=sys.stderr,
+    )
+    if cycles or det.self_deadlocks:
+        print(det.report(), file=sys.stderr)
+    for item in leaked:
+        print(f"[race]   LEAKED {item}", file=sys.stderr)
+
+    ok = (
+        bool(results)
+        and len(completed) == len(results)
+        and not cycles
+        and not det.self_deadlocks
+        and not leaked
+    )
+    print(json.dumps({
+        "metric": "race-harness clean scenarios",
+        "value": len(completed),
+        "unit": "scenarios",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": {
+            "scenarios": len(results),
+            "lock_order_edges": len(det.edges),
+            "cycles": len(cycles),
+            "self_deadlocks": len(det.self_deadlocks),
+            "leaked_nondaemon_threads": len(leaked),
+            "wall_seconds": round(wall, 1),
+        },
+    }))
+    return 0 if ok else 1
+
+
 PREDICT_FAULTED_COMPONENTS = (
     "accelerator-tpu-temperature", "accelerator-tpu-error-kmsg",
 )
@@ -1677,6 +1870,13 @@ def main(argv=None) -> int:
              "standard bench; a shipped scenario name, or 'all'",
     )
     ap.add_argument(
+        "--race", action="store_true",
+        help="run every chaos scenario under lock-order instrumentation "
+             "with a 10µs GIL switch interval; gates on an acyclic "
+             "lock-order graph, zero self-deadlocks, and zero leaked "
+             "non-daemon threads",
+    )
+    ap.add_argument(
         "--predict", action="store_true",
         help="run the predictive-health bench (slow-ramp + flap-burst "
              "replay against a live daemon; gates on warning lead time, "
@@ -1752,6 +1952,8 @@ def main(argv=None) -> int:
         )
     if args.fleet:
         return bench_fleet(agents=args.fleet_agents)
+    if args.race:
+        return bench_race()
     if args.predict:
         return bench_predict()
     if args.chaos:

@@ -255,6 +255,15 @@ class ChaosManager:
     history. ``plane`` may be attached by the bench/e2e harness to give
     plane_disconnect steps a fake control plane to storm."""
 
+    # _stop is a threading.Event (internally synchronized); plane /
+    # on_result are wired once at server construction, before any
+    # campaign thread exists
+    GUARDED_BY = {
+        "_history": "_mu",
+        "_running": "_mu",
+        "_seq": "_mu",
+    }
+
     def __init__(
         self,
         server,

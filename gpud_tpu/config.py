@@ -324,6 +324,14 @@ class Config:
             return "scheduler watchdog must be >= 0s (0 disables)"
         if not (0.0 <= self.scheduler_jitter_fraction <= 0.5):
             return "scheduler jitter fraction must be in [0, 0.5]"
+        if self.poll_interval_seconds < 1:
+            return "poll interval must be >= 1s"
+        if self.scrape_interval_seconds < 1:
+            return "scrape interval must be >= 1s"
+        if self.compact_period_seconds < 0:
+            return "compact period must be >= 0s (0 disables)"
+        if self.expected_chip_count < 0:
+            return "expected chip count must be >= 0 (0 = derive)"
         from gpud_tpu.remediation.policy import EXECUTABLE_ACTIONS
 
         unknown = sorted(

@@ -110,6 +110,24 @@ class HealthLedger:
     batching.
     """
 
+    GUARDED_BY = {
+        "_last": "_mu",
+        "_last_flap_event": "_mu",
+        "_tx_recent": "_mu",
+        "_annotations": "_mu",
+    }
+    # internal helpers reached only from observe()/is_flapping(), which
+    # take _mu; not renamed *_locked because HOT_WRITE_METHODS (storage
+    # lint) pins two of the names
+    _LOCK_FREE = {
+        "_reconcile_boot": "caller observe() holds _mu for the whole "
+                           "first-observation reconcile",
+        "_record_transition": "callers observe()/_reconcile_boot hold _mu",
+        "_flap_check": "caller observe() holds _mu around the flap scan",
+        "_transitions_in_window": "callers observe() (via _flap_check) and "
+                                  "is_flapping() hold _mu",
+    }
+
     def __init__(
         self,
         db: DB,
@@ -569,7 +587,14 @@ class HealthLedger:
 
     def is_flapping(self, component: str, now: Optional[float] = None) -> bool:
         ts = self.time_now_fn() if now is None else now
-        return self._transitions_in_window(component, ts) >= self.flap_threshold
+        # under _mu: _transitions_in_window prunes the per-component deque
+        # in place, so the unlocked call raced observe()'s appends (the
+        # old `except IndexError` there papered over exactly this)
+        with self._mu:
+            return (
+                self._transitions_in_window(component, ts)
+                >= self.flap_threshold
+            )
 
     def flapping_components(self, now: Optional[float] = None) -> List[str]:
         ts = self.time_now_fn() if now is None else now

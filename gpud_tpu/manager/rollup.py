@@ -243,6 +243,16 @@ class FleetRollupStore:
     outside all of them.
     """
 
+    # _shards is a fixed list built in __init__ and only indexed after —
+    # the per-shard state behind it is guarded by each shard's own lock
+    # (RollupShard.GUARDED_BY), taken via `with shard.lock`
+    GUARDED_BY = {
+        "_generation": "_meta",
+        "_cache": "_meta",
+        "_cache_hits": "_meta",
+        "_cache_misses": "_meta",
+    }
+
     def __init__(
         self,
         db,

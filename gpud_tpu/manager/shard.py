@@ -103,6 +103,10 @@ class RollupShard:
         "records_total", "duplicates_total", "series_total", "ingest_lag",
     )
 
+    # counters (records_total etc.) are deliberately unguarded: plain
+    # ints, torn-read-free, read lock-free on observability paths
+    GUARDED_BY = {"agents": "lock", "dedupe": "lock"}
+
     def __init__(self, index: int) -> None:
         self.index = index
         self.lock = threading.Lock()
@@ -128,6 +132,22 @@ class ShardIngestExecutor:
     blocking the session reader — the agent's durable outbox replays
     un-acked frames, so a drop costs redelivery, never data.
     """
+
+    # _errors / _submit_ns are GIL-atomic (int += races lose one count
+    # at worst on an error path; the deque is bounded and append-only)
+    GUARDED_BY = {
+        "_queues": "_conds",
+        "_busy": "_conds",
+        "_accepted": "_conds",
+        "_dropped": "_conds",
+        "_stopped": "_conds",
+    }
+    _LOCK_FREE = {
+        "queue_depths": "len() snapshot of a fixed-size deque list; "
+                        "torn reads tolerated on the observability path",
+        "stats": "unlocked counter snapshot for observability; values "
+                 "may lag one increment, never corrupt",
+    }
 
     def __init__(
         self,
@@ -182,9 +202,9 @@ class ShardIngestExecutor:
     # -- worker side -------------------------------------------------------
     def _worker(self, i: int) -> None:
         cond = self._conds[i]
-        q = self._queues[i]
         while True:
             with cond:
+                q = self._queues[i]
                 while not q and not self._stopped:
                     cond.wait(timeout=0.5)
                 if not q:
@@ -202,7 +222,7 @@ class ShardIngestExecutor:
             finally:
                 with cond:
                     self._busy[i] -= 1
-                    if not q and not self._busy[i]:
+                    if not self._queues[i] and not self._busy[i]:
                         cond.notify_all()  # flush() barrier
 
     # -- lifecycle / barriers ----------------------------------------------

@@ -37,6 +37,10 @@ def _escape(v: str) -> str:
 class _Metric:
     TYPE = "gauge"
 
+    # Gauge and Counter inherit this map (guard_lint merges base-class
+    # GUARDED_BY down through in-module subclasses)
+    GUARDED_BY = {"_values": "_mu"}
+
     def __init__(
         self, name: str, help_text: str, registry: Optional["Registry"] = None
     ) -> None:
@@ -126,6 +130,8 @@ class Histogram(_Metric):
     """
 
     TYPE = "histogram"
+
+    GUARDED_BY = {"_series": "_mu"}  # plus _Metric's inherited _values
 
     def __init__(
         self,
@@ -219,6 +225,8 @@ MetricT = TypeVar("MetricT", bound=_Metric)
 
 
 class Registry:
+    GUARDED_BY = {"_metrics": "_mu"}
+
     def __init__(self) -> None:
         self._mu = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}

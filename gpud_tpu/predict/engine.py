@@ -131,6 +131,16 @@ class PredictEngine:
     constructed in ``server.Server``, ``start(scheduler)`` in the
     assembly block, ``close()`` on stop."""
 
+    GUARDED_BY = {
+        "_st": "_mu",
+        "_ticks": "_mu",
+        "_last_tick": "_mu",
+    }
+    _LOCK_FREE = {
+        "_tick_component": "caller tick_once() holds _mu across the "
+                           "whole per-component scoring pass",
+    }
+
     def __init__(
         self,
         registry=None,
@@ -528,6 +538,8 @@ class PredictEngine:
             armed = sorted(n for n, st in self._st.items() if st.armed)
             warnings_total = sum(st.warnings for st in self._st.values())
             tracked = len(self._st)
+            ticks = self._ticks
+            last_tick = self._last_tick
         return {
             "enabled": self.enabled,
             "interval_seconds": self.interval,
@@ -538,8 +550,8 @@ class PredictEngine:
             "window_seconds": self.window,
             "warn_cooldown_seconds": self.warn_cooldown,
             "feature_weights": dict(FEATURE_WEIGHTS),
-            "ticks": self._ticks,
-            "last_tick": self._last_tick,
+            "ticks": ticks,
+            "last_tick": last_tick,
             "components_tracked": tracked,
             "armed": armed,
             "warnings_total": warnings_total,

@@ -162,6 +162,32 @@ class Scheduler:
     ``wait_first_runs`` returns. All public methods are thread-safe.
     """
 
+    # _cv wraps _mu (RLock), so `with self._cv` IS the mutex; _thread is
+    # written once under start() and joined in close() after _stopped
+    # flips — deliberately unguarded, as are the itertools counters
+    # (_seq/_worker_seq are internally thread-safe)
+    GUARDED_BY = {
+        "_heap": "_cv",
+        "_jobs": "_cv",
+        "_ready": "_cv",
+        "_workers": "_cv",
+        "_abandoned": "_cv",
+        "_busy": "_cv",
+        "_stopped": "_cv",
+        "_started": "_cv",
+        "_startup_pending": "_cv",
+        "_startup_t0": "_cv",
+        "_startup_ready_seconds": "_cv",
+        "_lag_samples": "_cv",
+    }
+    _LOCK_FREE = {
+        "_push": "internal heap insert; every caller (add_job, submit, "
+                 "poke, _run) already holds _cv",
+        "_startup_discard": "internal readiness bookkeeping; callers "
+                            "cancel()/_run hold _cv",
+        "_check_watchdogs": "called only from _run's scan, under _cv",
+    }
+
     def __init__(
         self,
         workers: int = DEFAULT_WORKERS,

@@ -110,6 +110,19 @@ class SessionOutbox:
     guarded by one lock; SQL rides the shared ``BatchWriter`` buffer.
     """
 
+    GUARDED_BY = {
+        "_next_seq": "_mu",
+        "_acked": "_mu",
+        "_published": "_mu",
+        "_replayed": "_mu",
+        "_write_drops": "_mu",
+        "_retention_drops": "_mu",
+        "_delivered": "_mu",
+        "_ack_progress_ts": "_mu",
+        "_flushed_seq": "_mu",
+        "_encoder": "_mu",
+    }
+
     def __init__(
         self,
         db,
@@ -198,6 +211,9 @@ class SessionOutbox:
             seq = self._next_seq
             self._next_seq += 1
             self._published += 1
+            # snapshot the watermark for the gauge below — reading
+            # self._acked unlocked after the block raced ack()
+            acked = self._acked
         key = dedupe_key or f"{kind}:{seq}"
         sql = (
             f"INSERT INTO {TABLE} (seq, ts, kind, dedupe_key, payload) "
@@ -216,7 +232,7 @@ class SessionOutbox:
         else:
             self.db.execute(sql, params)
         _c_published.inc(labels={"kind": kind})
-        _g_backlog.set(max(0, seq - self._acked))
+        _g_backlog.set(max(0, seq - acked))
         return seq
 
     # -- manager ack path --------------------------------------------------
@@ -495,6 +511,14 @@ class CircuitBreaker:
     State rides ``tpud_session_circuit_state`` and a bounded transition
     history feeds the chaos expectation layer.
     """
+
+    GUARDED_BY = {
+        "_state": "_mu",
+        "_failures": "_mu",
+        "_opened_at": "_mu",
+        "_blocked": "_mu",
+        "history": "_mu",
+    }
 
     def __init__(
         self,

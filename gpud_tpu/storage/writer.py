@@ -119,6 +119,22 @@ class BatchWriter:
     — ``DB`` keeps per-thread connections, so either is safe.
     """
 
+    # _flush_samples is a bounded deque (GIL-atomic appends, stats() reads
+    # a sorted snapshot); _job is written once under start()/close() and
+    # only poked afterwards — both deliberately unguarded
+    GUARDED_BY = {
+        "_appends": "_cv",
+        "_coalesce": "_cv",
+        "_pending": "_cv",
+        "_seq": "_cv",
+        "_flushed_seq": "_cv",
+        "_stopped": "_cv",
+        "_commits": "_cv",
+        "_committed_ops": "_cv",
+        "_dropped": "_cv",
+        "_last_batch": "_cv",
+    }
+
     def __init__(
         self,
         db,
@@ -283,6 +299,7 @@ class BatchWriter:
         for sql, params in coalesce.values():
             by_sql.setdefault(sql, []).append(tuple(params))
         groups.extend(by_sql.items())
+        committed = True
         try:
             self.db.run_batch(groups, fsync=self.fsync)
         except Exception:  # noqa: BLE001
@@ -291,14 +308,20 @@ class BatchWriter:
             # bound while the disk stays broken. The barrier still
             # advances: readers must never hang on storage that is down.
             logger.exception("storage batch commit failed; %d ops lost", n)
-            self._dropped += n
+            committed = False
             _c_dropped.inc(n, {"store": "_commit_failed"})
         else:
-            self._commits += 1
-            self._committed_ops += n
             _c_commits.inc()
         dt = time.monotonic() - t0
         with self._cv:
+            # counter updates ride the same acquisition as the watermark:
+            # unlocked `self._dropped += n` here raced drop_pending() and
+            # _buffer_locked() read-modify-writes (lost increments)
+            if committed:
+                self._commits += 1
+                self._committed_ops += n
+            else:
+                self._dropped += n
             if self._flushed_seq < watermark:
                 self._flushed_seq = watermark
             self._last_batch = n

@@ -17,8 +17,16 @@ Checks:
 - **openapi**: build the node HTTP app against a throwaway unstarted
   Server, render /openapi.json straight from the route table, and
   check both parity directions plus a non-empty summary per operation.
+- **guard**: AST-verify every GUARDED_BY-annotated attribute access in
+  the threaded modules sits under its declared lock (guard_lint).
+- **parity**: config knobs referenced/documented/validated, dispatcher
+  matrix + SDK coverage, /v1 route matrix coverage (parity_lint).
+- **race**: the ``bench.py --race`` harness stays wired — flag, dispatch,
+  GIL amplifier, and exit gates all present (the harness itself is a
+  bench, only its registration is linted here).
 
-Run: ``python -m gpud_tpu.tools.lint_all`` (exit 1 on any problem).
+Run: ``python -m gpud_tpu.tools.lint_all`` (exit 1 on any problem);
+``--json`` emits a machine-readable problem list instead of text.
 """
 
 from __future__ import annotations
@@ -26,9 +34,10 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import re
 import sys
 import tempfile
-from typing import List
+from typing import Dict, List
 
 
 def openapi_parity_problems() -> List[str]:
@@ -92,11 +101,57 @@ def openapi_parity_problems() -> List[str]:
     return problems
 
 
+def race_harness_problems() -> List[str]:
+    """The --race harness itself is a bench (~90s of chaos), far too slow
+    for tier-1 — but its *wiring* is lintable: the flag must stay
+    registered, dispatch to bench_race, and bench_race must keep its
+    GIL-preemption amplifier, detector, and exit gates. This pins the
+    harness against silent removal the same way the other registries are
+    pinned."""
+    import ast
+
+    from gpud_tpu.tools.guard_lint import _repo_root
+
+    path = os.path.join(_repo_root(), "bench.py")
+    if not os.path.isfile(path):
+        return ["bench.py: missing (race harness unregistered)"]
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    problems: List[str] = []
+    tree = ast.parse(src, filename="bench.py")
+    fn = next(
+        (n for n in tree.body
+         if isinstance(n, ast.FunctionDef) and n.name == "bench_race"),
+        None,
+    )
+    if fn is None:
+        return ["bench.py: bench_race() is gone — the race harness must "
+                "stay registered"]
+    seg = ast.get_source_segment(src, fn) or ""
+    for needle, why in (
+        ("sys.setswitchinterval(1e-5)", "GIL-preemption amplifier"),
+        ("LockOrderDetector", "lock-order instrumentation"),
+        ("det.cycles()", "acyclicity gate"),
+        ("self_deadlocks", "self-deadlock gate"),
+        ("_nondaemon_threads", "thread-leak audit"),
+    ):
+        if needle not in seg:
+            problems.append(
+                f"bench.py:{fn.lineno}: bench_race() lost its "
+                f"{why} ({needle!r} not found)"
+            )
+    if '"--race"' not in src or "args.race" not in src:
+        problems.append(
+            "bench.py: the --race flag is no longer wired to bench_race()"
+        )
+    return problems
+
+
 def run_all() -> List[str]:
     """Every lint, one problem list; [] = clean. Problems are prefixed
     with their lint's name so a CI log line is self-locating."""
     from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
-    from gpud_tpu.tools import metrics_lint, storage_lint
+    from gpud_tpu.tools import guard_lint, metrics_lint, parity_lint, storage_lint
 
     problems: List[str] = []
     metrics_lint.populate_default_registry()
@@ -105,17 +160,50 @@ def run_all() -> List[str]:
     )
     problems.extend(f"storage: {p}" for p in storage_lint.run_lint())
     problems.extend(f"openapi: {p}" for p in openapi_parity_problems())
+    problems.extend(f"guard: {p}" for p in guard_lint.run_lint())
+    problems.extend(f"parity: {p}" for p in parity_lint.run_lint())
+    problems.extend(f"race: {p}" for p in race_harness_problems())
     return problems
 
 
-def main() -> int:
+# problems carry a "<lint>: <file>:<line>: <message>" shape when they
+# anchor to a source line; lints that check cross-file invariants (e.g.
+# openapi parity) omit the location
+_PROBLEM_RE = re.compile(r"^(?P<lint>[a-z]+): (?:(?P<file>[^\s:]+\.(?:py|md))"
+                         r"(?::(?P<line>\d+))?: )?(?P<message>.*)$", re.S)
+
+
+def problems_as_json(problems: List[str]) -> List[Dict]:
+    """Machine-readable problem list: lint name, file, line, message."""
+    out: List[Dict] = []
+    for p in problems:
+        m = _PROBLEM_RE.match(p)
+        if m is None:
+            out.append({"lint": "", "file": None, "line": None, "message": p})
+            continue
+        out.append({
+            "lint": m.group("lint"),
+            "file": m.group("file"),
+            "line": int(m.group("line")) if m.group("line") else None,
+            "message": m.group("message"),
+        })
+    return out
+
+
+def main(argv: List[str] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    as_json = "--json" in argv
     problems = run_all()
+    if as_json:
+        print(json.dumps(problems_as_json(problems), indent=2))
+        return 1 if problems else 0
     for p in problems:
         print(f"lint-all: {p}", file=sys.stderr)
     if problems:
         print(f"lint-all: {len(problems)} problem(s)", file=sys.stderr)
         return 1
-    print("lint-all: metrics + storage + openapi clean")
+    print("lint-all: metrics + storage + openapi + guard + parity + "
+          "race-wiring clean")
     return 0
 
 

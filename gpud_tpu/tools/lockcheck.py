@@ -31,7 +31,16 @@ from typing import Dict, List, Optional, Set, Tuple
 
 
 class DeadlockError(RuntimeError):
-    """A thread blocked on a non-reentrant lock it already holds."""
+    """A thread blocked on a non-reentrant lock it already holds.
+
+    ``held`` lists the thread's lock stack (oldest first) at the moment
+    of the fatal acquire, so the traceback alone answers "holding what?"
+    without a debugger attached to a hung test.
+    """
+
+    def __init__(self, msg: str, held: Optional[List[str]] = None):
+        super().__init__(msg)
+        self.held: List[str] = list(held or [])
 
 
 class _Held(threading.local):
@@ -149,11 +158,15 @@ class LockOrderDetector:
         stack = self._held.stack
         if blocking and not proxy._reentrant and any(p is proxy for p in stack):
             site = _creation_site(depth=4)
-            msg = f"self-deadlock: {proxy.name} re-acquired at {site}"
+            held = [p.name for p in stack]
+            msg = (
+                f"self-deadlock: {proxy.name} re-acquired at {site} "
+                f"(held stack: {' -> '.join(held)})"
+            )
             with self._elock:
                 self.self_deadlocks.append(msg)
             if self.raise_on_self_deadlock:
-                raise DeadlockError(msg)
+                raise DeadlockError(msg, held=held)
         for held in stack:
             if held is proxy:
                 continue
@@ -302,8 +315,15 @@ class LockOrderDetector:
         out.sort(key=len)
         return out
 
-    def report(self) -> str:
+    def report(self, edges: bool = True) -> str:
+        """Human-readable run summary: every observed order edge with the
+        ``file:line`` where it was first acquired, cycles (if any) with
+        their member edges, and self-deadlock sightings with held stacks.
+        Pass ``edges=False`` to print only the problems."""
         lines = [f"{len(self.edges)} lock-order edges observed"]
+        if edges:
+            for (a, b), site in sorted(self.edges.items()):
+                lines.append(f"  {a} -> {b} (first acquired at {site})")
         for cyc in self.cycles():
             # an SCC is a set, not a path — listing it with arrows would
             # imply acquisition edges that may not exist

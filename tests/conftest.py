@@ -53,6 +53,52 @@ def pytest_sessionfinish(session, exitstatus):
         _COV.dump(_COV_OUT)
 
 
+# -- thread-leak audit -------------------------------------------------------
+# Daemon policy: every worker thread is daemon=True (the guard-linted
+# modules all spawn with daemon=True), so a non-daemon thread alive after
+# the suite is a leak that would hang interpreter exit in production.
+# Name prefixes here are the known transient singletons, not a dumping
+# ground — justify any addition.
+THREAD_LEAK_ALLOWLIST = (
+    # providers.detect abandons blackholed IMDS probes by design
+    # (shutdown(wait=False)); they die with their own HTTP timeouts
+    "ThreadPoolExecutor-",
+    # debugger/profiler helper threads when the suite runs under an IDE
+    "pydevd", "Profiler",
+)
+
+
+@pytest.fixture(scope="session", autouse=True)
+def thread_leak_audit():
+    """Fail the run if the suite leaks a non-daemon thread: snapshot the
+    non-daemon set before any test, and after the last test give
+    stragglers a short joining grace, then fail on survivors."""
+    import threading
+
+    baseline = {t.ident for t in threading.enumerate() if not t.daemon}
+
+    def stray():
+        return [
+            t for t in threading.enumerate()
+            if t.is_alive() and not t.daemon
+            and t is not threading.main_thread()
+            and t.ident not in baseline
+            and not any(t.name.startswith(p) for p in THREAD_LEAK_ALLOWLIST)
+        ]
+
+    yield
+    wait_until(lambda: not stray(), timeout=5.0)
+    leaked = stray()
+    if leaked:
+        pytest.fail(
+            "suite leaked non-daemon thread(s): "
+            + ", ".join(sorted(t.name for t in leaked))
+            + " — daemon threads are policy (see guard-linted modules); "
+            "either join it in teardown or justify an allowlist entry",
+            pytrace=False,
+        )
+
+
 @pytest.fixture()
 def tmp_db(tmp_path):
     from gpud_tpu.sqlite import DB

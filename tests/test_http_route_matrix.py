@@ -44,6 +44,8 @@ ROUTES_GET = [
     "/machine-info", "/admin/config", "/admin/packages",
     "/v1/components/trigger-check?componentName=cpu",
     "/v1/predict/scores", "/v1/predict/scores?component=cpu&history=4",
+    "/v1/states/history", "/v1/remediation/audit", "/v1/remediation/policy",
+    "/v1/chaos/campaigns", "/v1/session/status", "/v1/debug/traces",
 ]
 
 
@@ -91,6 +93,30 @@ def test_events_since_filter_parses(base):
     assert status == 200
     status, body = _get(base, "/v1/events?startTime=not-a-number")
     assert status == 400, body
+
+
+def test_set_healthy_post_unknown_component_404(base):
+    status, body = _req(
+        base, "POST", "/v1/components/set-healthy?componentName=no-such", {}
+    )
+    assert status == 404
+    assert json.loads(body).get("error")
+
+
+def test_chaos_run_post_unknown_scenario_400(base):
+    status, body = _req(
+        base, "POST", "/v1/chaos/run", {"scenario": "no-such-scenario"}
+    )
+    assert status == 400
+    assert json.loads(body).get("error")
+
+
+def test_delete_builtin_component_refused(base):
+    status, body = _req(
+        base, "DELETE", "/v1/components?componentName=cpu"
+    )
+    assert status == 400
+    assert json.loads(body).get("error")
 
 
 def test_wrong_method_is_405_not_500(base):

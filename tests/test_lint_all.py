@@ -1,9 +1,155 @@
 """The combined registration lint is a tier-1 gate: a metric module,
-store module, or HTTP route that misses its registry fails the test
-suite here, not just a bench run."""
+store module, HTTP route, guarded attribute, or config knob that misses
+its registry/annotation fails the test suite here, not just a bench run.
 
-from gpud_tpu.tools.lint_all import run_all
+The broken-fixture tests feed each new lint a deliberately-violating
+module and assert it objects — a lint that silently passes everything
+is worse than no lint (it certifies unreviewed code)."""
+
+import json
+
+from gpud_tpu.tools import guard_lint, parity_lint
+from gpud_tpu.tools.lint_all import main, problems_as_json, run_all
 
 
 def test_all_lints_clean():
     assert run_all() == []
+
+
+def test_json_flag_emits_empty_list_when_clean(capsys):
+    assert main(["--json"]) == 0
+    assert json.loads(capsys.readouterr().out) == []
+
+
+def test_problems_as_json_splits_location():
+    rows = problems_as_json([
+        "guard: gpud_tpu/storage/writer.py:41: self._pending read outside _cv",
+        "openapi: served but undocumented: GET /v1/x",
+    ])
+    assert rows[0] == {
+        "lint": "guard",
+        "file": "gpud_tpu/storage/writer.py",
+        "line": 41,
+        "message": "self._pending read outside _cv",
+    }
+    assert rows[1]["lint"] == "openapi"
+    assert rows[1]["file"] is None and rows[1]["line"] is None
+
+
+# -- guard_lint on a deliberately broken module ------------------------------
+
+BROKEN_GUARD_MODULE = '''\
+import threading
+
+
+class Broken:
+    GUARDED_BY = {"_items": "_mu"}
+    _LOCK_FREE = {"waived_ok": "snapshot read; torn values tolerated",
+                  "waived_empty": "",
+                  "waived_stale": "method never touches guarded state"}
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = []
+
+    def locked_ok(self):
+        with self._mu:
+            self._items.append(1)
+
+    def unlocked_violation(self):
+        return len(self._items)
+
+    def drain_locked(self):
+        self._items.clear()
+
+    def waived_ok(self):
+        return list(self._items)
+
+    def waived_empty(self):
+        return list(self._items)
+
+    def waived_stale(self):
+        return 7
+'''
+
+
+def test_guard_lint_flags_broken_module(tmp_path):
+    path = tmp_path / "broken.py"
+    path.write_text(BROKEN_GUARD_MODULE)
+    problems, waivers = guard_lint.lint_module(str(path), "broken.py")
+    blob = "\n".join(problems)
+    # the unlocked read is a violation; the locked/waived/_locked-suffix
+    # and __init__ accesses are not
+    assert "unlocked_violation" in blob
+    assert "locked_ok" not in blob and "drain_locked" not in blob
+    assert "__init__" not in blob
+    # empty waiver reasons and waivers with zero violations are themselves
+    # violations — stale escape hatches rot
+    assert "waived_empty" in blob
+    assert "waived_stale" in blob
+    # the justified waiver surfaces in the report with its reason
+    assert any("waived_ok" in w and "torn values tolerated" in w
+               for w in waivers)
+
+
+def test_guard_lint_requires_annotated_class(tmp_path):
+    path = tmp_path / "bare.py"
+    path.write_text("class NothingDeclared:\n    pass\n")
+    problems, _ = guard_lint.lint_module(str(path), "bare.py")
+    assert any("GUARDED_BY" in p for p in problems)
+
+
+def test_guard_lint_real_modules_clean():
+    problems, waivers = guard_lint.run_full()
+    assert problems == []
+    # every waiver printed carries a reason (the lint enforces non-empty,
+    # this pins that they actually flow through to the report)
+    assert waivers and all("—" in w for w in waivers)
+
+
+# -- parity_lint on a deliberately broken repo tree --------------------------
+
+def _write(root, rel, text):
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text)
+
+
+def test_parity_lint_flags_dead_undocumented_unvalidated_knob(tmp_path):
+    _write(tmp_path, "gpud_tpu/config.py", (
+        "class Config:\n"
+        "    ghost_interval_seconds: int = 5\n"
+        "    def validate(self):\n"
+        "        return []\n"
+    ))
+    problems = parity_lint.config_problems(str(tmp_path))
+    blob = "\n".join(problems)
+    assert "dead knob" in blob
+    assert "undocumented" in blob
+    assert "never range-checks" in blob
+
+
+def test_parity_lint_flags_unmatrixed_route(tmp_path):
+    _write(tmp_path, "gpud_tpu/server/app.py",
+           'app.router.add_get("/v1/shiny-new", handler)\n')
+    _write(tmp_path, "tests/test_http_route_matrix.py",
+           'ROUTES_GET = ["/v1/states"]\n')
+    problems = parity_lint.route_problems(str(tmp_path))
+    assert any("/v1/shiny-new" in p and "no row" in p for p in problems)
+
+
+def test_parity_lint_flags_dispatch_method_without_sdk_disposition(tmp_path):
+    _write(tmp_path, "gpud_tpu/session/dispatch.py", (
+        "class Dispatcher:\n"
+        "    def _m_brandNewVerb(self, p):\n"
+        "        return {}\n"
+    ))
+    _write(tmp_path, "tests/test_dispatch_error_matrix.py",
+           "MATRIX = []\n")
+    _write(tmp_path, "gpud_tpu/client/v1.py",
+           "class Client:\n    pass\n")
+    problems = parity_lint.dispatch_problems(str(tmp_path))
+    blob = "\n".join(problems)
+    # the new verb needs both a matrix row and an SDK disposition
+    assert "'brandNewVerb' has no error-matrix row" in blob
+    assert "'brandNewVerb' has no entry" in blob

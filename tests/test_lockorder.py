@@ -112,6 +112,38 @@ def test_self_deadlock_raises_instead_of_hanging():
     assert det.self_deadlocks
 
 
+def test_self_deadlock_carries_held_stack():
+    """The DeadlockError must name every lock the thread held at the
+    fatal acquire — that list is what makes a one-line CI failure
+    actionable without re-running under a debugger."""
+    det = LockOrderDetector()
+    a, b = det.make_lock(), det.make_lock()
+    a.name, b.name = "OUTER", "INNER"
+    a.acquire(); b.acquire()
+    with pytest.raises(DeadlockError) as exc:
+        b.acquire()
+    assert exc.value.held == ["OUTER", "INNER"]
+    assert "held stack: OUTER -> INNER" in str(exc.value)
+    b.release(); a.release()
+    # the recorded sighting carries the stack too (collect-only mode)
+    assert "OUTER -> INNER" in det.self_deadlocks[0]
+
+
+def test_report_lists_edges_with_sites():
+    det = LockOrderDetector()
+    a, b = det.make_lock(), det.make_lock()
+    a.name, b.name = "A", "B"
+    with a:
+        with b:
+            pass
+    rep = det.report()
+    assert "1 lock-order edges observed" in rep
+    # each edge line names the nested acquire's file:line
+    assert "A -> B (first acquired at test_lockorder.py:" in rep
+    # problems-only mode drops the edge listing but keeps the count
+    assert "A -> B" not in det.report(edges=False)
+
+
 def test_nonblocking_reacquire_is_not_a_deadlock():
     det = LockOrderDetector()
     a = det.make_lock()
