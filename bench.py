@@ -495,6 +495,227 @@ def bench_chaos(scenario: str) -> int:
     return 0 if all_passed else 1
 
 
+def bench_fabric(rows: int = 4, cols: int = 4) -> int:
+    """``--fabric`` mode: the topology-aware fabric plane end to end on a
+    simulated sysfs mesh. Boots TWO real daemons enrolled with one real
+    manager (manager/control_plane.py), both reading a shared
+    ``rows``×``cols`` sysfs ICI fixture tree, and gates:
+
+      - discovery: the sysfs inventory resolves to the rows×cols mesh
+        with every torus link enumerated
+      - sweep cost: p95 all-links sweep wall time under 250ms
+      - completeness: every logical link has a swept matrix row
+      - fault-to-matrix: flip one port's sysfs ``state`` file to down →
+        the matrix blames exactly that link (everything else Healthy)
+        within 2s
+      - fleet pane: the ``ici_link`` records ride the real outbox →
+        session → manager path with ZERO loss (journaled == applied per
+        agent), and one ``GET /v1/fleet/fabric?since=`` query answers
+        "which links degraded since t" across BOTH agents
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import requests as rq
+
+    from gpud_tpu.config import default_config
+    from gpud_tpu.fabric.mesh import MeshSpec, mesh_links
+    from gpud_tpu.manager.control_plane import ControlPlane
+    from gpud_tpu.server.server import Server
+    from gpud_tpu.session.outbox import TABLE as OUTBOX_TABLE
+
+    n_chips = rows * cols
+    expected = len(mesh_links(MeshSpec(
+        shape=(rows, cols), chips=tuple(range(n_chips)), source="sysfs",
+    )))
+    tmp = tempfile.mkdtemp(prefix="tpud-fabric-bench-")
+    dev = os.path.join(tmp, "dev")
+    ici_root = os.path.join(tmp, "ici")
+    os.makedirs(dev)
+    for i in range(n_chips):
+        open(os.path.join(dev, f"accel{i}"), "w").close()
+        for l in range(4):
+            d = os.path.join(ici_root, f"chip{i}", f"ici{l}")
+            os.makedirs(d)
+            for fname, val in (("state", "up"), ("tx_bytes", "0"),
+                               ("rx_bytes", "0"), ("crc_errors", "0")):
+                with open(os.path.join(d, fname), "w") as f:
+                    f.write(val)
+    prior_env = {
+        k: os.environ.get(k)
+        for k in ("TPUD_ICI_SYSFS_ROOT", "TPUD_DEV_ROOT",
+                  "TPUD_TPU_MOCK_ALL_SUCCESS", "TPUD_TPU_USE_JAX")
+    }
+    os.environ["TPUD_ICI_SYSFS_ROOT"] = ici_root
+    os.environ["TPUD_DEV_ROOT"] = dev
+    # the sysfs fixture IS the device under test — no mock, no JAX
+    os.environ.pop("TPUD_TPU_MOCK_ALL_SUCCESS", None)
+    os.environ.pop("TPUD_TPU_USE_JAX", None)
+
+    down_link = "c5-c6/x"      # chip 5's x-plus port loss downs exactly this
+    flip = os.path.join(ici_root, "chip5", "ici1", "state")
+    agent_ids = ("fabric-bench-1", "fabric-bench-2")
+    failures = []
+    servers = []
+    cp = ControlPlane()
+    cp.start()
+    try:
+        for i, aid in enumerate(agent_ids, start=1):
+            kmsg = os.path.join(tmp, f"kmsg-{i}.fixture")
+            open(kmsg, "w").close()
+            cfg = default_config(
+                data_dir=os.path.join(tmp, f"data-{i}"),
+                port=0,
+                tls=False,
+                kmsg_path=kmsg,
+                endpoint=cp.endpoint,
+                token="fabric-bench-token",
+                machine_id=aid,
+                accelerator_type_override=f"v5e-{n_chips}",
+                components_disabled=["network-latency"],
+                outbox_replay_interval_seconds=0.2,
+            )
+            srv = Server(config=cfg)
+            srv.start()
+            servers.append(srv)
+        planes = [srv.fabric for srv in servers]
+
+        # -- discovery + completeness + sweep cost -------------------------
+        sweep_s = []
+        for _ in range(12):
+            t0 = time.perf_counter()
+            planes[0].sweep_once()
+            sweep_s.append(time.perf_counter() - t0)
+        for _ in range(5):
+            planes[1].sweep_once()
+        st = planes[0].status()
+        shape = tuple((st.get("mesh") or {}).get("shape") or ())
+        if shape != (rows, cols):
+            failures.append(f"mesh shape {shape} != {(rows, cols)}")
+        if st["links"] != expected:
+            failures.append(f"links {st['links']} != expected {expected}")
+        matrix = planes[0].matrix()
+        unswept = [r["link"] for r in matrix if r["ts"] <= 0]
+        if len(matrix) != expected or unswept:
+            failures.append(
+                f"matrix incomplete: {len(matrix)}/{expected} rows, "
+                f"{len(unswept)} unswept"
+            )
+        sweep_s.sort()
+        sweep_p95 = sweep_s[int(0.95 * (len(sweep_s) - 1))]
+        if sweep_p95 > 0.25:
+            failures.append(f"sweep p95 {sweep_p95 * 1000:.1f}ms > 250ms")
+
+        # -- fault-to-matrix latency ---------------------------------------
+        t_before_fault = time.time()
+        with open(flip, "w") as f:
+            f.write("down")
+        t0 = time.perf_counter()
+        fault_lat = None
+        states = {}
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            planes[0].sweep_once()
+            states = {r["link"]: r["state"] for r in planes[0].matrix()}
+            if states.get(down_link) == "down":
+                fault_lat = time.perf_counter() - t0
+                break
+            time.sleep(0.01)
+        if fault_lat is None:
+            failures.append(f"{down_link} never read down after sysfs flip")
+        elif fault_lat > 2.0:
+            failures.append(f"fault-to-matrix {fault_lat:.3f}s > 2s")
+        blamed_extra = sorted(
+            n for n, s in states.items() if n != down_link and s != "up"
+        )
+        if blamed_extra:
+            failures.append(f"blast radius: un-faulted links not up: {blamed_extra}")
+
+        # -- zero loss through the real outbox -> manager path -------------
+        def journaled_ici(srv) -> int:
+            srv.outbox.flush()
+            row = srv.outbox.db.query_one(
+                f"SELECT COUNT(*) FROM {OUTBOX_TABLE} WHERE kind='ici_link'",
+            )
+            return int(row[0] or 0)
+
+        want = have = {}
+        drained = False
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            planes[1].sweep_once()  # agent 2 sees the same tree, publishes too
+            cp.ingest_executor.flush(timeout=5)
+            want = {
+                aid: journaled_ici(srv)
+                for aid, srv in zip(agent_ids, servers)
+            }
+            have = {
+                aid: (cp.rollup.agent_snapshot(aid) or {})
+                .get("records_by_kind", {}).get("ici_link", 0)
+                for aid in cp.rollup.agent_ids()
+            }
+            if all(have.get(a) == c and c > 0 for a, c in want.items()):
+                drained = True
+                break
+            time.sleep(0.05)
+        if not drained:
+            failures.append(
+                f"ici_link record loss: journaled={want} rollup-applied={have}"
+            )
+
+        # -- one fleet query answers degraded-since across both agents ----
+        r = rq.get(
+            f"{cp.endpoint}/v1/fleet/fabric",
+            params={"since": t_before_fault},
+            timeout=10,
+        )
+        if r.status_code != 200:
+            failures.append(f"GET /v1/fleet/fabric -> HTTP {r.status_code}")
+        else:
+            body = r.json()
+            blamed_agents = {
+                d["agent"] for d in body.get("degraded", [])
+                if d["link"] == down_link and d["state"] == "down"
+            }
+            if body.get("agents", 0) < 2:
+                failures.append(
+                    f"fleet pane shows {body.get('agents')} agent(s), want >= 2"
+                )
+            if blamed_agents != set(agent_ids):
+                failures.append(
+                    f"fleet pane blames {sorted(blamed_agents)} for "
+                    f"{down_link}, want {sorted(agent_ids)}"
+                )
+    finally:
+        for srv in servers:
+            srv.stop()
+        cp.stop()
+        for k, v in prior_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    print(
+        f"[fabric] mesh {rows}x{cols} ({n_chips} chips, {expected} links): "
+        f"sweep p95={sweep_p95 * 1000:.1f}ms, fault-to-matrix="
+        f"{(fault_lat or -1) * 1000:.0f}ms, journaled={want} applied={have}",
+        file=sys.stderr,
+    )
+    for msg in failures:
+        print(f"[fabric] FAIL: {msg}", file=sys.stderr)
+    if not failures:
+        print(f"[fabric] PASS: all gates held across {len(servers)} agents",
+              file=sys.stderr)
+    lat_ms = (fault_lat or -1.0) * 1000.0
+    print(json.dumps({
+        "metric": "fabric fault-to-matrix latency",
+        "value": round(lat_ms, 1),
+        "unit": "ms",
+        # reference gate: the production 60s sweep cadence
+        "vs_baseline": round(60000.0 / lat_ms, 1) if lat_ms > 0 else 0.0,
+    }))
+    return 0 if not failures else 1
+
+
 def _nondaemon_threads(baseline_idents=None):
     """Live non-daemon threads beyond the baseline set (by ident). The
     daemon's own workers are all daemon=True by policy (guard-linted
@@ -1937,6 +2158,18 @@ def main(argv=None) -> int:
              f"{FLEET_SOCKET_RECORDS_PER_AGENT})",
     )
     ap.add_argument(
+        "--fabric", action="store_true",
+        help="run the fabric observability plane bench (two real daemons "
+             "on a shared sysfs mesh fixture enrolled with a real "
+             "manager; gates mesh discovery, sweep cost, fault-to-matrix "
+             "latency, zero ici_link loss, and the one-query fleet pane) "
+             "instead of the standard bench",
+    )
+    ap.add_argument(
+        "--fabric-mesh", default="4x4", metavar="RxC",
+        help="mesh shape for --fabric (default 4x4)",
+    )
+    ap.add_argument(
         "--fleet-shards", type=int, default=0,
         help="manager shard count for --fleet --socket (default: the "
              "manager's own default)",
@@ -1952,6 +2185,14 @@ def main(argv=None) -> int:
         )
     if args.fleet:
         return bench_fleet(agents=args.fleet_agents)
+    if args.fabric:
+        try:
+            mesh_rows, mesh_cols = (
+                int(p) for p in args.fabric_mesh.lower().split("x", 1)
+            )
+        except ValueError:
+            ap.error(f"--fabric-mesh must look like 4x4, got {args.fabric_mesh!r}")
+        return bench_fabric(rows=mesh_rows, cols=mesh_cols)
     if args.race:
         return bench_race()
     if args.predict:

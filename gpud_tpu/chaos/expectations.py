@@ -19,6 +19,9 @@ invariants that hold the daemon itself to account:
   fleet:        the manager-side rollup store (manager/rollup.py) agrees
                 with the plane's ingest ledger — one row per accepted
                 record, redeliveries deduped, per-kind counts matching
+  fabric:       the fabric plane's mesh matrix blames exactly the
+                faulted ICI links (Degraded on latency deviation, Down
+                on port loss) and leaves every other link Healthy
   predict:      the predict engine warned before the reactive hard
                 signal (ordering + lead-time floor), and stayed silent
                 on un-faulted components
@@ -553,6 +556,76 @@ def _eval_fleet(server, spec: Dict, ctx) -> List[ExpectationResult]:
     return out
 
 
+def _eval_fabric(server, spec: Dict, ctx) -> List[ExpectationResult]:
+    """Mesh matrix assertions (fabric/plane.py, docs/fabric.md):
+
+      degraded:       link names that must read Degraded (EWMA latency
+                      deviation) in the current matrix
+      down:           link names that must read Down (endpoint port down)
+      others_healthy: true — every OTHER swept link must read Up; the
+                      matrix blames exactly the faulted links, nothing
+                      adjacent (blast-radius containment)
+
+    The plane is swept once per poll so the configured sweep cadence
+    never gates a campaign; fault-to-matrix latency is measured from the
+    phase's first fault step."""
+    plane = getattr(server, "fabric", None)
+    if plane is None:
+        return [ExpectationResult(
+            "fabric", False, detail="fabric plane disabled (fabric_sweep_enabled)",
+        )]
+    from gpud_tpu.fabric.plane import STATE_DEGRADED, STATE_DOWN, STATE_UP
+
+    want_degraded = set(spec.get("degraded") or [])
+    want_down = set(spec.get("down") or [])
+    others = bool(spec.get("others_healthy", False))
+    within = float(spec.get("within", ctx.detect_timeout))
+    ref = ctx.fault_t0 if ctx.fault_t0 is not None else ctx.phase_start
+    deadline = ctx.time_fn() + within
+
+    def states_now() -> Dict[str, str]:
+        plane.sweep_once()  # the sweep cadence must never gate a campaign
+        return {r["link"]: r["state"] for r in plane.matrix()}
+
+    def settled():
+        states = states_now()
+        degraded = {n for n, s in states.items() if s == STATE_DEGRADED}
+        down = {n for n, s in states.items() if s == STATE_DOWN}
+        if not want_degraded <= degraded or not want_down <= down:
+            return None
+        if others and (degraded - want_degraded or down - want_down):
+            return None
+        return (states,)
+
+    got = _poll(settled, deadline, ctx)
+    if got is None:
+        states = states_now()
+        by_state: Dict[str, List[str]] = {}
+        for name, s in sorted(states.items()):
+            by_state.setdefault(s or "unswept", []).append(name)
+        return [ExpectationResult(
+            "fabric", False, timed_out=True,
+            detail=(
+                f"matrix never settled within {within:g}s — wanted "
+                f"degraded={sorted(want_degraded)} down={sorted(want_down)} "
+                f"others_healthy={others}; matrix now: "
+                + "; ".join(f"{s}={v}" for s, v in sorted(by_state.items()))
+            ),
+        )]
+    states = got[0]
+    latency = max(0.0, ctx.time_fn() - ref)
+    healthy = sum(1 for s in states.values() if s == STATE_UP)
+    out = [ExpectationResult(
+        "fabric", True, latency_seconds=latency,
+        detail=(
+            f"matrix blames exactly the faulted links in "
+            f"{latency * 1000.0:.0f}ms: {len(want_degraded)} degraded, "
+            f"{len(want_down)} down, {healthy} healthy of {len(states)}"
+        ),
+    )]
+    return out
+
+
 def _eval_predict(server, specs: List[Dict], ctx) -> List[ExpectationResult]:
     """Predictive-health assertions (gpud_tpu/predict/, docs/predict.md):
 
@@ -851,6 +924,8 @@ def evaluate_phase(server, expect: Dict, ctx) -> List[ExpectationResult]:
         results.extend(_eval_outbox(server, expect["outbox"] or {}, ctx))
     if "fleet" in expect:
         results.extend(_eval_fleet(server, expect["fleet"] or {}, ctx))
+    if "fabric" in expect:
+        results.extend(_eval_fabric(server, expect["fabric"] or {}, ctx))
     if "predict" in expect:
         results.extend(_eval_predict(server, expect["predict"] or [], ctx))
     if "invariants" in expect:

@@ -21,10 +21,17 @@ Fault classes beyond the classic one-shot kmsg write:
                        engine's) injectable clock by ``offset`` seconds
   - ``plane_disconnect`` — drops control-plane sessions on the fake
                        plane harness (disconnect/reconnect storms)
+  - ``fabric_latency_ramp`` — slow-ramp ONE mesh link's probe latency
+                       through the fabric plane's ``telemetry_fn`` hook
+                       (quiet ICI degradation)
+  - ``fabric_link_down`` — hard-down one physical ICI port (sysfs state
+                       flip when a tree is attached, else a ``links_fn``
+                       snapshot rewrite on the mock backend)
 
 plus campaign helpers: ``trigger`` (poke a check), ``set_healthy``,
 ``remediation_scan`` (poke the engine), ``predict_scan`` (synchronous
-precursor-scoring tick), ``purge`` (retention pass now).
+precursor-scoring tick), ``fabric_sweep`` (one all-links sweep now),
+``purge`` (retention pass now).
 """
 
 from __future__ import annotations
@@ -196,6 +203,99 @@ def act_plane_refuse(server, step: Dict, ctx) -> Optional[str]:
         timer.start()
         ctx.cleanups.append(timer.cancel)
     logger.info("chaos: control plane refusing connects (duration=%gs)", duration)
+    return None
+
+
+def _fabric_plane(server):
+    plane = getattr(server, "fabric", None)
+    if plane is None:
+        return None, "fabric plane disabled (fabric_sweep_enabled)"
+    return plane, None
+
+
+def act_fabric_latency_ramp(server, step: Dict, ctx) -> Optional[str]:
+    """Quiet ICI degradation: wraps the fabric plane's ``telemetry_fn``
+    probe so ``link``'s latency reads as a start→end interpolation over
+    ``ramp_seconds`` (then holds at ``end``) while every other link keeps
+    its base reading — the EWMA baseline must flag exactly that link."""
+    plane, err = _fabric_plane(server)
+    if err:
+        return err
+    target = str(step.get("link", ""))
+    if not target:
+        return "fabric_latency_ramp needs a `link` (e.g. c0-c1/x)"
+    prev_fn = plane.telemetry_fn  # may be None = synthetic probe
+    base_fn = prev_fn or plane.synthetic_latency
+    start = float(step.get("start", 0.0))
+    end = float(step.get("end", 0.0))
+    ramp = float(step.get("ramp_seconds", 0.0))
+    t0 = ctx.time_fn()
+    time_fn = ctx.time_fn
+
+    def ramped(link):
+        if link.name != target:
+            return base_fn(link)
+        frac = 1.0 if ramp <= 0 else min(1.0, (time_fn() - t0) / ramp)
+        return start + (end - start) * frac
+
+    plane.telemetry_fn = ramped
+    ctx.cleanups.append(lambda: setattr(plane, "telemetry_fn", prev_fn))
+    return None
+
+
+def act_fabric_link_down(server, step: Dict, ctx) -> Optional[str]:
+    """Hard-down one physical ICI port (``port: chipN/iciL``). With a
+    sysfs tree attached (``TPUD_ICI_SYSFS_ROOT``) the port's ``state``
+    file is flipped to ``down`` — the real inventory walk sees it. On the
+    mock backend the plane's ``links_fn`` is wrapped to rewrite that one
+    snapshot instead. Either way cleanup restores the port."""
+    import os
+
+    plane, err = _fabric_plane(server)
+    if err:
+        return err
+    port = str(step.get("port", ""))
+    if not port or "/" not in port:
+        return "fabric_link_down needs a `port` (e.g. chip5/ici1)"
+    root = os.environ.get("TPUD_ICI_SYSFS_ROOT", "")
+    state_path = os.path.join(root, *port.split("/"), "state") if root else ""
+    if state_path and os.path.isfile(state_path):
+        with open(state_path, encoding="ascii", errors="replace") as f:
+            prev_state = f.read()
+
+        def _restore() -> None:
+            with open(state_path, "w", encoding="ascii") as f:
+                f.write(prev_state)
+
+        with open(state_path, "w", encoding="ascii") as f:
+            f.write("down")
+        ctx.cleanups.append(_restore)
+        return None
+    from gpud_tpu.tpu.instance import LinkState
+
+    prev_fn = plane.links_fn  # may be None = backend port walk
+    base_fn = prev_fn or plane.default_links
+
+    def downed():
+        out = []
+        for snap in base_fn():
+            if snap.name == port:
+                snap = dataclasses.replace(snap, state=LinkState.DOWN)
+            out.append(snap)
+        return out
+
+    plane.links_fn = downed
+    ctx.cleanups.append(lambda: setattr(plane, "links_fn", prev_fn))
+    return None
+
+
+def act_fabric_sweep(server, step: Dict, ctx) -> Optional[str]:
+    """Run one all-links fabric sweep now: campaigns pin the sweep
+    timeline to the fault timeline instead of racing the cadence."""
+    plane, err = _fabric_plane(server)
+    if err:
+        return err
+    plane.sweep_once()
     return None
 
 
@@ -400,6 +500,9 @@ ACTIONS: Dict[str, Callable] = {
     "clock_skew": act_clock_skew,
     "plane_disconnect": act_plane_disconnect,
     "plane_refuse": act_plane_refuse,
+    "fabric_latency_ramp": act_fabric_latency_ramp,
+    "fabric_link_down": act_fabric_link_down,
+    "fabric_sweep": act_fabric_sweep,
     "trigger": act_trigger,
     "set_healthy": act_set_healthy,
     "remediation_scan": act_remediation_scan,

@@ -44,7 +44,8 @@ logger = get_logger(__name__)
 # actions that *start* a fault: the phase's detection-latency clock is
 # anchored at the first of these to fire
 FAULT_ACTIONS = ("inject", "metric_ramp", "runtime_crash", "clock_skew",
-                 "plane_disconnect", "plane_refuse")
+                 "plane_disconnect", "plane_refuse",
+                 "fabric_latency_ramp", "fabric_link_down")
 
 STEP_WAIT_SECONDS = 60.0  # per-step completion ceiling on the pool
 

@@ -55,6 +55,9 @@ KNOWN_ACTIONS = (
     "clock_skew",      # shift a component's / the engine's clock by `offset`
     "plane_disconnect",  # drop control-plane sessions (fake_plane harness)
     "plane_refuse",    # hard-down manager: 503 every connect for `duration`
+    "fabric_latency_ramp",  # slow-ramp one mesh link's probe latency
+    "fabric_link_down",     # hard-down one physical ICI port
+    "fabric_sweep",    # run one all-links fabric sweep now
     "trigger",         # poke a component check to the front of the heap
     "set_healthy",     # clear a component's sticky state
     "remediation_scan",  # poke the remediation engine's scan job
@@ -70,7 +73,7 @@ KNOWN_ACTIONS = (
 # expectation kinds evaluated after each phase (gpud_tpu/chaos/expectations.py)
 KNOWN_EXPECTATIONS = (
     "detect", "ledger", "remediation", "events", "invariants", "plane",
-    "outbox", "fleet", "predict",
+    "outbox", "fleet", "fabric", "predict",
 )
 
 MAX_STEP_OCCURRENCES = 1000  # per phase — runaway `count` backstop
@@ -308,6 +311,8 @@ def first_fault_offset(occurrences: List[StepOccurrence]) -> Optional[Tuple[floa
     """(offset, action) of the first fault-class step in a phase — the
     reference point detection latency is measured from."""
     for o in occurrences:
-        if o.action in ("inject", "metric_ramp", "runtime_crash", "plane_disconnect"):
+        if o.action in ("inject", "metric_ramp", "runtime_crash",
+                        "plane_disconnect", "fabric_latency_ramp",
+                        "fabric_link_down"):
             return o.offset, o.action
     return None

@@ -545,6 +545,61 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def cmd_fabric(args) -> int:
+    """Show the fabric plane's mesh-wide per-link health matrix:
+    discovered mesh shape, sweep status, and each logical link's state,
+    latency, and EWMA deviation (docs/fabric.md)."""
+    from gpud_tpu.client.v1 import Client, ClientError
+
+    scheme = "http" if getattr(args, "no_tls", False) else "https"
+    c = Client(
+        base_url=f"{scheme}://localhost:{args.port}",
+        timeout=float(args.timeout),
+    )
+    try:
+        out = c.get_fabric(
+            link=args.link,
+            since=args.since or None,
+            limit=args.limit or None,
+        )
+    except ClientError as e:
+        print(f"error: {e.body[:500]}", file=sys.stderr)
+        return 1
+    except Exception as e:  # noqa: BLE001
+        print(f"tpud unreachable on port {args.port}: {e}", file=sys.stderr)
+        return 1
+    if getattr(args, "as_json", False):
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+    status = out.get("status") or {}
+    mesh = status.get("mesh") or {}
+    shape = "x".join(str(d) for d in (mesh.get("shape") or [])) or "?"
+    print(
+        f"fabric: mesh={shape} ({mesh.get('source', 'unknown')})  "
+        f"links={status.get('links', 0)}  "
+        f"sweeps={status.get('sweeps', 0)}  "
+        f"degraded={len(status.get('degraded') or [])}  "
+        f"down={len(status.get('down') or [])}"
+    )
+    matrix = out.get("matrix") or []
+    if not matrix:
+        print("no links observed (degraded 1x1 mesh or no sweep yet)")
+        return 0
+    for row in matrix:
+        state = row.get("state") or "unswept"
+        print(
+            f"  {row.get('link')}: {state}"
+            f"  latency={row.get('latency_seconds', 0):.6f}s"
+            f"  deviation={row.get('deviation', 0):.2f}"
+        )
+    for row in out.get("history") or []:
+        print(
+            f"  [history] {row.get('ts', 0):.3f} {row.get('link')}: "
+            f"{row.get('state')} latency={row.get('latency_seconds', 0):.6f}s"
+        )
+    return 0
+
+
 def cmd_machine_info(args) -> int:
     from gpud_tpu.machine_info import get_machine_info
     from gpud_tpu.tpu.instance import new_instance
@@ -849,6 +904,11 @@ def cmd_fleet(args) -> int:
     try:
         if args.fleet_cmd == "rollup":
             data = get("/v1/fleet/rollup")
+        elif args.fleet_cmd == "fabric":
+            params = {}
+            if args.since:
+                params["since"] = args.since
+            data = get("/v1/fleet/fabric", params=params or None)
         elif args.fleet_cmd == "agents":
             data = get(
                 "/v1/fleet/agents",
@@ -1077,6 +1137,23 @@ def build_parser() -> argparse.ArgumentParser:
                      help="machine-readable scores + status")
     ppr.set_defaults(fn=cmd_predict)
 
+    pfa = sub.add_parser(
+        "fabric",
+        help="ICI fabric health: mesh-wide per-link sweep matrix",
+    )
+    pfa.add_argument("--link", default="",
+                     help="append history for one link (e.g. c0-c1/x)")
+    pfa.add_argument("--since", type=float, default=0.0,
+                     help="history unix-timestamp floor")
+    pfa.add_argument("--limit", type=int, default=0,
+                     help="max history rows to append")
+    pfa.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
+    pfa.add_argument("--no-tls", action="store_true")
+    pfa.add_argument("--timeout", type=float, default=30.0)
+    pfa.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable matrix + status")
+    pfa.set_defaults(fn=cmd_fabric)
+
     pse = sub.add_parser(
         "session", help="control-plane session / outbox health"
     )
@@ -1183,6 +1260,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     fr = fsub.add_parser("rollup", help="fleet-wide rollup aggregates")
     _fleet_common(fr)
+    ff = fsub.add_parser(
+        "fabric", help="fleet-wide ICI link matrix: degraded links since ts"
+    )
+    ff.add_argument("--since", type=float, default=0.0,
+                    help="unix-timestamp floor for degraded-since")
+    _fleet_common(ff)
     fa = fsub.add_parser("agents", help="paginated per-agent rollups")
     fa.add_argument("--offset", type=int, default=0)
     fa.add_argument("--limit", type=int, default=100)

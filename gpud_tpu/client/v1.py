@@ -187,6 +187,25 @@ class Client:
             params["history"] = history
         return self._req("GET", "/v1/predict/scores", params=params or None)
 
+    def get_fabric(
+        self,
+        link: str = "",
+        since: Optional[float] = None,
+        limit: Optional[int] = None,
+    ) -> Dict:
+        """Fabric matrix (``GET /v1/fabric``): discovered mesh, sweep
+        status, and the current per-link (src, dst, axis, latency,
+        state) matrix; any of ``link``/``since``/``limit`` appends
+        matrix history rows from the durable store."""
+        params: Dict = {}
+        if link:
+            params["link"] = link
+        if since is not None:
+            params["since"] = since
+        if limit is not None:
+            params["limit"] = limit
+        return self._req("GET", "/v1/fabric", params=params or None)
+
     def get_remediation_policy(self) -> Dict:
         """Current remediation policy + guard state."""
         return self._req("GET", "/v1/remediation/policy")
@@ -263,6 +282,15 @@ class Client:
         """Fleet-wide rollup aggregates (``GET /v1/fleet/rollup``):
         availability, MTTR/MTBF, flap leaders, per-kind record counts."""
         return self._req("GET", "/v1/fleet/rollup")
+
+    def get_fleet_fabric(self, since: Optional[float] = None) -> Dict:
+        """Fleet-wide ICI fabric rollup (``GET /v1/fleet/fabric``):
+        per-agent link aggregates — which links degraded since ``since``
+        across every agent, from one query."""
+        params: Dict = {}
+        if since is not None:
+            params["since"] = since
+        return self._req("GET", "/v1/fleet/fabric", params=params or None)
 
     def get_fleet_agents(self, offset: int = 0, limit: int = 100) -> Dict:
         """One paginated page of per-agent rollups

@@ -40,6 +40,12 @@ DEFAULT_PREDICT_WINDOW = 600.0           # feature lookback window (s)
 DEFAULT_PREDICT_HISTORY_LIMIT = 256      # in-memory score points / component
 DEFAULT_PREDICT_WARN_COOLDOWN = 300.0    # predicted-warning audit-row cooldown
 DEFAULT_PREDICT_PUBLISH_INTERVAL = 60.0  # armed-score outbox snapshot cadence
+# fabric observability plane (docs/fabric.md): mesh-wide all-links sweep
+DEFAULT_FABRIC_SWEEP_INTERVAL = 60.0     # all-links sweep cadence (s)
+DEFAULT_FABRIC_SWEEP_THRESHOLD_Z = 4.0   # EWMA z that flags Degraded
+DEFAULT_FABRIC_SWEEP_EWMA_ALPHA = 0.3    # per-link baseline smoothing
+DEFAULT_FABRIC_SWEEP_WARMUP = 3          # sweeps before deviation flags
+DEFAULT_FABRIC_SWEEP_RETENTION = 7 * 86400.0  # matrix history window (s)
 # unified check scheduler (docs/scheduler.md): bounded worker pool +
 # deadline heap replacing per-component poller threads
 DEFAULT_SCHEDULER_WORKERS = 4
@@ -137,6 +143,16 @@ class Config:
     predict_history_limit: int = DEFAULT_PREDICT_HISTORY_LIMIT
     predict_warn_cooldown_seconds: float = DEFAULT_PREDICT_WARN_COOLDOWN
     predict_publish_interval_seconds: float = DEFAULT_PREDICT_PUBLISH_INTERVAL
+    # fabric observability (docs/fabric.md): logical-mesh discovery + the
+    # all-links sweep with per-link EWMA latency baselines. Hermetic by
+    # construction: with no JAX devices and no ICI inventory the mesh
+    # degrades to 1x1 and the sweep observes zero links.
+    fabric_sweep_enabled: bool = True
+    fabric_sweep_interval_seconds: float = DEFAULT_FABRIC_SWEEP_INTERVAL
+    fabric_sweep_latency_threshold_z: float = DEFAULT_FABRIC_SWEEP_THRESHOLD_Z
+    fabric_sweep_ewma_alpha: float = DEFAULT_FABRIC_SWEEP_EWMA_ALPHA
+    fabric_sweep_warmup_sweeps: int = DEFAULT_FABRIC_SWEEP_WARMUP
+    fabric_sweep_retention_seconds: float = DEFAULT_FABRIC_SWEEP_RETENTION
     # chaos campaign runner (docs/chaos.md): enabled by default — running
     # a campaign still takes an explicit API/CLI call, and every fault is
     # software-injected and undone on campaign exit
@@ -278,6 +294,16 @@ class Config:
             return "predict warn cooldown must be >= 0s"
         if self.predict_publish_interval_seconds < 0:
             return "predict publish interval must be >= 0s"
+        if self.fabric_sweep_interval_seconds <= 0:
+            return "fabric sweep interval must be > 0s"
+        if self.fabric_sweep_latency_threshold_z <= 0:
+            return "fabric sweep latency threshold z must be > 0"
+        if not 0.0 < self.fabric_sweep_ewma_alpha <= 1.0:
+            return "fabric sweep ewma alpha must be in (0, 1]"
+        if self.fabric_sweep_warmup_sweeps < 1:
+            return "fabric sweep warmup sweeps must be >= 1"
+        if self.fabric_sweep_retention_seconds < 60:
+            return "fabric sweep retention must be >= 60s"
         if self.chaos_max_campaign_seconds < 1:
             return "chaos max campaign seconds must be >= 1"
         if self.chaos_history_limit < 1:

@@ -1,0 +1,23 @@
+"""Fabric observability plane: mesh discovery, all-links sweep, matrix.
+
+The per-host ICI component (components/tpu/ici.py) answers "is any port
+on this host down". This package answers the fabric-level question the
+ROADMAP's north star asks — "which ICI links in the pod degraded this
+week" — by discovering the logical device mesh, sweeping every logical
+link on a scheduler cadence, keeping per-link EWMA latency baselines,
+and shipping deviations to the manager as ``ici_link`` outbox records
+(see docs/fabric.md).
+"""
+
+from gpud_tpu.fabric.mesh import MeshLink, MeshSpec, discover_mesh, mesh_links
+from gpud_tpu.fabric.plane import FabricPlane
+from gpud_tpu.fabric.store import FabricMatrixStore
+
+__all__ = [
+    "FabricMatrixStore",
+    "FabricPlane",
+    "MeshLink",
+    "MeshSpec",
+    "discover_mesh",
+    "mesh_links",
+]

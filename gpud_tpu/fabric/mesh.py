@@ -1,0 +1,203 @@
+"""Logical mesh discovery + link enumeration for the fabric sweep.
+
+Discovery degrades through three sources (MT4G's lesson in PAPERS.md —
+topology auto-discovery is itself the observability product, so never
+require the operator to declare the mesh):
+
+1. ``jax`` — when the operator opted into the exclusive libtpu client
+   (``TPUD_TPU_USE_JAX``) and ``jax.devices()`` yields real TPU devices,
+   the mesh is the near-square factorization of the device count, the
+   same shape SNIPPETS.md [2]/[3] build with
+   ``Mesh(np.array(jax.devices()).reshape(r, c), axis_names=...)``.
+2. ``sysfs`` — the ICI link inventory (sysfs layout or mock backend)
+   gives the local chip set; the mesh is its near-square factorization.
+3. ``degraded`` — no inventory at all (tier-1 under ``JAX_PLATFORMS=cpu``
+   with no fixture tree): a 1×1 mesh with zero links, so every consumer
+   sees a trivially complete, trivially healthy sweep instead of an
+   error path.
+
+Axis/port convention (2D torus): each chip exposes
+``ici_links_per_chip`` ports; port ``2k`` faces the negative direction
+of axis ``k`` and port ``2k+1`` the positive direction, with axis 0 =
+``"x"`` (fast, column index) and axis 1 = ``"y"`` (row index). A logical
+mesh link ``src→dst`` along axis ``k`` is therefore down when src's
+port ``2k+1`` or dst's port ``2k`` reports down.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from gpud_tpu.log import get_logger
+
+logger = get_logger(__name__)
+
+# axis order mirrors the port layout: ports (0,1) walk "x", (2,3) "y",
+# (4,5) "z" on 3D generations
+AXIS_NAMES = ("x", "y", "z")
+
+SOURCE_JAX = "jax"
+SOURCE_SYSFS = "sysfs"
+SOURCE_DEGRADED = "degraded"
+
+ENV_USE_JAX = "TPUD_TPU_USE_JAX"
+
+
+@dataclass(frozen=True)
+class MeshLink:
+    """One logical mesh edge (directed src→dst along one axis)."""
+
+    src_chip: int
+    dst_chip: int
+    axis: str
+
+    @property
+    def name(self) -> str:
+        return f"c{self.src_chip}-c{self.dst_chip}/{self.axis}"
+
+    def to_dict(self) -> Dict:
+        return {
+            "link": self.name,
+            "src_chip": self.src_chip,
+            "dst_chip": self.dst_chip,
+            "axis": self.axis,
+        }
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """A discovered logical mesh: row-major chip grid + provenance."""
+
+    shape: Tuple[int, ...]          # (rows, cols) — rows walk "y", cols "x"
+    chips: Tuple[int, ...] = field(default=())  # chip ids, row-major
+    source: str = SOURCE_DEGRADED
+
+    @property
+    def rows(self) -> int:
+        return self.shape[0] if self.shape else 1
+
+    @property
+    def cols(self) -> int:
+        return self.shape[1] if len(self.shape) > 1 else 1
+
+    def coords(self, index: int) -> Tuple[int, int]:
+        return index // self.cols, index % self.cols
+
+    def to_dict(self) -> Dict:
+        return {
+            "shape": list(self.shape),
+            "chips": len(self.chips),
+            "source": self.source,
+        }
+
+
+def near_square_factor(n: int) -> Tuple[int, int]:
+    """``(rows, cols)`` with ``rows*cols == n``, rows the largest divisor
+    ≤ √n — 8 → 2×4, 16 → 4×4, a prime p → 1×p (a ring)."""
+    if n <= 1:
+        return (1, max(1, n))
+    rows = 1
+    r = 1
+    while r * r <= n:
+        if n % r == 0:
+            rows = r
+        r += 1
+    return (rows, n // rows)
+
+
+def _jax_chip_count() -> int:
+    """Device count from the exclusive libtpu client, 0 when unavailable
+    or not actually TPU (``JAX_PLATFORMS=cpu`` lands here → 0)."""
+    if os.environ.get(ENV_USE_JAX, "") not in ("1", "true", "yes"):
+        return 0
+    try:
+        import jax
+
+        devices = [d for d in jax.devices() if d.platform == "tpu"]
+        return len(devices)
+    except Exception as exc:  # noqa: BLE001 — no jax / no TPU / init race
+        logger.debug("jax mesh discovery unavailable: %s", exc)
+        return 0
+
+
+def discover_mesh(tpu=None) -> MeshSpec:
+    """Derive the logical mesh (module docstring for the source ladder)."""
+    n = _jax_chip_count()
+    if n >= 2:
+        return MeshSpec(
+            shape=near_square_factor(n),
+            chips=tuple(range(n)),
+            source=SOURCE_JAX,
+        )
+    chips: List[int] = []
+    if tpu is not None:
+        try:
+            chips = sorted({snap.chip_id for snap in tpu.ici_links()})
+        except Exception as exc:  # noqa: BLE001 — backend probe failed
+            logger.debug("ici inventory unavailable for mesh discovery: %s", exc)
+            chips = []
+    if len(chips) >= 2:
+        return MeshSpec(
+            shape=near_square_factor(len(chips)),
+            chips=tuple(chips),
+            source=SOURCE_SYSFS,
+        )
+    return MeshSpec(shape=(1, 1), chips=tuple(chips[:1]), source=SOURCE_DEGRADED)
+
+
+def mesh_links(mesh: MeshSpec) -> List[MeshLink]:
+    """Enumerate every logical link, per axis: nearest-neighbor edges
+    along each row ("x") and column ("y"), plus the torus wrap edge when
+    the axis is longer than 2 (at size 2 the wrap would duplicate the
+    neighbor edge). A 1×1 mesh has no links; 2×4 has 12 (4+wrap per row
+    × 2 rows along x, 4 columns × 1 along y)."""
+    rows, cols = mesh.rows, mesh.cols
+    chips = mesh.chips
+    if len(chips) < rows * cols or rows * cols < 2:
+        return []
+
+    def chip(r: int, c: int) -> int:
+        return chips[r * cols + c]
+
+    links: List[MeshLink] = []
+    for r in range(rows):
+        for c in range(cols - 1):
+            links.append(MeshLink(chip(r, c), chip(r, c + 1), "x"))
+        if cols > 2:
+            links.append(MeshLink(chip(r, cols - 1), chip(r, 0), "x"))
+    for c in range(cols):
+        for r in range(rows - 1):
+            links.append(MeshLink(chip(r, c), chip(r + 1, c), "y"))
+        if rows > 2:
+            links.append(MeshLink(chip(rows - 1, c), chip(0, c), "y"))
+    return links
+
+
+def link_ports(link: MeshLink) -> Tuple[Tuple[int, int], Tuple[int, int]]:
+    """The two physical ports a logical link rides: ``((src_chip,
+    src_port), (dst_chip, dst_port))`` under the port convention in the
+    module docstring."""
+    axis_idx = AXIS_NAMES.index(link.axis)
+    return (
+        (link.src_chip, 2 * axis_idx + 1),
+        (link.dst_chip, 2 * axis_idx),
+    )
+
+
+def link_port_state(
+    link: MeshLink, port_up: Dict[Tuple[int, int], bool]
+) -> Optional[bool]:
+    """Fold the two endpoint ports into one link verdict: ``False`` when
+    either reports down, ``True`` when at least one reports up and none
+    down, ``None`` when neither port is in the inventory (derived
+    topology without per-port state — callers treat that as up)."""
+    (src, sp), (dst, dp) = link_ports(link)
+    a = port_up.get((src, sp))
+    b = port_up.get((dst, dp))
+    if a is False or b is False:
+        return False
+    if a is None and b is None:
+        return None
+    return True

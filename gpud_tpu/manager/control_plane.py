@@ -642,6 +642,23 @@ class ControlPlane:
         )
         return web.json_response(data)
 
+    async def _fleet_fabric_route(self, request):  # noqa: ANN001
+        """Fleet-wide ICI fabric matrix rollup: per-agent link aggregates
+        from journaled ``ici_link`` sweep records — "which links degraded
+        since ts" across every agent (``?since=``)."""
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        try:
+            since = self._q_num(request, "since", 0.0, float)
+        except ValueError:
+            return web.Response(status=400, text="since must be a number")
+        data = await asyncio.get_event_loop().run_in_executor(
+            self._op_pool, lambda: self.rollup.fleet_fabric(since)
+        )
+        return web.json_response(data)
+
     async def _fleet_agents_route(self, request):  # noqa: ANN001
         """One page of per-agent rollups (``?offset=&limit=``)."""
         from aiohttp import web
@@ -746,6 +763,7 @@ class ControlPlane:
         )
         app.router.add_post("/v1/drain", self._drain_route)
         app.router.add_get("/v1/fleet/rollup", self._fleet_rollup_route)
+        app.router.add_get("/v1/fleet/fabric", self._fleet_fabric_route)
         app.router.add_get("/v1/fleet/agents", self._fleet_agents_route)
         app.router.add_get(
             "/v1/fleet/agents/{agent_id}/history", self._fleet_history_route

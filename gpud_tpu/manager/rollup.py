@@ -214,12 +214,86 @@ class _SeriesRollup:
         }
 
 
+# worst-state ordering for ICI link aggregates (fabric plane states)
+_LINK_STATE_RANK = {"up": 0, "": 0, "degraded": 1, "down": 2}
+
+# per-agent cap on distinct link aggregates — a garbled agent shipping
+# unbounded link names degrades to truncation accounting, not OOM
+MAX_LINKS_PER_AGENT = 1024
+
+
+class _LinkRollup:
+    """Per-(agent, ici link) aggregate over shipped fabric sweep records."""
+
+    __slots__ = (
+        "src_chip", "dst_chip", "axis", "last_state", "worst_state",
+        "records", "deviations", "downs", "last_ts", "last_degraded_ts",
+        "max_deviation",
+    )
+
+    def __init__(self) -> None:
+        self.src_chip = -1
+        self.dst_chip = -1
+        self.axis = ""
+        self.last_state = ""
+        self.worst_state = ""
+        self.records = 0
+        self.deviations = 0       # records that arrived flagged degraded
+        self.downs = 0            # records that arrived hard-down
+        self.last_ts = 0.0
+        self.last_degraded_ts = 0.0  # newest not-up record ts
+        self.max_deviation = 0.0
+
+    def apply(self, body: Dict, ts: float) -> None:
+        state = str(body.get("state", "") or "")
+        self.src_chip = int(body.get("src_chip", self.src_chip) or -1)
+        self.dst_chip = int(body.get("dst_chip", self.dst_chip) or -1)
+        self.axis = str(body.get("axis", self.axis) or "")
+        when = float(body.get("ts", ts) or ts)
+        self.records += 1
+        self.last_state = state
+        if _LINK_STATE_RANK.get(state, 0) > _LINK_STATE_RANK.get(
+            self.worst_state, 0
+        ):
+            self.worst_state = state
+        if state == "degraded":
+            self.deviations += 1
+        elif state == "down":
+            self.downs += 1
+        if state in ("degraded", "down") and when > self.last_degraded_ts:
+            self.last_degraded_ts = when
+        if when > self.last_ts:
+            self.last_ts = when
+        try:
+            dev = float(body.get("deviation", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            dev = 0.0
+        if dev > self.max_deviation:
+            self.max_deviation = dev
+
+    def snapshot(self) -> Dict:
+        return {
+            "src_chip": self.src_chip,
+            "dst_chip": self.dst_chip,
+            "axis": self.axis,
+            "state": self.last_state,
+            "worst_state": self.worst_state,
+            "records": self.records,
+            "deviations": self.deviations,
+            "downs": self.downs,
+            "last_ts": self.last_ts,
+            "last_degraded_ts": self.last_degraded_ts,
+            "max_deviation": self.max_deviation,
+        }
+
+
 class _AgentRollup:
     """Per-agent aggregate over everything that agent's outbox shipped."""
 
     __slots__ = (
         "records_by_kind", "last_seq", "last_ts", "last_ingest",
         "outbox_lag_seconds", "remediation_outcomes", "series",
+        "links", "links_truncated",
     )
 
     def __init__(self) -> None:
@@ -230,6 +304,8 @@ class _AgentRollup:
         self.outbox_lag_seconds = 0.0
         self.remediation_outcomes: _Counter = _Counter()
         self.series: Dict[str, _SeriesRollup] = {}
+        self.links: Dict[str, _LinkRollup] = {}
+        self.links_truncated = 0
 
 
 class FleetRollupStore:
@@ -558,6 +634,17 @@ class FleetRollupStore:
             )
         elif kind == "remediation_audit":
             ar.remediation_outcomes[str(body.get("outcome", "") or "unknown")] += 1
+        elif kind == "ici_link":
+            link = str(body.get("link", "") or "")
+            if not link:
+                return
+            lr = ar.links.get(link)
+            if lr is None:
+                if len(ar.links) >= MAX_LINKS_PER_AGENT:
+                    ar.links_truncated += 1
+                    return
+                lr = ar.links[link] = _LinkRollup()
+            lr.apply(body, ts)
 
     def _update_gauges(self) -> None:
         # per-shard counters are plain ints; summing without the shard
@@ -693,6 +780,64 @@ class FleetRollupStore:
             "remediation_outcomes": dict(sorted(remediation.items())),
             "flapping": flapping[:32],
             "max_outbox_lag_seconds": max_lag,
+        }
+
+    def fleet_fabric(self, since: float = 0.0) -> Dict:
+        """Fleet-wide ICI link matrix rollup (``GET /v1/fleet/fabric``):
+        per-agent link aggregates from journaled ``ici_link`` fabric
+        sweep records, answering "which links degraded since ts" across
+        the whole fleet from one query."""
+        since = float(since)
+        return self._cached(
+            ("fabric", since),
+            lambda: self._compute_fleet_fabric(since),
+            sql=False,
+        )
+
+    def _compute_fleet_fabric(self, since: float) -> Dict:
+        with self._meta:
+            gen = self._generation
+        agents_with_links = 0
+        links_total = 0
+        truncated = 0
+        by_state: _Counter = _Counter()
+        degraded: List[Dict] = []
+        for shard in self._shards:
+            with shard.lock:
+                for aid, ar in shard.agents.items():
+                    if not ar.links:
+                        continue
+                    agents_with_links += 1
+                    links_total += len(ar.links)
+                    truncated += ar.links_truncated
+                    for name, lr in ar.links.items():
+                        by_state[lr.last_state or "unknown"] += 1
+                        if (
+                            lr.last_state in ("degraded", "down")
+                            or (lr.last_degraded_ts > 0
+                                and lr.last_degraded_ts >= since)
+                        ):
+                            row = lr.snapshot()
+                            row["agent"] = aid
+                            row["link"] = name
+                            degraded.append(row)
+        degraded.sort(
+            key=lambda r: (
+                -_LINK_STATE_RANK.get(r["state"], 0),
+                -r["last_degraded_ts"],
+                r["agent"],
+                r["link"],
+            )
+        )
+        return {
+            "generation": gen,
+            "since": since,
+            "agents": agents_with_links,
+            "links_total": links_total,
+            "links_by_state": dict(sorted(by_state.items())),
+            "degraded_count": len(degraded),
+            "degraded": degraded[:256],
+            "links_truncated": truncated,
         }
 
     def agents_page(self, offset: int = 0, limit: int = 50) -> Dict:

@@ -176,6 +176,9 @@ class PredictEngine:
         # optional score publisher (the server wires the session outbox
         # here); must never fail the tick
         self.on_publish = None
+        # optional fabric plane (gpud_tpu/fabric): when attached, the ICI
+        # component's feature set gains the neighbor co-occurrence signal
+        self.fabric = None
         self._mu = threading.Lock()
         self._st: Dict[str, _CompState] = {}
         self._ticks = 0
@@ -281,6 +284,12 @@ class PredictEngine:
         features = {
             "latency": lat, "cadence": cad, "trajectory": traj, "ngram": ng,
         }
+        fab = self.fabric
+        if fab is not None and name == getattr(fab, "component_name", None):
+            try:
+                features["fabric"] = fab.cooccurrence_score()
+            except Exception:  # noqa: BLE001 — fabric must not fail the tick
+                features["fabric"] = 0.0
         score = fuse(features)
         st.score = score
         st.features = features

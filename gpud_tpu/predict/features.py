@@ -30,12 +30,18 @@ WEIGHT_LATENCY = 0.5
 WEIGHT_CADENCE = 0.7
 WEIGHT_TRAJECTORY = 0.75
 WEIGHT_NGRAM = 0.6
+# fabric neighbor co-occurrence: correlated latency deviations on
+# adjacent ICI links (gpud_tpu/fabric). Capped below the warning
+# threshold for the same no-single-signal reason as latency drift — one
+# deviating link pair corroborates, it doesn't convict.
+WEIGHT_FABRIC = 0.55
 
 FEATURE_WEIGHTS: Dict[str, float] = {
     "latency": WEIGHT_LATENCY,
     "cadence": WEIGHT_CADENCE,
     "trajectory": WEIGHT_TRAJECTORY,
     "ngram": WEIGHT_NGRAM,
+    "fabric": WEIGHT_FABRIC,
 }
 
 
@@ -57,6 +63,26 @@ def fuse(features: Dict[str, float]) -> float:
         w = FEATURE_WEIGHTS.get(name, 0.5)
         acc *= 1.0 - w * clamp01(s)
     return clamp01(1.0 - acc)
+
+
+def neighbor_cooccurrence(
+    deviations: Dict[str, float], adjacency: Dict[str, Iterable[str]]
+) -> float:
+    """Co-occurrence evidence over a link graph: the strongest *pair* of
+    adjacent deviations, scored by the weaker member (min), so one noisy
+    link scores nothing but two neighbors deviating together — the
+    correlated-precursor pattern "When GPUs Fail Quietly" reports for
+    NVLink — scores as high as the weaker of the two. Inputs are [0, 1]
+    per-link deviation scores; output is [0, 1]."""
+    best = 0.0
+    for name, score in deviations.items():
+        if score <= best:
+            continue
+        for peer in adjacency.get(name, ()):
+            pair = min(score, deviations.get(peer, 0.0))
+            if pair > best:
+                best = pair
+    return clamp01(best)
 
 
 class Ewma:

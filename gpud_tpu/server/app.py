@@ -339,6 +339,27 @@ def build_app(srv: "Server") -> web.Application:
         out["status"] = eng.status()
         return _json(out)
 
+    async def fabric_matrix(req: web.Request) -> web.Response:
+        """Fabric observability (docs/fabric.md): discovered mesh, sweep
+        status, and the current per-link (src_chip, dst_chip, axis,
+        latency, state) matrix. ?link=, ?since=, or ?limit= appends
+        matrix history rows from the durable store (newest first)."""
+        plane = getattr(srv, "fabric", None)
+        if plane is None:
+            return _json({"error": "fabric plane disabled"}, 404)
+        link = req.query.get("link", "")
+        since = _qfloat(req, "since", 0.0)
+        limit = int(_qfloat(req, "limit", 0.0))
+        out = {"status": plane.status(), "matrix": plane.matrix()}
+        if link or since > 0 or limit > 0:
+            out["history"] = await _run_blocking(
+                srv,
+                lambda: plane.history(
+                    link=link, since=since, limit=limit if limit > 0 else 256
+                ),
+            )
+        return _json(out)
+
     async def remediation_policy_get(_req: web.Request) -> web.Response:
         """Current remediation policy and guard state (allowlist,
         cooldown, rate limit, reboot-window, escalation)."""
@@ -619,6 +640,7 @@ def build_app(srv: "Server") -> web.Application:
     r.add_get("/v1/states", states)
     r.add_get("/v1/states/history", states_history)
     r.add_get("/v1/predict/scores", predict_scores)
+    r.add_get("/v1/fabric", fabric_matrix)
     r.add_get("/v1/remediation/audit", remediation_audit)
     r.add_get("/v1/remediation/policy", remediation_policy_get)
     r.add_post("/v1/remediation/policy", remediation_policy_post)

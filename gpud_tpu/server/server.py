@@ -207,6 +207,31 @@ class Server:
                 ),
             )
 
+        # fabric observability plane (docs/fabric.md): logical mesh
+        # discovery + the all-links sweep with per-link EWMA baselines;
+        # constructed before the outbox so the ici_link producer below
+        # can hook it
+        self.fabric = None
+        if self.config.fabric_sweep_enabled:
+            from gpud_tpu.fabric import FabricPlane
+
+            self.fabric = FabricPlane(
+                self.db_rw,
+                tpu=self.tpu_instance,
+                writer=self.storage_writer,
+                interval_seconds=float(
+                    self.config.fabric_sweep_interval_seconds
+                ),
+                latency_threshold_z=float(
+                    self.config.fabric_sweep_latency_threshold_z
+                ),
+                ewma_alpha=float(self.config.fabric_sweep_ewma_alpha),
+                warmup_sweeps=int(self.config.fabric_sweep_warmup_sweeps),
+                retention_seconds=float(
+                    self.config.fabric_sweep_retention_seconds
+                ),
+            )
+
         # durable session outbox + control-plane circuit breaker
         # (docs/session.md): producers journal here; a replay job drains
         # everything above the manager-acked watermark into the session
@@ -288,6 +313,10 @@ class Server:
             self.remediation.executors.registry = self.registry
         if self.predictor is not None:
             self.predictor.registry = self.registry
+            # fabric deviations corroborate the ICI component's precursor
+            # score (neighbor co-occurrence feature; docs/fabric.md)
+            if self.fabric is not None:
+                self.predictor.fabric = self.fabric
 
         # shared kmsg watcher: one reader feeding every kmsg-consuming
         # component (reference hot-loop #2, SURVEY §3.1)
@@ -467,6 +496,13 @@ class Server:
                 ),
             )
 
+        def on_ici_link(body: dict) -> None:
+            outbox.publish(
+                "ici_link",
+                body,
+                dedupe_key=f"ici_link:{body.get('link')}:{body.get('ts')}",
+            )
+
         self.event_store.on_insert = on_event
         self.health_ledger.on_transition = on_transition
         if self.remediation is not None:
@@ -475,6 +511,8 @@ class Server:
             self.chaos.on_result = on_chaos_result
         if self.predictor is not None:
             self.predictor.on_publish = on_predict
+        if self.fabric is not None:
+            self.fabric.on_publish = on_ici_link
 
     def _outbox_replay_tick(self) -> int:
         """Scheduler job "session-outbox-replay": drain one batch of
@@ -545,6 +583,10 @@ class Server:
                 self._retention_targets.append(
                     ("session-outbox", self.outbox.purge_once)
                 )
+            if self.fabric is not None:
+                self._retention_targets.append(
+                    ("fabric-matrix", self.fabric.purge_once)
+                )
             retention_interval = max(
                 60.0, self.config.events_retention_seconds / 5.0
             )
@@ -588,6 +630,8 @@ class Server:
                 self.remediation.start(self.scheduler)
             if self.predictor is not None:
                 self.predictor.start(self.scheduler)
+            if self.fabric is not None:
+                self.fabric.start(self.scheduler)
             self.metrics_syncer.start(self.scheduler)
             self.self_metrics.start(self.scheduler)
             self.package_manager.start()
@@ -695,6 +739,8 @@ class Server:
             self.remediation.close()
         if self.predictor is not None:
             self.predictor.close()
+        if self.fabric is not None:
+            self.fabric.close()
         if self.chaos is not None:
             # aborts any in-flight campaign's sleeps before the pool the
             # campaign runs on is drained

@@ -186,6 +186,24 @@ class Dispatcher:
         out["status"] = eng.status()
         return out
 
+    def _m_fabricStatus(self, req: Dict) -> Dict:
+        """Fabric plane rollup for the control plane: discovered mesh +
+        sweep state + the current per-link matrix (``link``/``since``/
+        ``limit`` append matrix history) — the session twin of
+        ``GET /v1/fabric``."""
+        plane = getattr(self.server, "fabric", None)
+        if plane is None:
+            return {"error": "fabric plane disabled"}
+        link = str(req.get("link", "") or "")
+        since = float(req.get("since", 0.0))
+        limit = int(req.get("limit", 0))
+        out = {"status": plane.status(), "matrix": plane.matrix()}
+        if link or since > 0 or limit > 0:
+            out["history"] = plane.history(
+                link=link, since=since, limit=limit if limit > 0 else 256
+            )
+        return out
+
     def _m_remediationStatus(self, req: Dict) -> Dict:
         """Remediation engine rollup for the control plane: policy + guard
         state plus the most recent audit rows (``limit``, ``since``,

@@ -53,6 +53,7 @@ from typing import Dict, FrozenSet, List, Optional, Tuple
 # state — keep in sync when a new threaded subsystem appears
 GUARD_MODULES = (
     "gpud_tpu/chaos/runner.py",
+    "gpud_tpu/fabric/plane.py",
     "gpud_tpu/health_history.py",
     "gpud_tpu/manager/rollup.py",
     "gpud_tpu/manager/shard.py",

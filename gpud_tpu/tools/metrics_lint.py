@@ -44,6 +44,7 @@ _METRIC_MODULES = (
     "gpud_tpu.components.all",
     "gpud_tpu.components.base",
     "gpud_tpu.eventstore",
+    "gpud_tpu.fabric.plane",
     "gpud_tpu.health_history",
     "gpud_tpu.manager.exposition",
     "gpud_tpu.manager.rollup",

@@ -105,6 +105,12 @@ MATRIX = [
     ("predictStatus", {"history": "lots"}, "error"),
     ("predictStatus", {"history": 4}, "ok"),
     ("predictStatus", {"component": "no-such-component"}, "ok"),
+    # fabric: bad numeric filter types error; an unknown link just
+    # returns empty history alongside the live matrix
+    ("fabricStatus", {}, "ok"),
+    ("fabricStatus", {"since": "yesterday"}, "error"),
+    ("fabricStatus", {"limit": "lots"}, "error"),
+    ("fabricStatus", {"link": "no-such-link"}, "ok"),
     ("remediationPolicy", {}, "ok"),
     ("remediationPolicy", {"policy": "not-a-dict"}, "no-crash"),
     ("remediationPolicy", {"policy": {"enforce_actions": ["bogus"]}}, "no-crash"),

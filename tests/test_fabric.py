@@ -1,0 +1,393 @@
+"""Fabric observability plane (gpud_tpu/fabric/, docs/fabric.md): mesh
+discovery ladder, the all-links sweep with per-link EWMA baselines, the
+durable matrix store, the predict-plane co-occurrence feature, and the
+manager-side fleet fabric rollup — all hermetic (mock/sysfs-free paths
+only; the real-tree path is exercised by ``bench.py --fabric``)."""
+
+import os
+
+import pytest
+
+from gpud_tpu.config import default_config
+from gpud_tpu.fabric.mesh import (
+    MeshLink,
+    MeshSpec,
+    SOURCE_DEGRADED,
+    SOURCE_SYSFS,
+    discover_mesh,
+    link_port_state,
+    link_ports,
+    mesh_links,
+    near_square_factor,
+)
+from gpud_tpu.fabric.plane import (
+    STATE_DEGRADED,
+    STATE_DOWN,
+    STATE_UP,
+    FabricPlane,
+)
+from gpud_tpu.fabric.store import FabricMatrixStore
+from gpud_tpu.predict.features import neighbor_cooccurrence
+from gpud_tpu.sqlite import DB
+from gpud_tpu.tpu.instance import LinkState, MockBackend
+
+
+@pytest.fixture()
+def db(tmp_path):
+    d = DB(str(tmp_path / "fabric.db"))
+    yield d
+    d.close()
+
+
+# -- mesh model -------------------------------------------------------------
+
+
+def test_near_square_factorization():
+    assert near_square_factor(1) == (1, 1)
+    assert near_square_factor(4) == (2, 2)
+    assert near_square_factor(8) == (2, 4)
+    assert near_square_factor(12) == (3, 4)
+    assert near_square_factor(16) == (4, 4)
+    # primes degrade to a 1xN ring, never crash
+    assert near_square_factor(7) == (1, 7)
+
+
+def test_mesh_links_2x4_torus():
+    mesh = MeshSpec(shape=(2, 4), chips=tuple(range(8)), source=SOURCE_SYSFS)
+    names = {ln.name for ln in mesh_links(mesh)}
+    assert names == {
+        # x rings (4 > 2: wrap links close each row)
+        "c0-c1/x", "c1-c2/x", "c2-c3/x", "c3-c0/x",
+        "c4-c5/x", "c5-c6/x", "c6-c7/x", "c7-c4/x",
+        # y axis of size 2: neighbor edges only, no wrap duplicate
+        "c0-c4/y", "c1-c5/y", "c2-c6/y", "c3-c7/y",
+    }
+
+
+def test_mesh_links_no_wrap_on_axis_of_two():
+    mesh = MeshSpec(shape=(2, 2), chips=(0, 1, 2, 3), source=SOURCE_SYSFS)
+    names = {ln.name for ln in mesh_links(mesh)}
+    assert names == {"c0-c1/x", "c2-c3/x", "c0-c2/y", "c1-c3/y"}
+
+
+def test_mesh_links_empty_on_partial_inventory():
+    # fewer chips than the shape claims: refuse to fabricate links
+    mesh = MeshSpec(shape=(2, 2), chips=(0, 1), source=SOURCE_SYSFS)
+    assert mesh_links(mesh) == []
+
+
+def test_link_ports_and_port_state_fold():
+    link = MeshLink(src_chip=0, dst_chip=1, axis="x")
+    assert link_ports(link) == ((0, 1), (1, 0))  # src x-plus, dst x-minus
+    assert link_port_state(link, {}) is None  # ports absent: unknown
+    assert link_port_state(link, {(0, 1): True, (1, 0): True}) is True
+    # either endpoint down downs the logical link
+    assert link_port_state(link, {(0, 1): False, (1, 0): True}) is False
+    assert link_port_state(link, {(0, 1): True, (1, 0): False}) is False
+
+
+def test_discover_mesh_from_mock_inventory():
+    mesh = discover_mesh(MockBackend())  # v5e-8: 8 chips
+    assert mesh.shape == (2, 4)
+    assert mesh.source == SOURCE_SYSFS
+    assert len(mesh_links(mesh)) == 12
+
+
+def test_discover_mesh_degrades_without_hardware():
+    mesh = discover_mesh(None)
+    assert mesh.shape == (1, 1)
+    assert mesh.source == SOURCE_DEGRADED
+    assert mesh_links(mesh) == []
+
+
+# -- durable matrix store ---------------------------------------------------
+
+
+def test_store_roundtrip_history_and_purge(db):
+    st = FabricMatrixStore(db)
+    rows = [
+        {"link": "c0-c1/x", "src_chip": 0, "dst_chip": 1, "axis": "x",
+         "state": "up", "latency_seconds": 1e-4, "deviation": 0.0},
+        {"link": "c1-c2/x", "src_chip": 1, "dst_chip": 2, "axis": "x",
+         "state": "degraded", "latency_seconds": 2e-3, "deviation": 9.0},
+    ]
+    st.insert_sweep(rows, ts=100.0)
+    st.insert_sweep(rows, ts=200.0)
+    assert st.row_count() == 4
+    hist = st.history(link="c1-c2/x")
+    assert [h["ts"] for h in hist] == [200.0, 100.0]  # newest first
+    assert hist[0]["state"] == "degraded"
+    assert st.history(since=150.0, limit=1)[0]["ts"] == 200.0
+    assert st.purge(before=150.0) == 2
+    assert st.row_count() == 2
+
+
+# -- sweep plane ------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+@pytest.fixture()
+def plane(db):
+    clock = _Clock()
+    p = FabricPlane(
+        db,
+        tpu=MockBackend(),
+        warmup_sweeps=2,
+        latency_threshold_z=4.0,
+        time_now_fn=clock,
+    )
+    p.published = []
+    p.on_publish = p.published.append
+    p.clock = clock
+    yield p
+    p.close()
+
+
+def _sweep(plane, n=1):
+    for _ in range(n):
+        plane.clock.t += 1.0
+        plane.sweep_once()
+
+
+def test_sweep_baseline_all_up_publishes_nothing(plane):
+    _sweep(plane, 4)
+    matrix = plane.matrix()
+    assert len(matrix) == 12
+    assert all(r["state"] == STATE_UP for r in matrix)
+    assert all(r["ts"] > 0 for r in matrix)
+    assert plane.published == []
+    st = plane.status()
+    assert st["sweeps"] == 4 and st["degraded"] == [] and st["down"] == []
+
+
+def test_latency_deviation_flags_exactly_that_link(plane):
+    _sweep(plane, 4)  # past warmup, baselines settled
+    base = plane.synthetic_latency
+    plane.telemetry_fn = (
+        lambda ln: 100 * base(ln) if ln.name == "c0-c1/x" else base(ln)
+    )
+    _sweep(plane)
+    states = {r["link"]: r["state"] for r in plane.matrix()}
+    assert states.pop("c0-c1/x") == STATE_DEGRADED
+    assert set(states.values()) == {STATE_UP}
+    # the deviating sample is flagged, not absorbed into the baseline —
+    # a persistent shift stays flagged
+    _sweep(plane, 3)
+    assert plane.status()["degraded"] == ["c0-c1/x"]
+    # publishes: one not-up record per sweep while degraded
+    assert {p["link"] for p in plane.published} == {"c0-c1/x"}
+    assert all(p["state"] == STATE_DEGRADED for p in plane.published)
+    # score for the predict plane: positive, 1.0-capped, link-addressed
+    scores = plane.deviation_scores()
+    assert scores["c0-c1/x"] > 0.5
+    assert scores["c1-c2/x"] == 0.0
+
+
+def test_port_down_downs_the_logical_link_and_recovery_publishes(plane):
+    import dataclasses
+
+    _sweep(plane, 3)
+    base_fn = plane.default_links
+
+    def one_down():
+        return [
+            dataclasses.replace(s, state=LinkState.DOWN)
+            if s.name == "chip5/ici1" else s
+            for s in base_fn()
+        ]
+
+    plane.links_fn = one_down
+    _sweep(plane)
+    states = {r["link"]: r["state"] for r in plane.matrix()}
+    assert states.pop("c5-c6/x") == STATE_DOWN
+    assert set(states.values()) == {STATE_UP}
+    assert plane.deviation_scores()["c5-c6/x"] == 1.0
+    # recovery is a state change — it must publish (fleet pane clears)
+    plane.links_fn = None
+    plane.published.clear()
+    _sweep(plane)
+    assert [p["state"] for p in plane.published] == [STATE_UP]
+    assert plane.published[0]["link"] == "c5-c6/x"
+
+
+def test_sweep_rows_land_in_durable_store(plane):
+    _sweep(plane, 2)
+    hist = plane.history(link="c0-c1/x")
+    assert len(hist) == 2
+    assert hist[0]["ts"] > hist[1]["ts"]
+
+
+def test_cooccurrence_needs_correlated_neighbors(plane):
+    _sweep(plane, 4)
+    base = plane.synthetic_latency
+    # one isolated hot link: no neighbor corroboration, score 0
+    plane.telemetry_fn = (
+        lambda ln: 100 * base(ln) if ln.name == "c0-c1/x" else base(ln)
+    )
+    _sweep(plane)
+    assert plane.cooccurrence_score() == 0.0
+    # two links sharing chip 1 hot together: co-occurrence fires
+    plane.telemetry_fn = (
+        lambda ln: 100 * base(ln)
+        if ln.name in ("c0-c1/x", "c1-c2/x") else base(ln)
+    )
+    _sweep(plane)
+    assert plane.cooccurrence_score() > 0.4
+
+
+def test_neighbor_cooccurrence_feature():
+    adj = {"a": ["b"], "b": ["a", "c"], "c": ["b"]}
+    assert neighbor_cooccurrence({}, adj) == 0.0
+    assert neighbor_cooccurrence({"a": 0.9, "b": 0.0, "c": 0.0}, adj) == 0.0
+    assert neighbor_cooccurrence({"a": 0.9, "b": 0.7, "c": 0.0}, adj) == 0.7
+    # clamped to [0, 1] even on hostile scores
+    assert neighbor_cooccurrence({"a": 5.0, "b": 7.0}, {"a": ["b"], "b": ["a"]}) == 1.0
+
+
+def test_metric_cardinality_cap_counts_truncation(db):
+    p = FabricPlane(db, tpu=MockBackend(), metric_links_max=5)
+    try:
+        p.sweep_once()
+        from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
+
+        vals = {}
+        for m in DEFAULT_REGISTRY.all_metrics():
+            if m.name == "tpud_fabric_metric_links_truncated":
+                vals = dict(m.labels_values())
+        assert list(vals.values()) == [7.0]  # 12 links - 5 exported
+    finally:
+        p.close()
+
+
+# -- config knobs -----------------------------------------------------------
+
+
+def test_fabric_config_knob_validation(tmp_path):
+    cfg = default_config(data_dir=str(tmp_path))
+    assert cfg.validate() is None
+    for knob, bad in (
+        ("fabric_sweep_interval_seconds", 0),
+        ("fabric_sweep_latency_threshold_z", -1.0),
+        ("fabric_sweep_ewma_alpha", 1.5),
+        ("fabric_sweep_warmup_sweeps", 0),
+        ("fabric_sweep_retention_seconds", 10),
+    ):
+        c = default_config(data_dir=str(tmp_path))
+        setattr(c, knob, bad)
+        err = c.validate()
+        assert err and "fabric" in err, (knob, err)
+
+
+# -- manager-side fleet fabric rollup --------------------------------------
+
+
+def _ici_rec(seq, ts, link, state, agent_suffix=""):
+    body = {
+        "link": link, "src_chip": 0, "dst_chip": 1, "axis": "x",
+        "state": state, "latency_seconds": 2e-3, "deviation": 5.0, "ts": ts,
+    }
+    return (seq, ts, "ici_link", f"ici_link:{agent_suffix}{link}:{ts}", body)
+
+
+def test_rollup_ingests_ici_link_and_answers_since(db):
+    from gpud_tpu.manager.rollup import FleetRollupStore
+
+    st = FleetRollupStore(db, None)
+    st.ingest("agent-a", [
+        _ici_rec(1, 100.0, "c0-c1/x", STATE_DEGRADED),
+        _ici_rec(2, 110.0, "c0-c1/x", STATE_UP),       # recovered
+        _ici_rec(3, 120.0, "c2-c3/x", STATE_DOWN),     # still down
+    ])
+    st.ingest("agent-b", [_ici_rec(1, 130.0, "c0-c1/x", STATE_DOWN)])
+    pane = st.fleet_fabric(since=0.0)
+    assert pane["agents"] == 2
+    assert pane["links_total"] == 3
+    # still-down links always show; the recovered link shows because it
+    # degraded after `since`
+    blamed = {(d["agent"], d["link"]) for d in pane["degraded"]}
+    assert blamed == {
+        ("agent-a", "c0-c1/x"), ("agent-a", "c2-c3/x"), ("agent-b", "c0-c1/x"),
+    }
+    # down outranks degraded-history in the ordering
+    assert pane["degraded"][0]["state"] == STATE_DOWN
+    # a later `since` drops the recovered link but keeps the down ones
+    pane = st.fleet_fabric(since=115.0)
+    blamed = {(d["agent"], d["link"]) for d in pane["degraded"]}
+    assert blamed == {("agent-a", "c2-c3/x"), ("agent-b", "c0-c1/x")}
+    # worst-state + deviation aggregates survive per link
+    snap = st.agent_snapshot("agent-a")
+    assert snap["records_by_kind"]["ici_link"] == 3
+
+
+def test_rollup_dedupes_ici_link_redelivery(db):
+    from gpud_tpu.manager.rollup import FleetRollupStore
+
+    st = FleetRollupStore(db, None)
+    rec = _ici_rec(1, 100.0, "c0-c1/x", STATE_DOWN)
+    st.ingest("agent-a", [rec])
+    st.ingest("agent-a", [rec])  # redelivery across a reconnect
+    assert st.records_total() == 1
+    pane = st.fleet_fabric()
+    assert pane["degraded"][0]["records"] == 1
+
+
+def test_rollup_ici_link_survives_journal_replay(db):
+    from gpud_tpu.manager.rollup import FleetRollupStore
+
+    st = FleetRollupStore(db, None)
+    st.ingest("agent-a", [_ici_rec(1, 100.0, "c0-c1/x", STATE_DOWN)])
+    before = st.fleet_fabric()
+    # manager restart: a fresh store rebuilt from the same journal must
+    # serve the identical fleet pane
+    st2 = FleetRollupStore(db, None)
+    after = st2.fleet_fabric()
+    assert after["links_total"] == before["links_total"] == 1
+    assert after["degraded"][0]["link"] == "c0-c1/x"
+    assert after["degraded"][0]["state"] == STATE_DOWN
+
+
+def test_rollup_ignores_empty_link_and_caps_cardinality(db):
+    from gpud_tpu.manager.rollup import MAX_LINKS_PER_AGENT, FleetRollupStore
+
+    st = FleetRollupStore(db, None)
+    st.ingest("agent-a", [(1, 100.0, "ici_link", "ici_link::100",
+                           {"link": "", "state": "down"})])
+    assert st.fleet_fabric()["links_total"] == 0
+    assert MAX_LINKS_PER_AGENT >= 1024
+
+
+# -- live daemon surface ----------------------------------------------------
+
+
+def test_live_server_fabric_status_matrix(live_server):
+    plane = live_server.fabric
+    assert plane is not None
+    plane.sweep_once()
+    st = plane.status()
+    # conftest pins the mock backend: 8 chips -> 2x4 mesh, 12 links
+    assert tuple(st["mesh"]["shape"]) == (2, 4)
+    assert st["links"] == 12
+    assert {r["link"] for r in plane.matrix()} >= {"c0-c1/x", "c3-c7/y"}
+
+
+def test_dispatch_fabric_status_history(live_server):
+    from gpud_tpu.session.dispatch import Dispatcher
+
+    live_server.fabric.sweep_once()
+    d = Dispatcher(live_server)
+    resp = d({"method": "fabricStatus"})
+    assert not resp.get("error")
+    assert resp["status"]["links"] == 12
+    assert len(resp["matrix"]) == 12
+    assert "history" not in resp
+    resp = d({"method": "fabricStatus", "link": "c0-c1/x", "limit": 4})
+    assert not resp.get("error")
+    assert resp["history"], "history filter must read the durable store"
+    assert all(h["link"] == "c0-c1/x" for h in resp["history"])

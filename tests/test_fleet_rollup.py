@@ -472,6 +472,56 @@ def test_http_traces_correlation_end_to_end(fleet_cp):
     assert r.status_code == 400  # correlation_id is required
 
 
+def test_http_fleet_fabric_across_agents():
+    """One ``GET /v1/fleet/fabric?since=`` answers "which links degraded
+    since t" across every enrolled agent — ici_link records from two
+    agents journal into per-agent link aggregates served by one query."""
+    requests = pytest.importorskip("requests")
+    from gpud_tpu.manager.control_plane import AgentHandle, ControlPlane
+    from gpud_tpu.session import wire
+
+    cp = ControlPlane()
+    cp.start()
+    try:
+        t = time.time()
+        for aid, (link, state) in (
+            ("fabric-m1", ("c0-c1/x", "degraded")),
+            ("fabric-m2", ("c0-c1/x", "down")),
+        ):
+            handle = AgentHandle(aid, "v1")
+            cp._register(handle)
+            enc = wire.DeltaEncoder()
+            body = {
+                "link": link, "src_chip": 0, "dst_chip": 1, "axis": "x",
+                "state": state, "latency_seconds": 0.002,
+                "deviation": 6.5, "ts": t + 1,
+            }
+            rec = enc.encode_record(
+                1, t + 1, "ici_link", f"ici_link:{link}:{t + 1}", body,
+            )
+            handle.resolve("outbox-1", wire.build_batch([rec]))
+        assert cp.ingest_executor.flush(timeout=10)
+        r = requests.get(
+            f"{cp.endpoint}/v1/fleet/fabric",
+            params={"since": t}, timeout=10,
+        )
+        assert r.status_code == 200
+        pane = r.json()
+        assert pane["agents"] == 2
+        assert pane["links_total"] == 2
+        blamed = {(d["agent"], d["state"]) for d in pane["degraded"]
+                  if d["link"] == "c0-c1/x"}
+        assert blamed == {("fabric-m1", "degraded"), ("fabric-m2", "down")}
+        # down outranks degraded in the pane's ordering
+        assert pane["degraded"][0]["agent"] == "fabric-m2"
+        r = requests.get(
+            f"{cp.endpoint}/v1/fleet/fabric?since=zap", timeout=10
+        )
+        assert r.status_code == 400
+    finally:
+        cp.stop()
+
+
 def test_manager_schedules_journal_purge(fleet_cp):
     """max_journal_rows is only a bound if something calls purge():
     the manager must own a periodic purge job."""

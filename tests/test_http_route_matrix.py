@@ -44,6 +44,7 @@ ROUTES_GET = [
     "/machine-info", "/admin/config", "/admin/packages",
     "/v1/components/trigger-check?componentName=cpu",
     "/v1/predict/scores", "/v1/predict/scores?component=cpu&history=4",
+    "/v1/fabric", "/v1/fabric?link=c0-c1/x&limit=4",
     "/v1/states/history", "/v1/remediation/audit", "/v1/remediation/policy",
     "/v1/chaos/campaigns", "/v1/session/status", "/v1/debug/traces",
 ]
@@ -54,6 +55,20 @@ def test_get_routes_answer(base, path):
     status, body = _get(base, path)
     assert status == 200, (path, status, body[:200])
     assert body  # never an empty 200
+
+
+def test_fabric_matrix_shape(base):
+    status, body = _get(base, "/v1/fabric")
+    d = json.loads(body)
+    assert status == 200
+    assert "status" in d and "matrix" in d
+    # any history filter appends the durable-store rows
+    status, body = _get(base, "/v1/fabric?limit=4")
+    assert status == 200
+    assert "history" in json.loads(body)
+    # malformed numeric filters are a client error, not a 500
+    status, _ = _get(base, "/v1/fabric?since=yesterday")
+    assert status == 400
 
 
 def test_admin_config_shape(base):
