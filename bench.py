@@ -2079,6 +2079,396 @@ def bench_fleet_socket(agents: int = FLEET_SOCKET_AGENTS,
     return 0 if ok else 1
 
 
+FLEET_PREDICT_AGENTS = 256
+FLEET_PREDICT_RECORDS_PER_AGENT = 24
+FLEET_PREDICT_FAULTED = 8
+FLEET_PREDICT_CONCURRENCY = 32
+
+
+def bench_fleet_predict(agents: int = FLEET_PREDICT_AGENTS,
+                        records_per_agent: int = FLEET_PREDICT_RECORDS_PER_AGENT,
+                        shards: int = 0) -> int:
+    """``--fleet --predict`` combined mode: the predict→fleet loop end
+    to end. N simulated agents stream ``predict_score`` outbox records
+    through the REAL v2 gRPC Frame tunnel into a live manager: a small
+    faulted cohort publishes a precursor ramp ending in warn + lead
+    records, everyone else publishes benign low-score snapshots, and one
+    agent publishes a deliberately newer-schema record. Gates:
+
+      - zero record loss (journal rows == records sent, every agent
+        fully acked), with the newer-schema record journaled-and-counted
+        rather than dropped;
+      - the ranked pane (``/v1/fleet/predict``) puts EXACTLY the faulted
+        cohort in its top-K by decayed risk, and the fleet lead
+        distribution holds one lead per faulted agent;
+      - cold (under ingest) and cached pane p95 within the existing
+        fleet-socket read gates;
+      - the calibration replay: fitting thresholds on a synthetic
+        benign+precursor ledger history must produce a threshold that
+        warns at least one transition EARLIER than the global default on
+        the precursor ramp, at zero false positives on the benign
+        replay — the learned-threshold contract (docs/predict.md).
+    """
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import shutil
+    import threading
+
+    import grpc
+    import requests
+
+    from gpud_tpu.manager.control_plane import ControlPlane
+    from gpud_tpu.session import wire
+    from gpud_tpu.session.v2 import session_pb2 as pb
+    from gpud_tpu.session.v2.client import METHOD
+
+    tmp = tempfile.mkdtemp(prefix="tpud-fleet-pred-")
+    data_dir = os.path.join(tmp, "manager")
+    concurrency = min(
+        int(os.environ.get("TPUD_BENCH_CONC", str(FLEET_PREDICT_CONCURRENCY))),
+        agents,
+    )
+    cp = ControlPlane(
+        data_dir=data_dir, shards=shards or None,
+        max_v2_agents=concurrency + 16,
+    )
+    cp.start()
+    base = cp.endpoint
+    target = f"127.0.0.1:{cp.grpc_port}"
+    sess = requests.Session()
+
+    faulted_n = min(FLEET_PREDICT_FAULTED, agents)
+    faulted = {f"pred-{i:04d}" for i in range(faulted_n)}
+    comp = "accelerator-tpu-0"
+    t_base = time.time()
+
+    # -- pre-encode outside the measured window (the simulator's encode
+    # loop is not the plane under test)
+    total = 0
+    unknown_sent = 0
+    agent_work = []
+    for i in range(agents):
+        machine_id = f"pred-{i:04d}"
+        is_faulted = machine_id in faulted
+        enc = wire.DeltaEncoder()
+        frames = []
+        recs = []
+        seq = 0
+        for n in range(records_per_agent):
+            ts = t_base + n * 0.01
+            if is_faulted and n == records_per_agent - 2:
+                event, score, armed = "warn", 0.82, True
+            elif is_faulted and n == records_per_agent - 1:
+                event, score, armed = "lead", 0.9, True
+            else:
+                event, armed = "snapshot", False
+                # benign noise floor, faulted cohort ramps toward the bar
+                score = (0.05 + (n % 5) * 0.02 if not is_faulted
+                         else 0.1 + 0.6 * n / records_per_agent)
+            payload = {
+                "schema": 1,
+                "component": comp,
+                "component_class": "accelerator-tpu",
+                "event": event,
+                "ts": ts,
+                "score": round(score, 4),
+                "threshold": 0.6,
+                "features": {"cadence": round(score * 0.7, 4),
+                             "trajectory": round(score * 0.5, 4)},
+                "armed": armed,
+            }
+            if event == "warn":
+                payload["warned_at"] = ts
+            if event == "lead":
+                payload["warned_at"] = ts - 0.01
+                payload["lead_seconds"] = 12.5
+            seq += 1
+            recs.append(enc.encode_record(
+                seq, ts, "predict_score",
+                f"predict:{comp}:{event}:{ts}:{seq}", payload,
+            ))
+            total += 1
+        if i == agents - 1:
+            # one deliberately newer-schema record: the manager must
+            # journal and count it, never drop it (docs/fleet.md)
+            ts = t_base + records_per_agent * 0.01
+            seq += 1
+            recs.append(enc.encode_record(
+                seq, ts, "predict_score", f"predict:future:{ts}",
+                {"schema": 99, "component": "future-comp", "event": "warn",
+                 "ts": ts, "score": 1.0},
+            ))
+            total += 1
+            unknown_sent += 1
+        pkt = pb.AgentPacket()
+        pkt.frame.req_id = "outbox-1"
+        pkt.frame.data = wire.encode_payload(wire.build_batch(recs))
+        frames.append(pkt)
+        agent_work.append((machine_id, frames, seq))
+
+    ingest_done = threading.Event()
+    cold_lat_ms: list = []
+    read_errors: list = []
+
+    def _operator_load() -> None:
+        while not ingest_done.is_set():
+            t = time.monotonic()
+            try:
+                r = sess.get(f"{base}/v1/fleet/predict?top=10", timeout=30)
+                if r.status_code != 200:
+                    read_errors.append(f"/v1/fleet/predict: HTTP {r.status_code}")
+                    return
+            except Exception as e:  # noqa: BLE001
+                read_errors.append(f"/v1/fleet/predict: {e}")
+                return
+            cold_lat_ms.append((time.monotonic() - t) * 1000.0)
+            time.sleep(0.3)
+
+    failures: list = []
+    import queue as _q
+    driven = [0]
+
+    def _drive_agent(stream, machine_id, frames, last_seq) -> None:
+        out_q: "_q.Queue" = _q.Queue()
+        hello = pb.AgentPacket()
+        hello.hello.machine_id = machine_id
+        hello.hello.token = "bench"
+        hello.hello.revision = 1
+        hello.hello.min_revision = 1
+        hello.hello.max_revision = 3
+        out_q.put(hello)
+        for f in frames:
+            out_q.put(f)
+        call = stream(iter(out_q.get, None), timeout=120.0)
+        acked = False
+        for mpkt in call:
+            kind = mpkt.WhichOneof("payload")
+            if kind == "hello_ack":
+                if not mpkt.hello_ack.accepted:
+                    failures.append(f"{machine_id}: {mpkt.hello_ack.reason}")
+                    out_q.put(None)
+                    return
+            elif kind == "frame":
+                try:
+                    data = wire.decode_payload(mpkt.frame.data)
+                except ValueError:
+                    continue
+                if (not acked and isinstance(data, dict)
+                        and data.get("method") == "outboxAck"
+                        and int(data.get("seq", 0)) >= last_seq):
+                    acked = True
+                    out_q.put(None)
+        if acked:
+            driven[0] += 1
+        else:
+            failures.append(f"{machine_id}: stream ended before final ack")
+
+    def _worker(work_slice) -> None:
+        channel = grpc.insecure_channel(target)
+        stream = channel.stream_stream(
+            METHOD,
+            request_serializer=pb.AgentPacket.SerializeToString,
+            response_deserializer=pb.ManagerPacket.FromString,
+        )
+        try:
+            for machine_id, frames, last_seq in work_slice:
+                try:
+                    _drive_agent(stream, machine_id, frames, last_seq)
+                except grpc.RpcError as e:
+                    failures.append(f"{machine_id}: {e.code()}")
+        finally:
+            channel.close()
+
+    slices = [agent_work[w::concurrency] for w in range(concurrency)]
+    reader = threading.Thread(target=_operator_load, daemon=True)
+    reader.start()
+    workers = [threading.Thread(target=_worker, args=(s,), daemon=True)
+               for s in slices]
+    t0 = time.monotonic()
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=600)
+    elapsed = time.monotonic() - t0
+    ingest_done.set()
+    reader.join(timeout=60)
+    rate = total / elapsed if elapsed else 0.0
+
+    cp.ingest_executor.flush(timeout=60)
+    cp.writer.flush(timeout=60.0)
+
+    cached_lat_ms = []
+    pane = None
+    for _ in range(40):
+        t = time.monotonic()
+        r = sess.get(f"{base}/v1/fleet/predict?top={faulted_n}", timeout=30)
+        cached_lat_ms.append((time.monotonic() - t) * 1000.0)
+        pane = r.json()
+    journaled = cp.rollup.journal_count()
+    cp.stop()
+
+    cold_p95 = (statistics.quantiles(cold_lat_ms, n=20)[-1]
+                if len(cold_lat_ms) >= 2 else float("inf"))
+    cached_p95 = (statistics.quantiles(cached_lat_ms, n=20)[-1]
+                  if len(cached_lat_ms) >= 2 else float("inf"))
+    zero_loss = (
+        journaled == total
+        and driven[0] == agents
+        and not failures
+    )
+    top_agents = {row["agent"] for row in (pane or {}).get("top", [])}
+    ranked_ok = pane is not None and top_agents == faulted
+    lead = (pane or {}).get("lead", {"count": 0})
+    lead_ok = lead.get("count", 0) == faulted_n
+    unknown_ok = (pane or {}).get("unknown_schema_records", 0) == unknown_sent
+
+    # -- calibration replay: synthetic ledger with a benign year and a
+    # precursor ramp; the fitted threshold must warn earlier than the
+    # default on the ramp and never on the benign section
+    from gpud_tpu.predict.calibrate import ThresholdCalibrator
+    from gpud_tpu.predict.features import cadence_score, fuse, trajectory_score
+
+    cal_t0 = t_base - 7 * 86400.0
+    rows = []
+    # benign: sparse restart-recovery transitions hours apart — never
+    # within a feature window of each other, never near an Unhealthy —
+    # so the benign replay scores sit at the noise floor and the fitted
+    # threshold can drop below the global default
+    for d in range(12):
+        rows.append({"component": "accelerator-tpu-1",
+                     "time": cal_t0 + d * 7200.0,
+                     "from": "Initializing", "to": "Healthy",
+                     "reason": "boot"})
+    # precursor ramp: accelerating restarts ending in a hard failure.
+    # Restarts are not Degraded excursions, so trajectory stays quiet
+    # and only cadence climbs — fused scores walk up THROUGH the
+    # calibrated band before crossing the global default
+    ramp_t0 = cal_t0 + 2 * 86400.0
+    t = ramp_t0
+    for gap in (200.0, 120.0, 80.0, 60.0, 45.0, 35.0, 25.0, 20.0):
+        rows.append({"component": "accelerator-tpu-1", "time": t,
+                     "from": "Healthy", "to": "Initializing",
+                     "reason": "ramp"})
+        t += gap
+    fail_ts = t
+    rows.append({"component": "accelerator-tpu-1", "time": fail_ts,
+                 "from": "Initializing", "to": "Unhealthy",
+                 "reason": "fail"})
+    rows.sort(key=lambda r: r["time"])
+
+    class _Ledger:
+        flap_threshold = 5
+
+        def history(self):
+            return list(reversed(rows))  # newest-first, like the real one
+
+    default_thr = 0.6
+    cal = ThresholdCalibrator(
+        _Ledger(), default_threshold=default_thr, window_seconds=600.0,
+    ).calibrate(now=t_base)["accelerator-tpu"]
+
+    def first_warn(threshold, weights):
+        times = [r["time"] for r in rows]
+        seen = [(r["time"], r["from"], r["to"]) for r in rows]
+        for i, r in enumerate(rows):
+            feats = {
+                "cadence": cadence_score(times[:i + 1], r["time"], 600.0,
+                                         saturation=5),
+                "trajectory": trajectory_score(r["to"], seen[:i + 1],
+                                               r["time"], 600.0),
+            }
+            if fuse(feats, weights) >= threshold:
+                return r["time"]
+        return None
+
+    warn_default = first_warn(default_thr, None)
+    warn_cal = first_warn(cal.threshold, cal.weights)
+    benign_fp = cal.benign_max >= cal.threshold
+    earlier = (
+        warn_cal is not None
+        and warn_cal < fail_ts
+        and (warn_default is None or warn_cal < warn_default)
+    )
+    calib_ok = (
+        cal.source == "calibrated"
+        and cal.threshold < default_thr
+        and not benign_fp
+        and earlier
+    )
+    lead_gain = (
+        (warn_default if warn_default is not None else fail_ts) - warn_cal
+        if warn_cal is not None else 0.0
+    )
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    print(
+        f"[fleet-predict] ingest: {rate:,.0f} records/sec "
+        f"({total:,} predict_score records from {agents} agents over the "
+        f"v2 tunnel in {elapsed:.2f}s), journal={journaled:,} "
+        f"zero_loss={zero_loss} failures={len(failures)}",
+        file=sys.stderr,
+    )
+    print(
+        f"[fleet-predict] pane: top-{faulted_n} == faulted cohort: "
+        f"{ranked_ok}; leads {lead.get('count', 0)}/{faulted_n} "
+        f"(mean {lead.get('mean_seconds', 0):g}s); unknown-schema "
+        f"counted={unknown_ok} ({unknown_sent} sent); cold p95 "
+        f"{cold_p95:.1f}ms [<= {FLEET_SOCKET_COLD_P95_MS:g}], cached "
+        f"p95 {cached_p95:.1f}ms [<= {FLEET_SOCKET_CACHED_P95_MS:g}]",
+        file=sys.stderr,
+    )
+    print(
+        f"[fleet-predict] calibration: threshold {cal.threshold:.3f} "
+        f"(default {default_thr:g}, benign_max {cal.benign_max:.3f}, "
+        f"source={cal.source}), warn default@"
+        f"{'never' if warn_default is None else f'{warn_default - ramp_t0:.0f}s'}"
+        f" vs calibrated@"
+        f"{'never' if warn_cal is None else f'{warn_cal - ramp_t0:.0f}s'} "
+        f"into the ramp (gain {lead_gain:.0f}s, fail at "
+        f"{fail_ts - ramp_t0:.0f}s), historical FPs={benign_fp}",
+        file=sys.stderr,
+    )
+    if failures:
+        print(f"[fleet-predict] FAILURES: {failures[:5]}", file=sys.stderr)
+    if read_errors:
+        print(f"[fleet-predict] READ ERRORS: {read_errors[:5]}",
+              file=sys.stderr)
+    ok = (
+        zero_loss
+        and ranked_ok
+        and lead_ok
+        and unknown_ok
+        and cold_p95 <= FLEET_SOCKET_COLD_P95_MS
+        and cached_p95 <= FLEET_SOCKET_CACHED_P95_MS
+        and not read_errors
+        and calib_ok
+    )
+    print(json.dumps({
+        "metric": "fleet predict pane correctness",
+        "value": round(rate, 1),
+        "unit": "records/sec",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "detail": {
+            "agents": agents,
+            "faulted": faulted_n,
+            "records_total": total,
+            "journal_rows": journaled,
+            "zero_loss": zero_loss,
+            "ranked_ok": ranked_ok,
+            "lead_count": lead.get("count", 0),
+            "unknown_schema_counted": unknown_ok,
+            "cold_p95_ms": round(cold_p95, 2),
+            "cached_p95_ms": round(cached_p95, 2),
+            "calibrated_threshold": round(cal.threshold, 4),
+            "calibration_lead_gain_s": round(lead_gain, 1),
+            "calibration_zero_fp": not benign_fp,
+            "calibration_ok": calib_ok,
+            "pass": ok,
+        },
+    }))
+    return 0 if ok else 1
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -2102,7 +2492,10 @@ def main(argv=None) -> int:
         help="run the predictive-health bench (slow-ramp + flap-burst "
              "replay against a live daemon; gates on warning lead time, "
              "zero false positives, CPU/RSS) instead of the standard "
-             "bench",
+             "bench; with --fleet: stream predict_score records from "
+             f"{FLEET_PREDICT_AGENTS} simulated agents through the v2 "
+             "tunnel and gate the ranked /v1/fleet/predict pane, zero "
+             "loss, pane p95s, and the calibration replay",
     )
     ap.add_argument(
         "--ingest", action="store_true",
@@ -2175,6 +2568,13 @@ def main(argv=None) -> int:
              "manager's own default)",
     )
     args = ap.parse_args(argv)
+    if args.fleet and args.predict:
+        return bench_fleet_predict(
+            agents=(args.fleet_agents
+                    if args.fleet_agents != FLEET_TARGET_AGENTS
+                    else FLEET_PREDICT_AGENTS),
+            shards=args.fleet_shards,
+        )
     if args.fleet and args.socket:
         return bench_fleet_socket(
             agents=(args.fleet_agents

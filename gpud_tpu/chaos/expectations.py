@@ -25,6 +25,11 @@ invariants that hold the daemon itself to account:
   predict:      the predict engine warned before the reactive hard
                 signal (ordering + lead-time floor), and stayed silent
                 on un-faulted components
+  predict_lead: the manager-side fleet pane reflects the prediction —
+                the faulted component ranks in the top-K of
+                ``fleet_predict`` by decayed risk, warn/lead records
+                survived ingest, and the fleet lead distribution
+                clears its floor
   invariants:   zero unhandled worker exceptions (scheduler failure +
                 watchdog counters flat), un-faulted job cadence within
                 slack, thread-count and RSS gates
@@ -907,6 +912,94 @@ def _eval_invariants(server, spec: Dict, ctx) -> List[ExpectationResult]:
     return out
 
 
+def _eval_predict_lead(server, spec: Dict, ctx) -> List[ExpectationResult]:
+    """Fleet-level predictive assertions against the manager-side rollup
+    (manager/rollup.py ``fleet_predict``), closing the predict→fleet
+    loop end-to-end: the agent's ``predict_score`` outbox records must
+    survive ingest and surface in the ranked pane:
+
+      component:      the faulted component name
+      in_top:         K — the component must rank within the top-K rows
+                      by decayed risk (default 1: it must LEAD the pane)
+      warns_min:      floor on fleet-wide journaled warn records (>=1)
+      lead_count_min: floor on journaled lead records fleet-wide
+      lead_min:       floor on the fleet's minimum measured lead time —
+                      the pane must agree the warning landed BEFORE the
+                      reactive hard signal, from the manager's view
+      within:         poll bound (defaults to the detect timeout)
+    """
+    plane = ctx.plane
+    rollup = getattr(plane, "rollup", None) if plane is not None else None
+    if rollup is None:
+        return [ExpectationResult(
+            "predict_lead", False,
+            detail="no fleet rollup store attached to the fake control plane",
+        )]
+    component = spec.get("component", "")
+    in_top = int(spec.get("in_top", 1))
+    warns_min = int(spec.get("warns_min", 1))
+    lead_count_min = int(spec.get("lead_count_min", 0))
+    lead_min = spec.get("lead_min")
+    within = float(spec.get("within", ctx.detect_timeout))
+    deadline = ctx.time_fn() + within
+
+    def pane_ready():
+        # explicit now bypasses the pane's TTL cache so each poll sees
+        # the freshest ingested records (and decay at the poll instant)
+        pane = rollup.fleet_predict(top=max(in_top, 5), now=ctx.time_fn())
+        if pane["warns_total"] < warns_min:
+            return None
+        if pane["lead"]["count"] < lead_count_min:
+            return None
+        rank = None
+        for i, row in enumerate(pane["top"]):
+            if row["component"] == component:
+                rank = i
+                break
+        if rank is None or rank >= in_top:
+            return None
+        return (pane, rank)
+
+    got = _poll(pane_ready, deadline, ctx)
+    if got is None:
+        pane = rollup.fleet_predict(top=max(in_top, 5), now=ctx.time_fn())
+        ranked = [
+            f'{r["agent"]}/{r["component"]}@{r["risk"]:.3f}'
+            for r in pane["top"]
+        ]
+        return [ExpectationResult(
+            "predict_lead", False, timed_out=True,
+            detail=(
+                f"{component}: never ranked in the fleet pane top-{in_top} "
+                f"within {within:g}s (warns={pane['warns_total']}, "
+                f"leads={pane['lead']['count']}, top={ranked})"
+            ),
+        )]
+    pane, rank = got
+    out = [ExpectationResult(
+        "predict_lead", True,
+        detail=(
+            f"{component}: rank #{rank + 1} in the fleet pane "
+            f"(risk={pane['top'][rank]['risk']:.3f}, "
+            f"warns={pane['warns_total']}, leads={pane['lead']['count']})"
+        ),
+    )]
+    if lead_min is not None:
+        have = pane["lead"]["min_seconds"]
+        ok = pane["lead"]["count"] > 0 and have >= float(lead_min)
+        out.append(ExpectationResult(
+            "predict_lead", ok,
+            detail=(
+                f"fleet lead floor: min={have:g}s over "
+                f"{pane['lead']['count']} lead record(s) "
+                f"(gate >= {float(lead_min):g}s)"
+                if pane["lead"]["count"]
+                else "fleet lead floor: no lead records journaled"
+            ),
+        ))
+    return out
+
+
 def evaluate_phase(server, expect: Dict, ctx) -> List[ExpectationResult]:
     """Evaluate a phase's full expectation block, in chain order."""
     results: List[ExpectationResult] = []
@@ -928,6 +1021,10 @@ def evaluate_phase(server, expect: Dict, ctx) -> List[ExpectationResult]:
         results.extend(_eval_fabric(server, expect["fabric"] or {}, ctx))
     if "predict" in expect:
         results.extend(_eval_predict(server, expect["predict"] or [], ctx))
+    if "predict_lead" in expect:
+        results.extend(
+            _eval_predict_lead(server, expect["predict_lead"] or {}, ctx)
+        )
     if "invariants" in expect:
         results.extend(_eval_invariants(server, expect["invariants"] or {}, ctx))
     return results

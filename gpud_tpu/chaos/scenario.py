@@ -73,7 +73,7 @@ KNOWN_ACTIONS = (
 # expectation kinds evaluated after each phase (gpud_tpu/chaos/expectations.py)
 KNOWN_EXPECTATIONS = (
     "detect", "ledger", "remediation", "events", "invariants", "plane",
-    "outbox", "fleet", "fabric", "predict",
+    "outbox", "fleet", "fabric", "predict", "predict_lead",
 )
 
 MAX_STEP_OCCURRENCES = 1000  # per phase — runaway `count` backstop

@@ -509,6 +509,12 @@ def cmd_predict(args) -> int:
         timeout=float(args.timeout),
     )
     try:
+        if getattr(args, "calibration", False):
+            out = c.get_predict_calibration(
+                refit=getattr(args, "refit", False)
+            )
+            print(json.dumps(out, indent=2, sort_keys=True))
+            return 0
         out = c.get_predict_scores(
             component=args.component, history=args.history or None
         )
@@ -909,6 +915,8 @@ def cmd_fleet(args) -> int:
             if args.since:
                 params["since"] = args.since
             data = get("/v1/fleet/fabric", params=params or None)
+        elif args.fleet_cmd == "predict":
+            data = get("/v1/fleet/predict", params={"top": args.top})
         elif args.fleet_cmd == "agents":
             data = get(
                 "/v1/fleet/agents",
@@ -1130,6 +1138,10 @@ def build_parser() -> argparse.ArgumentParser:
     ppr.add_argument("--component", default="", help="filter to one component")
     ppr.add_argument("--history", type=int, default=0,
                      help="append the last N score points per component")
+    ppr.add_argument("--calibration", action="store_true",
+                     help="show learned per-class threshold calibration")
+    ppr.add_argument("--refit", action="store_true",
+                     help="with --calibration: re-fit from the ledger first")
     ppr.add_argument("--port", type=int, default=cfgmod.DEFAULT_PORT)
     ppr.add_argument("--no-tls", action="store_true")
     ppr.add_argument("--timeout", type=float, default=30.0)
@@ -1266,6 +1278,13 @@ def build_parser() -> argparse.ArgumentParser:
     ff.add_argument("--since", type=float, default=0.0,
                     help="unix-timestamp floor for degraded-since")
     _fleet_common(ff)
+    fp = fsub.add_parser(
+        "predict",
+        help="fleet-ranked predictive pane: top-K series by decayed risk",
+    )
+    fp.add_argument("--top", type=int, default=20,
+                    help="how many ranked (agent, component) rows")
+    _fleet_common(fp)
     fa = fsub.add_parser("agents", help="paginated per-agent rollups")
     fa.add_argument("--offset", type=int, default=0)
     fa.add_argument("--limit", type=int, default=100)

@@ -187,6 +187,19 @@ class Client:
             params["history"] = history
         return self._req("GET", "/v1/predict/scores", params=params or None)
 
+    def get_predict_calibration(self, refit: bool = False) -> Dict:
+        """Learned-threshold calibration state
+        (``/v1/predict/calibration``): per-component-class fitted
+        thresholds and feature weights replayed from the node's own
+        ledger history; ``refit=True`` re-fits synchronously before
+        returning."""
+        params: Dict = {}
+        if refit:
+            params["refit"] = 1
+        return self._req(
+            "GET", "/v1/predict/calibration", params=params or None,
+        )
+
     def get_fabric(
         self,
         link: str = "",
@@ -291,6 +304,15 @@ class Client:
         if since is not None:
             params["since"] = since
         return self._req("GET", "/v1/fleet/fabric", params=params or None)
+
+    def get_fleet_predict(self, top: Optional[int] = None) -> Dict:
+        """Fleet-ranked predictive pane (``GET /v1/fleet/predict``):
+        the top-K (agent, component) series by time-decayed predicted-
+        failure risk, with lead-time distribution and risk buckets."""
+        params: Dict = {}
+        if top is not None:
+            params["top"] = top
+        return self._req("GET", "/v1/fleet/predict", params=params or None)
 
     def get_fleet_agents(self, offset: int = 0, limit: int = 100) -> Dict:
         """One paginated page of per-agent rollups

@@ -40,6 +40,13 @@ DEFAULT_PREDICT_WINDOW = 600.0           # feature lookback window (s)
 DEFAULT_PREDICT_HISTORY_LIMIT = 256      # in-memory score points / component
 DEFAULT_PREDICT_WARN_COOLDOWN = 300.0    # predicted-warning audit-row cooldown
 DEFAULT_PREDICT_PUBLISH_INTERVAL = 60.0  # armed-score outbox snapshot cadence
+# threshold calibration: per-component-class thresholds/weights fitted
+# by replaying the node's own ledger history (docs/predict.md)
+DEFAULT_PREDICT_CALIBRATE_INTERVAL = 3600.0  # re-fit cadence (s)
+DEFAULT_PREDICT_CALIBRATE_MIN_HISTORY = 8    # class samples below => defaults
+DEFAULT_PREDICT_CALIBRATE_MIN_THRESHOLD = 0.35  # fitted-threshold floor
+DEFAULT_PREDICT_CALIBRATE_MARGIN = 0.05      # gap above the benign maximum
+DEFAULT_PREDICT_CALIBRATE_HORIZON = 900.0    # post-sample failure horizon (s)
 # fabric observability plane (docs/fabric.md): mesh-wide all-links sweep
 DEFAULT_FABRIC_SWEEP_INTERVAL = 60.0     # all-links sweep cadence (s)
 DEFAULT_FABRIC_SWEEP_THRESHOLD_Z = 4.0   # EWMA z that flags Degraded
@@ -143,6 +150,19 @@ class Config:
     predict_history_limit: int = DEFAULT_PREDICT_HISTORY_LIMIT
     predict_warn_cooldown_seconds: float = DEFAULT_PREDICT_WARN_COOLDOWN
     predict_publish_interval_seconds: float = DEFAULT_PREDICT_PUBLISH_INTERVAL
+    # ledger-history threshold calibration (docs/predict.md)
+    predict_calibrate_enabled: bool = True
+    predict_calibrate_interval_seconds: float = (
+        DEFAULT_PREDICT_CALIBRATE_INTERVAL
+    )
+    predict_calibrate_min_history: int = DEFAULT_PREDICT_CALIBRATE_MIN_HISTORY
+    predict_calibrate_min_threshold: float = (
+        DEFAULT_PREDICT_CALIBRATE_MIN_THRESHOLD
+    )
+    predict_calibrate_margin: float = DEFAULT_PREDICT_CALIBRATE_MARGIN
+    predict_calibrate_horizon_seconds: float = (
+        DEFAULT_PREDICT_CALIBRATE_HORIZON
+    )
     # fabric observability (docs/fabric.md): logical-mesh discovery + the
     # all-links sweep with per-link EWMA latency baselines. Hermetic by
     # construction: with no JAX devices and no ICI inventory the mesh
@@ -294,6 +314,16 @@ class Config:
             return "predict warn cooldown must be >= 0s"
         if self.predict_publish_interval_seconds < 0:
             return "predict publish interval must be >= 0s"
+        if self.predict_calibrate_interval_seconds <= 0:
+            return "predict calibrate interval must be > 0s"
+        if self.predict_calibrate_min_history < 1:
+            return "predict calibrate min history must be >= 1"
+        if not 0.0 < self.predict_calibrate_min_threshold <= 1.0:
+            return "predict calibrate min threshold must be in (0, 1]"
+        if not 0.0 <= self.predict_calibrate_margin < 0.5:
+            return "predict calibrate margin must be in [0, 0.5)"
+        if self.predict_calibrate_horizon_seconds < 1:
+            return "predict calibrate horizon must be >= 1s"
         if self.fabric_sweep_interval_seconds <= 0:
             return "fabric sweep interval must be > 0s"
         if self.fabric_sweep_latency_threshold_z <= 0:

@@ -291,6 +291,7 @@ class ControlPlane:
         rollup_cache_ttl: float = 2.0,
         shards: Optional[int] = None,
         max_v2_agents: int = 64,
+        predict_decay_seconds: Optional[float] = None,
     ) -> None:
         self.port = port
         self.grpc_port = grpc_port
@@ -351,9 +352,12 @@ class ControlPlane:
         self.db = DB(db_path)
         self.writer = BatchWriter(self.db)
         self.shards = int(shards) if shards else DEFAULT_SHARD_COUNT
+        rollup_kwargs = {}
+        if predict_decay_seconds is not None:
+            rollup_kwargs["predict_decay_seconds"] = predict_decay_seconds
         self.rollup = FleetRollupStore(
             self.db, self.writer, cache_ttl_seconds=rollup_cache_ttl,
-            shard_count=self.shards,
+            shard_count=self.shards, **rollup_kwargs,
         )
         # lock-striped offload for wire decode + rollup ingest: session
         # reader threads enqueue, shard workers journal + ack
@@ -659,6 +663,24 @@ class ControlPlane:
         )
         return web.json_response(data)
 
+    async def _fleet_predict_route(self, request):  # noqa: ANN001
+        """Fleet-ranked prediction pane: top-K (agent, component) rows
+        by time-decayed predicted-failure risk from journaled
+        ``predict_score`` records, with per-feature breakdown and
+        fleet-wide lead-time aggregates (``?top=``, docs/fleet.md)."""
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        try:
+            top = self._q_num(request, "top", 20, int)
+        except ValueError:
+            return web.Response(status=400, text="top must be an integer")
+        data = await asyncio.get_event_loop().run_in_executor(
+            self._op_pool, lambda: self.rollup.fleet_predict(top)
+        )
+        return web.json_response(data)
+
     async def _fleet_agents_route(self, request):  # noqa: ANN001
         """One page of per-agent rollups (``?offset=&limit=``)."""
         from aiohttp import web
@@ -764,6 +786,7 @@ class ControlPlane:
         app.router.add_post("/v1/drain", self._drain_route)
         app.router.add_get("/v1/fleet/rollup", self._fleet_rollup_route)
         app.router.add_get("/v1/fleet/fabric", self._fleet_fabric_route)
+        app.router.add_get("/v1/fleet/predict", self._fleet_predict_route)
         app.router.add_get("/v1/fleet/agents", self._fleet_agents_route)
         app.router.add_get(
             "/v1/fleet/agents/{agent_id}/history", self._fleet_history_route

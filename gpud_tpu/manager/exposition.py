@@ -61,6 +61,30 @@ _AGENT_FAMILIES = (
     ("tpud_fleet_agent_unhealthy_series",
      "the agent's components currently in a non-Healthy state",
      "unhealthy_series"),
+    ("tpud_fleet_agent_predict_risk",
+     "worst predicted-failure risk across the agent's components "
+     "(decay anchored at the agent's newest record time)",
+     "predict_risk"),
+)
+
+# fleet-level predictive gauges refreshed from the ranked pane at scrape
+# time — fixed cardinality regardless of fleet size (docs/fleet.md)
+_g_predict_armed = gauge(
+    "tpud_fleet_predict_armed_series",
+    "(agent, component) predictive series currently armed fleet-wide",
+)
+_g_predict_warns = gauge(
+    "tpud_fleet_predict_warns",
+    "predictive warnings journaled fleet-wide, all time",
+)
+_g_predict_risk_max = gauge(
+    "tpud_fleet_predict_risk_max",
+    "highest time-decayed predicted-failure risk in the fleet right now",
+)
+_g_predict_lead_mean = gauge(
+    "tpud_fleet_predict_lead_mean_seconds",
+    "mean measured lead time (predictive warning to reactive hard "
+    "signal) across all journaled lead records",
 )
 
 
@@ -81,6 +105,16 @@ def render_fleet_metrics(
     from gpud_tpu.manager.shard import update_shard_gauges
 
     update_shard_gauges(rollup_store, ingest_executor)
+    # fleet-level predictive rollup: one cached pane read feeds four
+    # fixed-cardinality gauges (the ranked per-node detail stays behind
+    # the paginated operator API, like everything agent-labelled)
+    pane = rollup_store.fleet_predict(top=1)
+    _g_predict_armed.set(pane["armed"])
+    _g_predict_warns.set(pane["warns_total"])
+    _g_predict_risk_max.set(
+        pane["top"][0]["risk"] if pane["top"] else 0.0
+    )
+    _g_predict_lead_mean.set(pane["lead"]["mean_seconds"])
     parts: List[str] = [DEFAULT_REGISTRY.render_prometheus()]
     # walk the paginated view (cached + flush-barriered like any other
     # operator read) instead of a private fast path
@@ -106,6 +140,7 @@ def render_fleet_metrics(
                 "unhealthy_series": sum(
                     1 for c in comps if c["state"] and c["state"] != "Healthy"
                 ),
+                "predict_risk": a.get("predict_risk", 0.0),
             })
         if page["next_offset"] is None:
             break

@@ -48,6 +48,7 @@ Design:
 
 from __future__ import annotations
 
+import math
 import os
 import threading
 import time
@@ -121,6 +122,15 @@ _g_agents = gauge(
 _g_series = gauge(
     "tpud_fleet_agent_series",
     "distinct (agent, component) rollup series held in memory",
+)
+_g_predict_series = gauge(
+    "tpud_fleet_predict_series",
+    "distinct (agent, component) predictive rollup series held in memory",
+)
+_g_predict_unknown = gauge(
+    "tpud_fleet_predict_unknown_schema_records",
+    "journaled predict_score records whose payload schema is newer than "
+    "this manager understands (counted per record, never dropped)",
 )
 
 
@@ -221,6 +231,13 @@ _LINK_STATE_RANK = {"up": 0, "": 0, "degraded": 1, "down": 2}
 # unbounded link names degrades to truncation accounting, not OOM
 MAX_LINKS_PER_AGENT = 1024
 
+# per-link bound on retained degraded-record timestamps: the windowed
+# 1h/24h/7d counters saturate here instead of growing with history
+MAX_LINK_WINDOW_SAMPLES = 512
+
+# windowed degradation buckets served by /v1/fleet/fabric
+LINK_WINDOWS = (("1h", 3600.0), ("24h", 86400.0), ("7d", 604800.0))
+
 
 class _LinkRollup:
     """Per-(agent, ici link) aggregate over shipped fabric sweep records."""
@@ -228,7 +245,7 @@ class _LinkRollup:
     __slots__ = (
         "src_chip", "dst_chip", "axis", "last_state", "worst_state",
         "records", "deviations", "downs", "last_ts", "last_degraded_ts",
-        "max_deviation",
+        "max_deviation", "degraded_recent",
     )
 
     def __init__(self) -> None:
@@ -243,6 +260,8 @@ class _LinkRollup:
         self.last_ts = 0.0
         self.last_degraded_ts = 0.0  # newest not-up record ts
         self.max_deviation = 0.0
+        # bounded not-up record timestamps behind the windowed counters
+        self.degraded_recent: deque = deque(maxlen=MAX_LINK_WINDOW_SAMPLES)
 
     def apply(self, body: Dict, ts: float) -> None:
         state = str(body.get("state", "") or "")
@@ -260,8 +279,10 @@ class _LinkRollup:
             self.deviations += 1
         elif state == "down":
             self.downs += 1
-        if state in ("degraded", "down") and when > self.last_degraded_ts:
-            self.last_degraded_ts = when
+        if state in ("degraded", "down"):
+            self.degraded_recent.append(when)
+            if when > self.last_degraded_ts:
+                self.last_degraded_ts = when
         if when > self.last_ts:
             self.last_ts = when
         try:
@@ -271,7 +292,18 @@ class _LinkRollup:
         if dev > self.max_deviation:
             self.max_deviation = dev
 
-    def snapshot(self) -> Dict:
+    def snapshot(self, as_of: Optional[float] = None) -> Dict:
+        """``as_of`` anchors the windowed counters; ``None`` falls back
+        to the link's own newest record time, which makes the snapshot a
+        pure function of the journal (rebuild-parity tests lean on it —
+        wall-clock anchoring is the *caller's* choice)."""
+        anchor = self.last_ts if as_of is None else as_of
+        windows = {
+            label: sum(
+                1 for t in self.degraded_recent if t > anchor - span
+            )
+            for label, span in LINK_WINDOWS
+        }
         return {
             "src_chip": self.src_chip,
             "dst_chip": self.dst_chip,
@@ -284,6 +316,162 @@ class _LinkRollup:
             "last_ts": self.last_ts,
             "last_degraded_ts": self.last_degraded_ts,
             "max_deviation": self.max_deviation,
+            "degraded_windows": windows,
+        }
+
+
+# newest predict_score payload schema this manager understands: records
+# with a higher schema are journaled + counted, never applied (a newer
+# agent in a mixed fleet degrades to accounting, not silent data loss)
+PREDICT_SCHEMA_MAX = 1
+
+# per-agent cap on distinct predictive series (same OOM guard as links)
+MAX_PREDICT_PER_AGENT = 512
+
+# per-series bound on retained lead-time measurements (p50 source)
+MAX_PREDICT_LEADS = 64
+
+# default e-folding time for stale-score down-ranking in the fleet pane:
+# an armed component republishes every publish-interval (60s default),
+# so a score 15 minutes old is either a dead agent or a cleared story —
+# rank it down smoothly rather than serving it as fresh
+DEFAULT_PREDICT_DECAY = 900.0
+
+
+class _PredictRollup:
+    """Per-(agent, component) predictive aggregate over journaled
+    ``predict_score`` outbox records (warn/clear/lead/snapshot).
+
+    Pure function of the agent's record sequence — no wall-clock reads —
+    so a journal replay rebuilds it byte-identically for any shard
+    count. Staleness decay is applied at *read* time in
+    :meth:`FleetRollupStore._compute_fleet_predict`."""
+
+    __slots__ = (
+        "component_class", "schema", "score", "armed", "warned_at",
+        "threshold", "last_event", "last_ts", "features",
+        "warn_count", "clear_count", "snapshot_count",
+        "lead_count", "lead_total", "lead_min", "lead_max", "leads",
+    )
+
+    def __init__(self) -> None:
+        self.component_class = ""
+        self.schema = 0
+        self.score = 0.0
+        self.armed = False
+        self.warned_at: Optional[float] = None
+        self.threshold = 0.0
+        self.last_event = ""
+        self.last_ts = 0.0
+        self.features: Dict[str, float] = {}
+        self.warn_count = 0
+        self.clear_count = 0
+        self.snapshot_count = 0
+        self.lead_count = 0
+        self.lead_total = 0.0
+        self.lead_min = 0.0
+        self.lead_max = 0.0
+        self.leads: deque = deque(maxlen=MAX_PREDICT_LEADS)
+
+    def apply(self, body: Dict, ts: float) -> None:
+        event = str(body.get("event", "") or "")
+        when = float(body.get("ts", ts) or ts)
+        try:
+            score = float(body.get("score", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            score = 0.0
+        score = 0.0 if score < 0.0 else (1.0 if score > 1.0 else score)
+        if when >= self.last_ts:
+            # latest-wins fields follow record time: per-agent replay
+            # order is (ts, seq), so this is deterministic on rebuild
+            self.last_ts = when
+            self.last_event = event
+            self.score = score
+            self.armed = bool(body.get("armed"))
+            self.schema = int(body.get("schema", 0) or 0)
+            self.component_class = str(
+                body.get("component_class", self.component_class) or ""
+            )
+            wa = body.get("warned_at")
+            self.warned_at = float(wa) if wa is not None else None
+            try:
+                self.threshold = float(body.get("threshold", 0.0) or 0.0)
+            except (TypeError, ValueError):
+                self.threshold = 0.0
+            feats = body.get("features")
+            if isinstance(feats, dict):
+                clean: Dict[str, float] = {}
+                for k, v in feats.items():
+                    try:
+                        clean[str(k)] = float(v)
+                    except (TypeError, ValueError):
+                        continue
+                self.features = clean
+        if event == "warn":
+            self.warn_count += 1
+        elif event == "clear":
+            self.clear_count += 1
+        elif event == "snapshot":
+            self.snapshot_count += 1
+        elif event == "lead":
+            lead = body.get("lead_seconds")
+            try:
+                lead = None if lead is None else float(lead)
+            except (TypeError, ValueError):
+                lead = None
+            if lead is not None and lead >= 0.0:
+                self.lead_count += 1
+                self.lead_total += lead
+                if self.lead_count == 1 or lead < self.lead_min:
+                    self.lead_min = lead
+                if lead > self.lead_max:
+                    self.lead_max = lead
+                self.leads.append(lead)
+
+    def risk(self, now: float, decay_tau: float) -> float:
+        """Predicted-failure likelihood at ``now``: the noisy-OR of the
+        last fused score, an armed bonus, and repeat-warning evidence,
+        all down-ranked by exponential staleness decay. Bounded [0, 1],
+        monotone in freshness — a node that stopped reporting sinks."""
+        age = max(0.0, now - self.last_ts)
+        decay = math.exp(-age / decay_tau) if decay_tau > 0 else 1.0
+        armed_term = 0.25 if self.armed else 0.0
+        warn_term = 0.15 * min(self.warn_count, 4) / 4.0
+        base = 1.0 - (1.0 - self.score) * (1.0 - armed_term) * (
+            1.0 - warn_term
+        )
+        r = base * decay
+        return 0.0 if r < 0.0 else (1.0 if r > 1.0 else r)
+
+    def snapshot(self, now: float, decay_tau: float) -> Dict:
+        leads = sorted(self.leads)
+        return {
+            "component_class": self.component_class,
+            "schema": self.schema,
+            "score": self.score,
+            "risk": self.risk(now, decay_tau),
+            "age_seconds": max(0.0, now - self.last_ts),
+            "armed": self.armed,
+            "warned_at": self.warned_at,
+            "threshold": self.threshold,
+            "last_event": self.last_event,
+            "last_ts": self.last_ts,
+            "features": dict(self.features),
+            "warn_count": self.warn_count,
+            "clear_count": self.clear_count,
+            "snapshot_count": self.snapshot_count,
+            "lead": {
+                "count": self.lead_count,
+                "mean_seconds": (
+                    self.lead_total / self.lead_count
+                    if self.lead_count else 0.0
+                ),
+                "min_seconds": self.lead_min,
+                "max_seconds": self.lead_max,
+                "p50_seconds": (
+                    leads[(len(leads) - 1) // 2] if leads else 0.0
+                ),
+            },
         }
 
 
@@ -294,6 +482,7 @@ class _AgentRollup:
         "records_by_kind", "last_seq", "last_ts", "last_ingest",
         "outbox_lag_seconds", "remediation_outcomes", "series",
         "links", "links_truncated",
+        "predict", "predict_truncated", "predict_unknown_schema",
     )
 
     def __init__(self) -> None:
@@ -306,6 +495,9 @@ class _AgentRollup:
         self.series: Dict[str, _SeriesRollup] = {}
         self.links: Dict[str, _LinkRollup] = {}
         self.links_truncated = 0
+        self.predict: Dict[str, _PredictRollup] = {}
+        self.predict_truncated = 0
+        self.predict_unknown_schema = 0
 
 
 class FleetRollupStore:
@@ -338,12 +530,14 @@ class FleetRollupStore:
         max_journal_rows: int = DEFAULT_MAX_JOURNAL_ROWS,
         shard_count: int = DEFAULT_SHARD_COUNT,
         rebuild_parallel: bool = True,
+        predict_decay_seconds: float = DEFAULT_PREDICT_DECAY,
     ) -> None:
         self.db = db
         self.writer = writer
         self.cache_ttl = float(cache_ttl_seconds)
         self.dedupe_keys_max = int(dedupe_keys_max)
         self.max_journal_rows = int(max_journal_rows)
+        self.predict_decay = float(predict_decay_seconds)
         self.shard_count = max(1, min(int(shard_count), SHARD_SLOTS))
         self.rebuild_parallel = bool(rebuild_parallel)
         self._shards: List[RollupShard] = [
@@ -507,6 +701,8 @@ class FleetRollupStore:
             shard.records_total = 0
             shard.duplicates_total = 0
             shard.series_total = 0
+            shard.predict_total = 0
+            shard.predict_unknown_total = 0
             dedupe = shard.dedupe
             run_agent = None
             run_keys: List[str] = []
@@ -645,12 +841,36 @@ class FleetRollupStore:
                     return
                 lr = ar.links[link] = _LinkRollup()
             lr.apply(body, ts)
+        elif kind == "predict_score":
+            try:
+                schema = int(body.get("schema", 0) or 0)
+            except (TypeError, ValueError):
+                schema = 0
+            if schema > PREDICT_SCHEMA_MAX:
+                # newer-agent record: already journaled above (a future
+                # manager can replay it), counted here, never applied
+                ar.predict_unknown_schema += 1
+                shard.predict_unknown_total += 1
+                return
+            comp = str(body.get("component", "") or "_unknown")
+            pr = ar.predict.get(comp)
+            if pr is None:
+                if len(ar.predict) >= MAX_PREDICT_PER_AGENT:
+                    ar.predict_truncated += 1
+                    return
+                pr = ar.predict[comp] = _PredictRollup()
+                shard.predict_total += 1
+            pr.apply(body, ts)
 
     def _update_gauges(self) -> None:
         # per-shard counters are plain ints; summing without the shard
         # locks reads a consistent-enough snapshot for gauges
         _g_agents.set(sum(len(s.agents) for s in self._shards))
         _g_series.set(sum(s.series_total for s in self._shards))
+        _g_predict_series.set(sum(s.predict_total for s in self._shards))
+        _g_predict_unknown.set(
+            sum(s.predict_unknown_total for s in self._shards)
+        )
 
     # -- cache plumbing ----------------------------------------------------
     def _barrier(self) -> None:
@@ -782,19 +1002,29 @@ class FleetRollupStore:
             "max_outbox_lag_seconds": max_lag,
         }
 
-    def fleet_fabric(self, since: float = 0.0) -> Dict:
+    def fleet_fabric(
+        self, since: float = 0.0, now: Optional[float] = None
+    ) -> Dict:
         """Fleet-wide ICI link matrix rollup (``GET /v1/fleet/fabric``):
         per-agent link aggregates from journaled ``ici_link`` fabric
-        sweep records, answering "which links degraded since ts" across
-        the whole fleet from one query."""
+        sweep records, answering "which links degraded since ts" — and,
+        via the windowed 1h/24h/7d counters, "which links degraded this
+        week" — across the whole fleet from one query. ``now`` anchors
+        the window buckets; passing it explicitly (tests, parity
+        comparisons) makes the response a pure function of the journal
+        and bypasses the TTL cache."""
         since = float(since)
+        if now is not None:
+            return self._compute_fleet_fabric(since, float(now))
         return self._cached(
             ("fabric", since),
-            lambda: self._compute_fleet_fabric(since),
+            lambda: self._compute_fleet_fabric(since, None),
             sql=False,
         )
 
-    def _compute_fleet_fabric(self, since: float) -> Dict:
+    def _compute_fleet_fabric(
+        self, since: float, now: Optional[float]
+    ) -> Dict:
         with self._meta:
             gen = self._generation
         agents_with_links = 0
@@ -817,7 +1047,7 @@ class FleetRollupStore:
                             or (lr.last_degraded_ts > 0
                                 and lr.last_degraded_ts >= since)
                         ):
-                            row = lr.snapshot()
+                            row = lr.snapshot(as_of=now)
                             row["agent"] = aid
                             row["link"] = name
                             degraded.append(row)
@@ -838,6 +1068,110 @@ class FleetRollupStore:
             "degraded_count": len(degraded),
             "degraded": degraded[:256],
             "links_truncated": truncated,
+        }
+
+    def fleet_predict(
+        self, top: int = 20, now: Optional[float] = None
+    ) -> Dict:
+        """Fleet-ranked prediction pane (``GET /v1/fleet/predict``):
+        "which K of my N nodes fail next", from journaled
+        ``predict_score`` records. Rows are (agent, component) predictive
+        aggregates ranked by time-decayed risk — the last fused score
+        plus armed/repeat-warning evidence, down-ranked exponentially as
+        the score goes stale (``predict_decay_seconds`` e-folding).
+        ``now`` anchors the decay; passing it explicitly (tests, parity
+        comparisons) makes the response a pure function of the journal
+        and bypasses the TTL cache."""
+        top = max(1, min(500, int(top)))
+        if now is not None:
+            return self._compute_fleet_predict(top, float(now))
+        return self._cached(
+            ("predict", top),
+            lambda: self._compute_fleet_predict(top, time.time()),
+            sql=False,
+        )
+
+    def _compute_fleet_predict(self, top: int, now: float) -> Dict:
+        with self._meta:
+            gen = self._generation
+        decay_tau = self.predict_decay
+        agents_with_predict = 0
+        unknown_schema = 0
+        truncated = 0
+        armed = 0
+        warns_total = 0
+        # (agent, component, snapshot) collected one shard lock at a
+        # time, then globally sorted — identical output for any shard
+        # count (the fleet lead-time aggregation below also walks the
+        # sorted list so float sums are order-stable)
+        rows: List[tuple] = []
+        for shard in self._shards:
+            with shard.lock:
+                for aid, ar in shard.agents.items():
+                    if not ar.predict and not ar.predict_unknown_schema:
+                        continue
+                    if ar.predict:
+                        agents_with_predict += 1
+                    unknown_schema += ar.predict_unknown_schema
+                    truncated += ar.predict_truncated
+                    for comp, pr in ar.predict.items():
+                        snap = pr.snapshot(now, decay_tau)
+                        if snap["armed"]:
+                            armed += 1
+                        warns_total += snap["warn_count"]
+                        rows.append((aid, comp, snap))
+        rows.sort(key=lambda r: (-r[2]["risk"], r[0], r[1]))
+        lead_count = 0
+        lead_total = 0.0
+        lead_min = 0.0
+        lead_max = 0.0
+        for aid, comp, snap in sorted(rows, key=lambda r: (r[0], r[1])):
+            lead = snap["lead"]
+            if lead["count"]:
+                if lead_count == 0 or lead["min_seconds"] < lead_min:
+                    lead_min = lead["min_seconds"]
+                if lead["max_seconds"] > lead_max:
+                    lead_max = lead["max_seconds"]
+                lead_count += lead["count"]
+                lead_total += lead["mean_seconds"] * lead["count"]
+        ranked = []
+        for aid, comp, snap in rows[:top]:
+            row = dict(snap)
+            row["agent"] = aid
+            row["component"] = comp
+            ranked.append(row)
+        buckets = {"low": 0, "moderate": 0, "elevated": 0, "critical": 0}
+        for _aid, _comp, snap in rows:
+            r = snap["risk"]
+            if r < 0.25:
+                buckets["low"] += 1
+            elif r < 0.5:
+                buckets["moderate"] += 1
+            elif r < 0.75:
+                buckets["elevated"] += 1
+            else:
+                buckets["critical"] += 1
+        return {
+            "generation": gen,
+            "now": now,
+            "decay_tau_seconds": decay_tau,
+            "agents": agents_with_predict,
+            "series": len(rows),
+            "armed": armed,
+            "warns_total": warns_total,
+            "risk_buckets": buckets,
+            "lead": {
+                "count": lead_count,
+                "mean_seconds": (
+                    lead_total / lead_count if lead_count else 0.0
+                ),
+                "min_seconds": lead_min,
+                "max_seconds": lead_max,
+            },
+            "unknown_schema_records": unknown_schema,
+            "predict_truncated": truncated,
+            "top_k": top,
+            "top": ranked,
         }
 
     def agents_page(self, offset: int = 0, limit: int = 50) -> Dict:
@@ -861,6 +1195,14 @@ class FleetRollupStore:
                 if ar is None:
                     continue  # raced a rebuild; agents are never removed
                 as_of = ar.last_ts
+                # predict risk anchored at the agent's own newest record
+                # time (a pure function of the journal — pagination stays
+                # rebuild-deterministic); the wall-clock staleness decay
+                # lives in the fleet_predict ranking pane
+                predict = {
+                    comp: pr.snapshot(as_of, self.predict_decay)
+                    for comp, pr in sorted(ar.predict.items())
+                }
                 rollups.append({
                     "agent": aid,
                     "last_seq": ar.last_seq,
@@ -873,6 +1215,11 @@ class FleetRollupStore:
                         comp: sr.snapshot(as_of)
                         for comp, sr in sorted(ar.series.items())
                     },
+                    "predict": predict,
+                    "predict_risk": max(
+                        (p["risk"] for p in predict.values()), default=0.0
+                    ),
+                    "predict_unknown_schema": ar.predict_unknown_schema,
                 })
         total = len(ids)
         next_offset = offset + len(rollups)
@@ -901,6 +1248,11 @@ class FleetRollupStore:
                     comp: sr.snapshot(as_of)
                     for comp, sr in sorted(ar.series.items())
                 },
+                "predict": {
+                    comp: pr.snapshot(as_of, self.predict_decay)
+                    for comp, pr in sorted(ar.predict.items())
+                },
+                "predict_unknown_schema": ar.predict_unknown_schema,
             }
 
     def dedupe_snapshot(self, agent_id: str) -> List[str]:
@@ -923,6 +1275,8 @@ class FleetRollupStore:
                     "duplicates_total": shard.duplicates_total,
                     "dedupe_keys": sum(len(d) for d in shard.dedupe.values()),
                     "ingest_lag_seconds": shard.ingest_lag,
+                    "predict_series": shard.predict_total,
+                    "predict_unknown_schema": shard.predict_unknown_total,
                 })
         return out
 

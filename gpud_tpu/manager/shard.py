@@ -101,6 +101,7 @@ class RollupShard:
     __slots__ = (
         "index", "lock", "agents", "dedupe",
         "records_total", "duplicates_total", "series_total", "ingest_lag",
+        "predict_total", "predict_unknown_total",
     )
 
     # counters (records_total etc.) are deliberately unguarded: plain
@@ -116,6 +117,8 @@ class RollupShard:
         self.duplicates_total = 0
         self.series_total = 0
         self.ingest_lag = 0.0
+        self.predict_total = 0
+        self.predict_unknown_total = 0
 
     def dedupe_keys(self) -> int:
         with self.lock:

@@ -51,12 +51,24 @@ from gpud_tpu.api.v1.types import (
 from gpud_tpu.components.base import _h_check_duration
 from gpud_tpu.log import get_logger
 from gpud_tpu.metrics.registry import counter, gauge, histogram
+from gpud_tpu.predict.calibrate import (
+    DEFAULT_CALIBRATE_INTERVAL,
+    DEFAULT_HORIZON,
+    DEFAULT_MARGIN,
+    DEFAULT_MIN_HISTORY,
+    DEFAULT_MIN_THRESHOLD,
+    PREDICT_SCHEMA,
+    ClassCalibration,
+    ThresholdCalibrator,
+    component_class,
+)
 from gpud_tpu.predict.features import (
     FEATURE_WEIGHTS,
     LatencyDrift,
     NgramNovelty,
     cadence_score,
     fuse,
+    peer_corroboration,
     trajectory_score,
 )
 from gpud_tpu.remediation.policy import (
@@ -97,6 +109,15 @@ _h_tick = histogram(
     "tpud_predict_tick_duration_seconds",
     "wall time of one full predict scan over every component",
 )
+_g_threshold = gauge(
+    "tpud_predict_threshold",
+    "effective warning threshold (calibrated per component class, or "
+    "the global default), by component",
+)
+_c_calibrations = counter(
+    "tpud_predict_calibrations_total",
+    "ledger-history calibration passes completed",
+)
 
 
 class _CompState:
@@ -135,10 +156,14 @@ class PredictEngine:
         "_st": "_mu",
         "_ticks": "_mu",
         "_last_tick": "_mu",
+        "_calib": "_mu",
+        "_last_calibrate": "_mu",
     }
     _LOCK_FREE = {
-        "_tick_component": "caller tick_once() holds _mu across the "
-                           "whole per-component scoring pass",
+        "_component_features": "caller tick_once() holds _mu across "
+                               "the whole scoring pass",
+        "_threshold_for": "callers hold _mu (tick pass / view methods)",
+        "_weights_for": "callers hold _mu (tick pass / view methods)",
     }
 
     def __init__(
@@ -157,6 +182,12 @@ class PredictEngine:
         history_limit: int = DEFAULT_HISTORY_LIMIT,
         warn_cooldown_seconds: float = DEFAULT_WARN_COOLDOWN,
         publish_interval_seconds: float = DEFAULT_PUBLISH_INTERVAL,
+        calibrate_enabled: bool = True,
+        calibrate_interval_seconds: float = DEFAULT_CALIBRATE_INTERVAL,
+        calibrate_min_history: int = DEFAULT_MIN_HISTORY,
+        calibrate_min_threshold: float = DEFAULT_MIN_THRESHOLD,
+        calibrate_margin: float = DEFAULT_MARGIN,
+        calibrate_horizon_seconds: float = DEFAULT_HORIZON,
     ) -> None:
         self.registry = registry
         self.ledger = ledger
@@ -172,6 +203,12 @@ class PredictEngine:
         self.history_limit = history_limit
         self.warn_cooldown = warn_cooldown_seconds
         self.publish_interval = publish_interval_seconds
+        self.calibrate_enabled = calibrate_enabled
+        self.calibrate_interval = calibrate_interval_seconds
+        self.calibrate_min_history = max(1, int(calibrate_min_history))
+        self.calibrate_min_threshold = calibrate_min_threshold
+        self.calibrate_margin = calibrate_margin
+        self.calibrate_horizon = calibrate_horizon_seconds
         self.time_now_fn = time.time
         # optional score publisher (the server wires the session outbox
         # here); must never fail the tick
@@ -183,7 +220,10 @@ class PredictEngine:
         self._st: Dict[str, _CompState] = {}
         self._ticks = 0
         self._last_tick: Optional[float] = None
+        self._calib: Dict[str, ClassCalibration] = {}
+        self._last_calibrate: Optional[float] = None
         self._job = None  # scheduler Job when scheduler-driven
+        self._calib_job = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self, scheduler=None) -> None:
@@ -199,6 +239,16 @@ class PredictEngine:
                 interval=self.interval,
                 initial_delay=self.interval,
             )
+        if self.calibrate_enabled and self._calib_job is None:
+            # first fit runs one scan-interval after boot (the ledger's
+            # persisted history is already there), then re-fits on the
+            # calibrate cadence as new history accrues
+            self._calib_job = scheduler.add_job(
+                "predict-calibrate",
+                self.calibrate_now,
+                interval=self.calibrate_interval,
+                initial_delay=self.interval,
+            )
 
     def poke(self) -> None:
         """Scan now: poke the scheduler job, or tick synchronously when
@@ -212,6 +262,9 @@ class PredictEngine:
         if self._job is not None:
             self._job.cancel()
             self._job = None
+        if self._calib_job is not None:
+            self._calib_job.cancel()
+            self._calib_job = None
 
     def reset(self, component: str = "") -> None:
         """Drop the in-memory scorer state (one component, or all) and
@@ -244,18 +297,84 @@ class PredictEngine:
                 logger.exception("predict: registry walk failed")
         out: Dict[str, float] = {}
         with self._mu:
+            # pass 1: per-component base features + base score (no
+            # co-occurrence yet — cooccur needs every peer's base)
+            staged: List[tuple] = []
+            bases: Dict[str, float] = {}
             for name in names:
                 try:
-                    out[name] = self._tick_component(name, now)
+                    st, features, transitions = self._component_features(
+                        name, now
+                    )
                 except Exception:  # noqa: BLE001 — one component's
                     # featurizer bug must not end prediction for the rest
+                    logger.exception("predict tick failed for %s", name)
+                    continue
+                bases[name] = fuse(features, self._weights_for(name))
+                staged.append((name, st, features, transitions))
+            # pass 2: cross-component co-occurrence, then fuse + hysteresis
+            fab = self.fabric
+            fabric_comp = (
+                getattr(fab, "component_name", None)
+                if fab is not None else None
+            )
+            for name, st, features, transitions in staged:
+                try:
+                    co = peer_corroboration(
+                        name, bases,
+                        self._cooccur_peers(name, bases, fabric_comp),
+                    )
+                    if co > 0.0:
+                        features["cooccur"] = co
+                    out[name] = self._score_component(
+                        name, st, features, transitions, now, bases[name]
+                    )
+                except Exception:  # noqa: BLE001
                     logger.exception("predict tick failed for %s", name)
             self._ticks += 1
             self._last_tick = now
         _h_tick.observe(time.monotonic() - t0)
         return out
 
-    def _tick_component(self, name: str, now: float) -> float:
+    @staticmethod
+    def _cooccur_peers(
+        name: str, bases: Dict[str, float], fabric_comp: Optional[str]
+    ) -> List[str]:
+        """Adjacency for cross-component co-occurrence: siblings of the
+        same component class always corroborate each other; accelerator
+        components and the ICI fabric component corroborate both ways
+        (they share the physical fabric the PR-16 link adjacency maps —
+        a precursor on an ICI-adjacent link and a precursor on the chip
+        behind it are one story, not two)."""
+        cls = component_class(name)
+        peers = [
+            p for p in bases
+            if p != name and component_class(p) == cls
+        ]
+        accel = name.startswith("accelerator")
+        if fabric_comp is not None and name != fabric_comp and accel:
+            peers.append(fabric_comp)
+        elif fabric_comp is not None and name == fabric_comp:
+            peers.extend(
+                p for p in bases
+                if p != name and p.startswith("accelerator")
+            )
+        return peers
+
+    def _threshold_for(self, name: str) -> float:
+        cal = self._calib.get(component_class(name))
+        if cal is not None and cal.source == "calibrated":
+            return cal.threshold
+        return self.threshold
+
+    def _weights_for(self, name: str) -> Optional[Dict[str, float]]:
+        cal = self._calib.get(component_class(name))
+        if cal is not None and cal.source == "calibrated":
+            return cal.weights
+        return None
+
+    def _component_features(self, name: str, now: float):
+        """Base feature extraction for one component (no co-occurrence)."""
         st = self._st.get(name)
         if st is None:
             st = self._st[name] = _CompState(self.history_limit)
@@ -290,28 +409,41 @@ class PredictEngine:
                 features["fabric"] = fab.cooccurrence_score()
             except Exception:  # noqa: BLE001 — fabric must not fail the tick
                 features["fabric"] = 0.0
-        score = fuse(features)
+        return st, features, transitions
+
+    def _score_component(
+        self, name: str, st: _CompState, features: Dict[str, float],
+        transitions: List[Dict], now: float, base: float,
+    ) -> float:
+        # the base fusion already covered every feature unless pass 2
+        # added co-occurrence evidence; only re-fuse when it did
+        score = (
+            fuse(features, self._weights_for(name))
+            if "cooccur" in features else base
+        )
         st.score = score
         st.features = features
         st.history.append((now, score))
-        _g_score.set(score, labels)
+        _g_score.set(score, {"component": name})
+        thr = self._threshold_for(name)
+        _g_threshold.set(thr, {"component": name})
 
         # hysteresis: the dead band between (threshold - hysteresis) and
         # threshold resets both streaks, so a score dithering inside it
         # can neither arm nor clear — the no-flap property
-        if score >= self.threshold:
+        if score >= thr:
             st.above += 1
             st.below = 0
-        elif score <= self.threshold - self.hysteresis:
+        elif score <= thr - self.hysteresis:
             st.below += 1
             st.above = 0
         else:
             st.above = 0
             st.below = 0
         if not st.armed and st.above >= self.arm_ticks:
-            self._warn(name, st, now)
+            self._warn(name, st, now, thr)
         elif st.armed and st.below >= self.clear_ticks:
-            self._clear(name, st, now)
+            self._clear(name, st, now, thr)
         if st.armed:
             self._measure_lead(name, st, transitions)
             if self.ledger is not None:
@@ -349,7 +481,9 @@ class PredictEngine:
         return out
 
     # -- warning lifecycle -------------------------------------------------
-    def _warn(self, name: str, st: _CompState, now: float) -> None:
+    def _warn(
+        self, name: str, st: _CompState, now: float, thr: float
+    ) -> None:
         st.armed = True
         st.warned_at = now
         st.warn_score = st.score
@@ -362,15 +496,17 @@ class PredictEngine:
         )
         logger.warning(
             "predict: %s precursor score %.3f >= %.2f (%s)",
-            name, st.score, self.threshold, detail,
+            name, st.score, thr, detail,
         )
         if self.ledger is not None:
             self.ledger.set_annotation(name, "predicted", "true")
-        self._emit_event(name, st, now, detail)
-        self._audit(name, st, now, detail)
+        self._emit_event(name, st, now, detail, thr)
+        self._audit(name, st, now, detail, thr)
         self._publish(name, st, now, "warn")
 
-    def _clear(self, name: str, st: _CompState, now: float) -> None:
+    def _clear(
+        self, name: str, st: _CompState, now: float, thr: float
+    ) -> None:
         st.armed = False
         st.above = 0
         st.below = 0
@@ -380,7 +516,7 @@ class PredictEngine:
             self.ledger.clear_annotation(name, "predicted_score")
         logger.info(
             "predict: %s cleared (score %.3f <= %.3f)",
-            name, st.score, self.threshold - self.hysteresis,
+            name, st.score, thr - self.hysteresis,
         )
         self._publish(name, st, now, "clear")
 
@@ -416,7 +552,8 @@ class PredictEngine:
         self._publish(name, st, self.time_now_fn(), "lead")
 
     def _emit_event(
-        self, name: str, st: _CompState, now: float, detail: str
+        self, name: str, st: _CompState, now: float, detail: str,
+        thr: float,
     ) -> None:
         if self.event_store is None:
             return
@@ -429,11 +566,11 @@ class PredictEngine:
                     type=EventType.WARNING,
                     message=(
                         f"precursor score {st.score:.3f} crossed "
-                        f"{self.threshold:g} ({detail})"
+                        f"{thr:g} ({detail})"
                     ),
                     extra_info={
                         "score": f"{st.score:.3f}",
-                        "threshold": f"{self.threshold:g}",
+                        "threshold": f"{thr:g}",
                         **{
                             k: f"{v:.3f}"
                             for k, v in sorted(st.features.items())
@@ -445,7 +582,8 @@ class PredictEngine:
             logger.exception("predict event emit failed for %s", name)
 
     def _audit(
-        self, name: str, st: _CompState, now: float, detail: str
+        self, name: str, st: _CompState, now: float, detail: str,
+        thr: float,
     ) -> None:
         """Dry-run audit row in the predict lane. Never consults the
         enforce allowlist and never executes anything: the suggestion is
@@ -467,7 +605,7 @@ class PredictEngine:
                 suggested=RepairActionType.PREDICTED_DEGRADATION,
                 trigger_health=HealthStateType.DEGRADED,
                 trigger_reason=(
-                    f"precursor score {st.score:.3f} >= {self.threshold:g}"
+                    f"precursor score {st.score:.3f} >= {thr:g}"
                 ),
                 decision=DECISION_DRY_RUN,
                 outcome=OUTCOME_DRY_RUN,
@@ -486,10 +624,17 @@ class PredictEngine:
         st.last_publish = now
         try:
             hook({
+                # versioned payload (satellite of PR 17): the manager
+                # ingests any schema <= PREDICT_SCHEMA and counts-but-
+                # keeps newer ones, so a mixed-version fleet degrades to
+                # accounting, never silent drops
+                "schema": PREDICT_SCHEMA,
                 "component": name,
+                "component_class": component_class(name),
                 "event": kind,
                 "ts": now,
                 "score": round(st.score, 4),
+                "threshold": round(self._threshold_for(name), 4),
                 "features": {
                     k: round(v, 4) for k, v in sorted(st.features.items())
                 },
@@ -499,6 +644,67 @@ class PredictEngine:
             })
         except Exception:  # noqa: BLE001
             logger.exception("predict publish hook failed")
+
+    # -- calibration -------------------------------------------------------
+    def calibrate_now(self) -> Dict:
+        """Fit per-class thresholds/weights by replaying the ledger's
+        persisted transition history (docs/predict.md). The DB read runs
+        outside ``_mu``; the fitted map swaps in atomically. Returns a
+        {classes, calibrated} summary (scheduler job + tests + bench)."""
+        if self.ledger is None:
+            return {"classes": 0, "calibrated": 0}
+        now = self.time_now_fn()
+        calibrator = ThresholdCalibrator(
+            ledger=self.ledger,
+            default_threshold=self.threshold,
+            window_seconds=self.window,
+            min_history=self.calibrate_min_history,
+            min_threshold=self.calibrate_min_threshold,
+            margin=self.calibrate_margin,
+            horizon_seconds=self.calibrate_horizon,
+        )
+        try:
+            fitted = calibrator.calibrate(now)
+        except Exception:  # noqa: BLE001 — calibration must never take
+            # down the scan job; stale thresholds beat no thresholds
+            logger.exception("predict calibration failed")
+            return {"classes": 0, "calibrated": 0}
+        with self._mu:
+            self._calib = fitted
+            self._last_calibrate = now
+        _c_calibrations.inc()
+        calibrated = sum(
+            1 for c in fitted.values() if c.source == "calibrated"
+        )
+        if calibrated:
+            logger.info(
+                "predict: calibrated %d/%d component classes from "
+                "ledger history", calibrated, len(fitted),
+            )
+        return {"classes": len(fitted), "calibrated": calibrated}
+
+    def calibration(self) -> Dict:
+        """Per-class fitted thresholds/weights + knobs + provenance —
+        the one view behind /v1/predict/calibration, the session verb,
+        SDK, and CLI."""
+        with self._mu:
+            classes = {
+                cls: cal.as_dict()
+                for cls, cal in sorted(self._calib.items())
+            }
+            last = self._last_calibrate
+        return {
+            "enabled": self.calibrate_enabled,
+            "schema": PREDICT_SCHEMA,
+            "default_threshold": self.threshold,
+            "interval_seconds": self.calibrate_interval,
+            "min_history": self.calibrate_min_history,
+            "min_threshold": self.calibrate_min_threshold,
+            "margin": self.calibrate_margin,
+            "horizon_seconds": self.calibrate_horizon,
+            "last_calibrate": last,
+            "classes": classes,
+        }
 
     # -- views -------------------------------------------------------------
     def scores(
@@ -517,6 +723,8 @@ class PredictEngine:
             for name, st in sorted(items.items()):
                 d = {
                     "score": round(st.score, 4),
+                    "component_class": component_class(name),
+                    "threshold": round(self._threshold_for(name), 4),
                     "features": {
                         k: round(v, 4)
                         for k, v in sorted(st.features.items())
@@ -549,6 +757,10 @@ class PredictEngine:
             tracked = len(self._st)
             ticks = self._ticks
             last_tick = self._last_tick
+            calibrated = sum(
+                1 for c in self._calib.values() if c.source == "calibrated"
+            )
+            last_calibrate = self._last_calibrate
         return {
             "enabled": self.enabled,
             "interval_seconds": self.interval,
@@ -559,6 +771,10 @@ class PredictEngine:
             "window_seconds": self.window,
             "warn_cooldown_seconds": self.warn_cooldown,
             "feature_weights": dict(FEATURE_WEIGHTS),
+            "schema": PREDICT_SCHEMA,
+            "calibrate_enabled": self.calibrate_enabled,
+            "classes_calibrated": calibrated,
+            "last_calibrate": last_calibrate,
             "ticks": ticks,
             "last_tick": last_tick,
             "components_tracked": tracked,

@@ -35,6 +35,10 @@ WEIGHT_NGRAM = 0.6
 # threshold for the same no-single-signal reason as latency drift — one
 # deviating link pair corroborates, it doesn't convict.
 WEIGHT_FABRIC = 0.55
+# cross-component co-occurrence: a peer component in the same class (or
+# a coupled fabric neighbor) scoring high at the same tick. Capped below
+# the threshold — corroboration only, never a conviction on its own.
+WEIGHT_COOCCUR = 0.5
 
 FEATURE_WEIGHTS: Dict[str, float] = {
     "latency": WEIGHT_LATENCY,
@@ -42,6 +46,7 @@ FEATURE_WEIGHTS: Dict[str, float] = {
     "trajectory": WEIGHT_TRAJECTORY,
     "ngram": WEIGHT_NGRAM,
     "fabric": WEIGHT_FABRIC,
+    "cooccur": WEIGHT_COOCCUR,
 }
 
 
@@ -51,18 +56,48 @@ def clamp01(x: float) -> float:
     return 0.0 if x < 0.0 else (1.0 if x > 1.0 else x)
 
 
-def fuse(features: Dict[str, float]) -> float:
+def fuse(
+    features: Dict[str, float],
+    weights: Optional[Dict[str, float]] = None,
+) -> float:
     """Weighted noisy-OR over per-feature evidence scores.
 
     ``1 - prod(1 - w_i * s_i)`` — monotone in every input, bounded [0, 1],
     and saturating: independent weak evidence accumulates, redundant
-    strong evidence doesn't overshoot.
+    strong evidence doesn't overshoot. ``weights`` overrides individual
+    defaults (the calibrator fits per-component-class weights; absent
+    keys fall back to :data:`FEATURE_WEIGHTS`).
     """
     acc = 1.0
     for name, s in features.items():
-        w = FEATURE_WEIGHTS.get(name, 0.5)
-        acc *= 1.0 - w * clamp01(s)
+        w = None if weights is None else weights.get(name)
+        if w is None:
+            w = FEATURE_WEIGHTS.get(name, 0.5)
+        acc *= 1.0 - clamp01(w) * clamp01(s)
     return clamp01(1.0 - acc)
+
+
+def peer_corroboration(
+    name: str, scores: Dict[str, float], peers: Iterable[str]
+) -> float:
+    """Cross-component co-occurrence evidence: the strongest *pair*
+    formed by this component and one adjacent peer, scored by the weaker
+    member — the same min-of-pair rule as :func:`neighbor_cooccurrence`,
+    lifted from links to components. One elevated component scores
+    nothing; two coupled components elevating together (the correlated-
+    precursor pattern across a shared fabric) score as the weaker of the
+    two. Inputs are [0, 1] base scores; output is [0, 1]."""
+    own = scores.get(name, 0.0)
+    if own <= 0.0:
+        return 0.0
+    best = 0.0
+    for peer in peers:
+        if peer == name:
+            continue
+        pair = min(own, scores.get(peer, 0.0))
+        if pair > best:
+            best = pair
+    return clamp01(best)
 
 
 def neighbor_cooccurrence(

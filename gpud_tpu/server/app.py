@@ -339,6 +339,18 @@ def build_app(srv: "Server") -> web.Application:
         out["status"] = eng.status()
         return _json(out)
 
+    async def predict_calibration(req: web.Request) -> web.Response:
+        """Threshold calibration state (docs/predict.md): per-class
+        fitted thresholds/weights replayed from the node's own ledger
+        history, with provenance (calibrated vs thin-history default).
+        ?refit=1 re-fits synchronously before answering."""
+        eng = srv.predictor
+        if eng is None:
+            return _json({"error": "predict engine disabled"}, 404)
+        if req.query.get("refit", "") in ("1", "true"):
+            await _run_blocking(srv, eng.calibrate_now)
+        return _json(eng.calibration())
+
     async def fabric_matrix(req: web.Request) -> web.Response:
         """Fabric observability (docs/fabric.md): discovered mesh, sweep
         status, and the current per-link (src_chip, dst_chip, axis,
@@ -640,6 +652,7 @@ def build_app(srv: "Server") -> web.Application:
     r.add_get("/v1/states", states)
     r.add_get("/v1/states/history", states_history)
     r.add_get("/v1/predict/scores", predict_scores)
+    r.add_get("/v1/predict/calibration", predict_calibration)
     r.add_get("/v1/fabric", fabric_matrix)
     r.add_get("/v1/remediation/audit", remediation_audit)
     r.add_get("/v1/remediation/policy", remediation_policy_get)

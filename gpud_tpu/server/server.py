@@ -164,6 +164,20 @@ class Server:
                 publish_interval_seconds=float(
                     self.config.predict_publish_interval_seconds
                 ),
+                calibrate_enabled=bool(
+                    self.config.predict_calibrate_enabled
+                ),
+                calibrate_interval_seconds=float(
+                    self.config.predict_calibrate_interval_seconds
+                ),
+                calibrate_min_history=self.config.predict_calibrate_min_history,
+                calibrate_min_threshold=float(
+                    self.config.predict_calibrate_min_threshold
+                ),
+                calibrate_margin=float(self.config.predict_calibrate_margin),
+                calibrate_horizon_seconds=float(
+                    self.config.predict_calibrate_horizon_seconds
+                ),
             )
 
         # metrics pipeline (reference: server.go:223-242)

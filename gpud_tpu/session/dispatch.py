@@ -186,6 +186,18 @@ class Dispatcher:
         out["status"] = eng.status()
         return out
 
+    def _m_predictCalibration(self, req: Dict) -> Dict:
+        """Threshold-calibration state for the control plane: per-class
+        fitted thresholds/weights replayed from the node's own ledger
+        history (``refit`` re-fits synchronously first) — the session
+        twin of ``GET /v1/predict/calibration``."""
+        eng = getattr(self.server, "predictor", None)
+        if eng is None:
+            return {"error": "predict engine disabled"}
+        if bool(req.get("refit")):
+            eng.calibrate_now()
+        return eng.calibration()
+
     def _m_fabricStatus(self, req: Dict) -> Dict:
         """Fabric plane rollup for the control plane: discovered mesh +
         sweep state + the current per-link matrix (``link``/``since``/

@@ -49,6 +49,7 @@ DISPATCH_TO_SDK: Dict[str, Tuple[Optional[str], str]] = {
     "events": ("get_events", ""),
     "stateHistory": ("get_state_history", ""),
     "predictStatus": ("get_predict_scores", ""),
+    "predictCalibration": ("get_predict_calibration", ""),
     "fabricStatus": ("get_fabric", ""),
     "remediationStatus": ("get_remediation_audit", ""),
     "remediationPolicy": ("get_remediation_policy", ""),

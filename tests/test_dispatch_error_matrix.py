@@ -105,6 +105,11 @@ MATRIX = [
     ("predictStatus", {"history": "lots"}, "error"),
     ("predictStatus", {"history": 4}, "ok"),
     ("predictStatus", {"component": "no-such-component"}, "ok"),
+    # calibration: view always serves; refit of any truthiness is a
+    # synchronous re-fit, never an error
+    ("predictCalibration", {}, "ok"),
+    ("predictCalibration", {"refit": True}, "ok"),
+    ("predictCalibration", {"refit": "yes"}, "ok"),
     # fabric: bad numeric filter types error; an unknown link just
     # returns empty history alongside the live matrix
     ("fabricStatus", {}, "ok"),

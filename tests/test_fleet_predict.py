@@ -1,0 +1,446 @@
+"""Fleet-ranked predictive observability (manager/rollup.py predict leg).
+
+Covers the predict→fleet loop contracts: ``predict_score`` ingest into
+first-class per-(agent, component) aggregates, schema versioning
+(newer-schema records journaled + counted, never applied), the ranked
+``fleet_predict`` pane (top-K by decayed risk, deterministic for an
+explicit ``now`` across any shard count), stale-score decay, windowed
+link-degradation counters on the fabric pane, SIGKILL-mid-ingest
+rebuild consistency, and the live ``GET /v1/fleet/predict`` route."""
+
+import json
+import os
+import sqlite3
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gpud_tpu.manager.rollup import (
+    DEFAULT_PREDICT_DECAY,
+    MAX_PREDICT_PER_AGENT,
+    PREDICT_SCHEMA_MAX,
+    TABLE,
+    FleetRollupStore,
+)
+from gpud_tpu.sqlite import DB
+from gpud_tpu.storage.writer import BatchWriter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _transition(seq, ts, comp="c0", frm="Healthy", to="Unhealthy"):
+    return (
+        seq, ts, "transition", f"transition:{comp}:{ts}:{to}",
+        {"component": comp, "from": frm, "to": to, "ts": ts, "reason": "x"},
+    )
+
+
+def _predict(seq, ts, comp="accelerator-tpu-0", event="snapshot",
+             score=0.5, armed=False, schema=1, **extra):
+    body = {
+        "schema": schema,
+        "component": comp,
+        "component_class": "accelerator-tpu",
+        "event": event,
+        "ts": ts,
+        "score": score,
+        "threshold": 0.6,
+        "features": {"cadence": score * 0.7},
+        "armed": armed,
+    }
+    body.update(extra)
+    return (
+        seq, ts, "predict_score",
+        f"predict:{comp}:{event}:{ts}", body,
+    )
+
+
+def _link(seq, ts, link="c0-c1/x", state="degraded"):
+    return (
+        seq, ts, "ici_link", f"ici_link:{link}:{ts}",
+        {"link": link, "src_chip": 0, "dst_chip": 1, "axis": "x",
+         "state": state, "deviation": 0.5, "ts": ts},
+    )
+
+
+@pytest.fixture()
+def store(tmp_path):
+    db = DB(str(tmp_path / "fleet.db"))
+    writer = BatchWriter(db)
+    st = FleetRollupStore(db, writer)
+    yield st
+    writer.close()
+    db.close()
+
+
+# -- predict_score ingest -------------------------------------------------
+
+def test_predict_ingest_aggregates(store):
+    t = 1000.0
+    store.ingest("a1", [
+        _predict(1, t, score=0.3),
+        _predict(2, t + 10, event="warn", score=0.7, armed=True,
+                 warned_at=t + 10),
+        _predict(3, t + 20, event="lead", score=0.8, armed=True,
+                 warned_at=t + 10, lead_seconds=10.0),
+        _predict(4, t + 30, event="clear", score=0.1),
+    ])
+    snap = store.agent_snapshot("a1")
+    pr = snap["predict"]["accelerator-tpu-0"]
+    assert pr["warn_count"] == 1
+    assert pr["clear_count"] == 1
+    assert pr["snapshot_count"] == 1
+    assert pr["lead"]["count"] == 1
+    assert pr["lead"]["mean_seconds"] == 10.0
+    assert pr["lead"]["p50_seconds"] == 10.0
+    # latest-wins fields follow the newest record
+    assert pr["last_event"] == "clear"
+    assert pr["score"] == pytest.approx(0.1)
+    assert not pr["armed"]
+    assert pr["component_class"] == "accelerator-tpu"
+    assert snap["records_by_kind"]["predict_score"] == 4
+
+
+def test_predict_replay_is_idempotent(store):
+    t = 1000.0
+    recs = [_predict(1, t, event="warn", score=0.7, armed=True)]
+    assert store.ingest("a1", recs) == 1
+    assert store.ingest("a1", recs) == 0
+    pr = store.agent_snapshot("a1")["predict"]["accelerator-tpu-0"]
+    assert pr["warn_count"] == 1
+
+
+def test_unknown_schema_counted_never_dropped(store):
+    """A newer-schema record from a newer agent is journaled and
+    surfaced as accounting — not applied, not silently dropped."""
+    t = 1000.0
+    store.ingest("a1", [
+        _predict(1, t, score=0.4),
+        _predict(2, t + 1, event="warn", score=1.0,
+                 schema=PREDICT_SCHEMA_MAX + 1),
+    ])
+    assert store.journal_count() == 2  # both journaled
+    snap = store.agent_snapshot("a1")
+    assert snap["predict_unknown_schema"] == 1
+    pr = snap["predict"]["accelerator-tpu-0"]
+    assert pr["warn_count"] == 0  # the future-schema warn never applied
+    assert pr["score"] == pytest.approx(0.4)
+    pane = store.fleet_predict(now=t + 2)
+    assert pane["unknown_schema_records"] == 1
+    # records_total still counts it: counted, never dropped
+    assert store.fleet_rollup()["records_total"] == 2
+
+
+def test_predict_series_cap_truncates(store):
+    t = 1000.0
+    recs = [
+        _predict(i + 1, t + i, comp=f"c{i}")
+        for i in range(MAX_PREDICT_PER_AGENT + 5)
+    ]
+    store.ingest("a1", recs)
+    snap = store.agent_snapshot("a1")
+    assert len(snap["predict"]) == MAX_PREDICT_PER_AGENT
+    pane = store.fleet_predict(now=t)
+    assert pane["predict_truncated"] == 5
+
+
+# -- ranking + decay ------------------------------------------------------
+
+def _seed_fleet(store, t=1000.0):
+    store.ingest("quiet-1", [_predict(1, t, score=0.05)])
+    store.ingest("quiet-2", [_predict(1, t, score=0.1)])
+    store.ingest("loud-1", [
+        _predict(1, t, event="warn", score=0.8, armed=True, warned_at=t),
+        _predict(2, t + 5, event="lead", score=0.85, armed=True,
+                 warned_at=t, lead_seconds=5.0),
+    ])
+    return t
+
+
+def test_fleet_predict_ranks_by_risk(store):
+    t = _seed_fleet(store)
+    pane = store.fleet_predict(top=2, now=t + 10)
+    assert pane["series"] == 3
+    assert pane["armed"] == 1
+    assert pane["warns_total"] == 1
+    assert pane["top_k"] == 2
+    assert [r["agent"] for r in pane["top"]] == ["loud-1", "quiet-2"]
+    assert pane["top"][0]["risk"] > pane["top"][1]["risk"]
+    assert pane["lead"]["count"] == 1
+    assert pane["lead"]["mean_seconds"] == 5.0
+    # risk buckets partition every series
+    assert sum(pane["risk_buckets"].values()) == 3
+
+
+def test_stale_scores_decay(store):
+    t = _seed_fleet(store)
+    fresh = store.fleet_predict(now=t + 10)["top"][0]["risk"]
+    stale = store.fleet_predict(
+        now=t + 10 + 3 * DEFAULT_PREDICT_DECAY
+    )["top"][0]["risk"]
+    assert stale < fresh * 0.1  # three e-foldings down
+    # decay is monotone: a dead agent keeps sinking
+    deader = store.fleet_predict(
+        now=t + 10 + 6 * DEFAULT_PREDICT_DECAY
+    )["top"][0]["risk"]
+    assert deader < stale
+
+
+def test_explicit_now_bypasses_cache(store):
+    t = _seed_fleet(store)
+    a = store.fleet_predict(now=t + 1)
+    b = store.fleet_predict(now=t + 1000)
+    assert a["top"][0]["risk"] != b["top"][0]["risk"]
+
+
+def test_top_clamping(store):
+    _seed_fleet(store)
+    assert store.fleet_predict(top=0, now=2000.0)["top_k"] == 1
+    assert store.fleet_predict(top=10 ** 6, now=2000.0)["top_k"] == 500
+
+
+@pytest.mark.parametrize("shards", [1, 2, 8])
+def test_ranking_deterministic_across_shard_counts(tmp_path, shards):
+    """The pane for a fixed ``now`` is byte-identical however the
+    in-memory state is striped — ranking must be a pure function of
+    the journal."""
+    db = DB(str(tmp_path / "fleet.db"))
+    writer = BatchWriter(db)
+    st = FleetRollupStore(db, writer, shard_count=4)
+    t = 1000.0
+    for i in range(12):
+        st.ingest(f"m-{i:02d}", [
+            _predict(1, t + i, comp=f"accelerator-tpu-{i % 3}",
+                     event="warn" if i % 4 == 0 else "snapshot",
+                     score=(i * 7 % 10) / 10.0, armed=i % 4 == 0),
+            _predict(2, t + i + 1, comp=f"accelerator-tpu-{i % 3}",
+                     event="lead" if i % 4 == 0 else "snapshot",
+                     score=(i * 3 % 10) / 10.0, lead_seconds=float(i)),
+        ])
+    writer.flush()
+    baseline = st.fleet_predict(top=50, now=t + 100)
+    baseline.pop("generation")
+    for n in ([shards] if shards != 1 else [1]):
+        re = FleetRollupStore(db, None, shard_count=n)
+        pane = re.fleet_predict(top=50, now=t + 100)
+        pane.pop("generation")
+        assert json.dumps(pane, sort_keys=True) == json.dumps(
+            baseline, sort_keys=True
+        ), f"pane diverged at shard_count={n}"
+    writer.close()
+    db.close()
+
+
+def test_agents_page_exposes_predict_risk(store):
+    t = _seed_fleet(store)
+    page = store.agents_page()
+    by_agent = {a["agent"]: a for a in page["agents"]}
+    assert by_agent["loud-1"]["predict_risk"] > 0.5
+    assert by_agent["quiet-1"]["predict_risk"] < 0.3
+    # anchored at the agent's own last_ts: a pure function of the
+    # journal, so pagination stays rebuild-deterministic
+    pr = by_agent["loud-1"]["predict"]["accelerator-tpu-0"]
+    assert pr["age_seconds"] == 0.0
+
+
+# -- windowed link history ------------------------------------------------
+
+def test_link_degraded_windows(store):
+    t = 1_000_000.0
+    recs = []
+    seq = 0
+    # 3 in the last hour, 2 more within 24h, 1 more within 7d
+    for dt in (30.0, 600.0, 3000.0, 7200.0, 50_000.0, 500_000.0):
+        seq += 1
+        recs.append(_link(seq, t - dt))
+    seq += 1
+    recs.append(_link(seq, t, state="up"))
+    store.ingest("a1", recs)
+    pane = store.fleet_fabric(now=t)
+    (row,) = [
+        l for l in pane["degraded"] if l["link"] == "c0-c1/x"
+    ]
+    assert row["degraded_windows"] == {"1h": 3, "24h": 5, "7d": 6}
+    # the window anchor slides with now: an hour later the 1h bucket
+    # drains but history is not lost
+    pane2 = store.fleet_fabric(now=t + 3600.0)
+    (row2,) = [
+        l for l in pane2["degraded"] if l["link"] == "c0-c1/x"
+    ]
+    assert row2["degraded_windows"]["1h"] == 0
+    assert row2["degraded_windows"]["7d"] == 6
+
+
+def test_link_windows_rebuild_parity(tmp_path):
+    db = DB(str(tmp_path / "fleet.db"))
+    writer = BatchWriter(db)
+    st = FleetRollupStore(db, writer)
+    t = 1_000_000.0
+    st.ingest("a1", [
+        _link(i + 1, t - i * 4000.0) for i in range(10)
+    ])
+    writer.flush()
+    before = st.fleet_fabric(now=t)
+    before.pop("generation")
+    for n in (1, 2, 8):
+        re = FleetRollupStore(db, None, shard_count=n)
+        after = re.fleet_fabric(now=t)
+        after.pop("generation")
+        assert json.dumps(after, sort_keys=True) == json.dumps(
+            before, sort_keys=True
+        ), f"fabric pane diverged at shard_count={n}"
+    writer.close()
+    db.close()
+
+
+# -- crash consistency ----------------------------------------------------
+
+def test_sigkill_mid_predict_ingest_rebuilds_consistently(tmp_path):
+    """Hard-kill a writer streaming predict_score records: the journal
+    may lose its last durability window, but the rebuilt predictive
+    aggregates must agree exactly with the surviving rows."""
+    db_path = str(tmp_path / "fleet.db")
+    script = f"""
+from gpud_tpu.manager.rollup import FleetRollupStore
+from gpud_tpu.sqlite import DB
+from gpud_tpu.storage.writer import BatchWriter
+db = DB({db_path!r})
+w = BatchWriter(db)
+st = FleetRollupStore(db, w)
+seq = 0
+while True:
+    seq += 1
+    ts = 1000.0 + seq
+    ev = "warn" if seq % 3 == 0 else "snapshot"
+    st.ingest("a1", [(seq, ts, "predict_score",
+                      f"predict:c0:{{ev}}:{{ts}}",
+                      {{"schema": 1, "component": "c0", "event": ev,
+                        "ts": ts, "score": 0.5, "armed": ev == "warn"}})])
+    if seq % 50 == 0:
+        w.flush()
+    if seq == 100:
+        print("primed", flush=True)
+"""
+    proc = subprocess.Popen(
+        [sys.executable, "-c", script],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, cwd=REPO,
+    )
+    try:
+        line = proc.stdout.readline()
+        assert "primed" in line, "writer subprocess never primed"
+        time.sleep(0.2)
+    finally:
+        proc.kill()
+        proc.wait(timeout=10)
+    con = sqlite3.connect(db_path)
+    try:
+        (res,) = con.execute("PRAGMA integrity_check").fetchone()
+        assert res == "ok", res
+        (journaled,) = con.execute(
+            f"SELECT COUNT(*) FROM {TABLE}"
+        ).fetchone()
+    finally:
+        con.close()
+    assert journaled >= 50
+    db = DB(db_path)
+    try:
+        st = FleetRollupStore(db, None)
+        assert st.fleet_rollup()["records_total"] == journaled
+        pr = st.agent_snapshot("a1")["predict"]["c0"]
+        # every journaled row applied exactly once: counters add up
+        assert pr["warn_count"] == journaled // 3
+        assert pr["snapshot_count"] == journaled - journaled // 3
+        pane = st.fleet_predict(now=1000.0 + journaled)
+        assert pane["series"] == 1
+        assert pane["warns_total"] == journaled // 3
+    finally:
+        db.close()
+
+
+# -- mixed-kind interplay -------------------------------------------------
+
+def test_predict_rides_alongside_transitions(store):
+    t = 1000.0
+    store.ingest("a1", [
+        _transition(1, t),
+        _predict(2, t + 1, event="warn", score=0.7, armed=True),
+        _transition(3, t + 2, frm="Unhealthy", to="Healthy"),
+    ])
+    roll = store.fleet_rollup()
+    assert roll["records_total"] == 3
+    assert roll["records_by_kind"]["predict_score"] == 1
+    snap = store.agent_snapshot("a1")
+    assert snap["components"]["c0"]["transitions"] == 2
+    assert snap["predict"]["accelerator-tpu-0"]["warn_count"] == 1
+
+
+def test_shard_stats_count_predict_series(store):
+    _seed_fleet(store)
+    stats = store.shard_stats()
+    assert sum(s["predict_series"] for s in stats) == 3
+    assert sum(s["predict_unknown_schema"] for s in stats) == 0
+
+
+# -- live HTTP surface ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def predict_cp():
+    requests = pytest.importorskip("requests")
+    from gpud_tpu.manager.control_plane import ControlPlane
+
+    cp = ControlPlane()
+    cp.start()
+    t = time.time()
+    cp.rollup.ingest("pred-m1", [
+        (1, t, "predict_score", f"predict:c0:warn:{t}",
+         {"schema": 1, "component": "c0", "component_class": "c",
+          "event": "warn", "ts": t, "score": 0.75, "armed": True,
+          "warned_at": t}),
+        (2, t + 1, "predict_score", f"predict:c0:lead:{t + 1}",
+         {"schema": 1, "component": "c0", "component_class": "c",
+          "event": "lead", "ts": t + 1, "score": 0.8, "armed": True,
+          "warned_at": t, "lead_seconds": 42.0}),
+    ])
+    yield cp, requests
+    cp.stop()
+
+
+def test_http_fleet_predict(predict_cp):
+    cp, requests = predict_cp
+    pane = requests.get(
+        f"{cp.endpoint}/v1/fleet/predict", timeout=10
+    ).json()
+    assert pane["series"] == 1
+    assert pane["warns_total"] == 1
+    assert pane["lead"]["count"] == 1
+    assert pane["lead"]["mean_seconds"] == 42.0
+    (row,) = pane["top"]
+    assert row["agent"] == "pred-m1"
+    assert row["component"] == "c0"
+    assert row["armed"]
+    assert row["risk"] > 0.5
+
+
+def test_http_fleet_predict_top_param(predict_cp):
+    cp, requests = predict_cp
+    pane = requests.get(
+        f"{cp.endpoint}/v1/fleet/predict?top=1", timeout=10
+    ).json()
+    assert pane["top_k"] == 1
+    r = requests.get(
+        f"{cp.endpoint}/v1/fleet/predict?top=zap", timeout=10
+    )
+    assert r.status_code == 400
+
+
+def test_federated_metrics_include_predict(predict_cp):
+    cp, requests = predict_cp
+    body = requests.get(f"{cp.endpoint}/metrics", timeout=10).text
+    assert "tpud_fleet_predict_armed_series 1" in body
+    assert "tpud_fleet_predict_warns 1" in body
+    assert "tpud_fleet_predict_lead_mean_seconds 42" in body
+    assert 'tpud_fleet_agent_predict_risk{agent="pred-m1"}' in body
+    assert "tpud_fleet_predict_series 1" in body

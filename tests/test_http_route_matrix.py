@@ -44,6 +44,7 @@ ROUTES_GET = [
     "/machine-info", "/admin/config", "/admin/packages",
     "/v1/components/trigger-check?componentName=cpu",
     "/v1/predict/scores", "/v1/predict/scores?component=cpu&history=4",
+    "/v1/predict/calibration",
     "/v1/fabric", "/v1/fabric?link=c0-c1/x&limit=4",
     "/v1/states/history", "/v1/remediation/audit", "/v1/remediation/policy",
     "/v1/chaos/campaigns", "/v1/session/status", "/v1/debug/traces",

@@ -295,7 +295,8 @@ def _engine(clock, scripted_scores=None, monkeypatch=None, **kw):
     if scripted_scores is not None:
         it = iter(scripted_scores)
         monkeypatch.setattr(
-            "gpud_tpu.predict.engine.fuse", lambda features: next(it)
+            "gpud_tpu.predict.engine.fuse",
+            lambda features, weights=None: next(it),
         )
     return eng
 

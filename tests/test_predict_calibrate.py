@@ -1,0 +1,390 @@
+"""Learned per-component-class thresholds (predict/calibrate.py).
+
+Covers the calibration contracts: component-class mapping, thin-history
+fallback to defaults, the zero-historical-false-positive guarantee
+(threshold strictly above every benign replay sample), earlier warnings
+than the global default on a precursor ramp, noisy-feature weight
+scaling with its floor, the engine integration (periodic refit job,
+per-class threshold/weight lookup, calibration view, versioned publish
+payload), and cross-component co-occurrence corroboration."""
+
+import time
+
+import pytest
+
+from gpud_tpu.predict.calibrate import (
+    DEFAULT_MIN_HISTORY,
+    MIN_WEIGHT_FRACTION,
+    PREDICT_SCHEMA,
+    ClassCalibration,
+    ThresholdCalibrator,
+    component_class,
+)
+from gpud_tpu.predict.features import (
+    FEATURE_WEIGHTS,
+    cadence_score,
+    fuse,
+    peer_corroboration,
+    trajectory_score,
+)
+
+
+# -- component_class ------------------------------------------------------
+
+@pytest.mark.parametrize("name,cls", [
+    ("accelerator-tpu-3", "accelerator-tpu"),
+    ("accelerator-tpu-temperature", "accelerator-tpu-temperature"),
+    ("tpu-hbm", "tpu-hbm"),
+    ("disk0", "disk"),
+    ("cpu", "cpu"),
+    ("c0", "c"),
+    ("42", "42"),  # all-digits: its own class, never empty
+])
+def test_component_class(name, cls):
+    assert component_class(name) == cls
+
+
+# -- synthetic ledgers ----------------------------------------------------
+
+class _Ledger:
+    flap_threshold = 5
+
+    def __init__(self, rows):
+        self._rows = sorted(rows, key=lambda r: r["time"])
+
+    def history(self):
+        return list(reversed(self._rows))  # newest-first, like the real one
+
+
+def _row(comp, t, frm, to):
+    return {"component": comp, "time": t, "from": frm, "to": to,
+            "reason": "r"}
+
+
+def _benign_rows(comp="accelerator-tpu-1", t0=1_000_000.0, blips=12):
+    """Quiet history: sparse restart-recovery transitions hours apart,
+    never within a window of each other, never near an Unhealthy —
+    the benign replay scores stay near the noise floor."""
+    return [
+        _row(comp, t0 + d * 7200.0, "Initializing", "Healthy")
+        for d in range(blips)
+    ]
+
+
+def _ramp_rows(comp="accelerator-tpu-1", t0=2_000_000.0):
+    """Accelerating restart ramp ending in a hard failure: the cadence
+    feature climbs with the flap rate (trajectory stays quiet until the
+    end — restarts are not Degraded excursions), so fused scores walk
+    up THROUGH the calibrated band before crossing the global default."""
+    rows, t = [], t0
+    for gap in (200.0, 120.0, 80.0, 60.0, 45.0, 35.0, 25.0, 20.0):
+        rows.append(_row(comp, t, "Healthy", "Initializing"))
+        t += gap
+    rows.append(_row(comp, t, "Initializing", "Unhealthy"))
+    return rows, t
+
+
+# -- fitting --------------------------------------------------------------
+
+def test_thin_history_falls_back_to_defaults():
+    rows = _benign_rows(blips=(DEFAULT_MIN_HISTORY - 1) // 2)
+    cal = ThresholdCalibrator(_Ledger(rows)).calibrate(now=3_000_000.0)
+    c = cal["accelerator-tpu"]
+    assert c.source == "default"
+    assert c.threshold == 0.6
+    assert c.weights == FEATURE_WEIGHTS
+    assert c.samples < DEFAULT_MIN_HISTORY
+
+
+def test_calibrated_threshold_zero_historical_fps():
+    rows = _benign_rows()
+    ramp, _fail = _ramp_rows()
+    cal = ThresholdCalibrator(_Ledger(rows + ramp)).calibrate(
+        now=3_000_000.0
+    )["accelerator-tpu"]
+    assert cal.source == "calibrated"
+    # never raises the global bar, never sits at or below a benign sample
+    assert cal.threshold <= 0.6
+    assert cal.threshold > cal.benign_max
+    assert cal.benign_samples > 0
+
+
+def test_empty_ledger_and_no_ledger():
+    assert ThresholdCalibrator(None).calibrate(now=0.0) == {}
+    assert ThresholdCalibrator(_Ledger([])).calibrate(now=0.0) == {}
+
+
+def test_components_filter_restricts_classes():
+    rows = _benign_rows() + _benign_rows(comp="cpu")
+    cal = ThresholdCalibrator(_Ledger(rows)).calibrate(
+        now=3_000_000.0, components=["accelerator-tpu-1"]
+    )
+    assert set(cal) == {"accelerator-tpu"}
+
+
+def test_class_pools_members_history():
+    """Two thin members of one class calibrate together: the class pool
+    is what crosses min_history, not each instance alone."""
+    rows = _benign_rows(comp="accelerator-tpu-1", blips=4)
+    rows += _benign_rows(comp="accelerator-tpu-2", t0=1_500_000.0, blips=4)
+    cal = ThresholdCalibrator(_Ledger(rows)).calibrate(
+        now=3_000_000.0
+    )["accelerator-tpu"]
+    assert cal.components == 2
+    assert cal.samples == 8
+    assert cal.source == "calibrated"
+
+
+def _first_warn(rows, threshold, weights, window=600.0, saturation=5):
+    times = [r["time"] for r in rows]
+    seen = [(r["time"], r["from"], r["to"]) for r in rows]
+    for i, r in enumerate(rows):
+        feats = {
+            "cadence": cadence_score(times[:i + 1], r["time"], window,
+                                     saturation=saturation),
+            "trajectory": trajectory_score(r["to"], seen[:i + 1],
+                                           r["time"], window),
+        }
+        if fuse(feats, weights) >= threshold:
+            return r["time"]
+    return None
+
+
+def test_calibrated_warns_earlier_than_default_on_ramp():
+    """The whole point: on the same precursor ramp, the fitted
+    threshold crosses at least one transition before the global
+    default would — and still before the failure."""
+    benign = _benign_rows()
+    ramp, fail_ts = _ramp_rows()
+    rows = sorted(benign + ramp, key=lambda r: r["time"])
+    cal = ThresholdCalibrator(_Ledger(rows)).calibrate(
+        now=3_000_000.0
+    )["accelerator-tpu"]
+    assert cal.threshold < 0.6
+    warn_default = _first_warn(rows, 0.6, None)
+    warn_cal = _first_warn(rows, cal.threshold, cal.weights)
+    assert warn_cal is not None
+    assert warn_cal < fail_ts
+    assert warn_default is None or warn_cal < warn_default
+    # and the fitted threshold never fires on the benign prefix
+    assert _first_warn(benign, cal.threshold, cal.weights) is None
+
+
+def test_noisy_feature_weight_scaled_with_floor():
+    """A feature whose benign replay maximum could alone cross the
+    fitted threshold gets scaled down, but never below the floor."""
+    # tight benign flapping: high benign cadence scores
+    rows = []
+    t = 1_000_000.0
+    for d in range(10):
+        rows.append(_row("noisy-1", t, "Healthy", "Degraded"))
+        rows.append(_row("noisy-1", t + 5.0, "Degraded", "Healthy"))
+        t += 40.0
+    cal = ThresholdCalibrator(_Ledger(rows)).calibrate(
+        now=2_000_000.0
+    )["noisy"]
+    assert cal.source == "calibrated"
+    # a benign Degraded-blip class can never beat the global bar: the
+    # clamp only ever lowers, and a noisy benign_max pins it at 0.6
+    assert cal.threshold == 0.6
+    for f in ("cadence", "trajectory"):
+        assert cal.weights[f] < FEATURE_WEIGHTS[f]  # scaled down
+        assert cal.weights[f] >= FEATURE_WEIGHTS[f] * MIN_WEIGHT_FRACTION
+
+
+def test_class_calibration_as_dict_round():
+    c = ClassCalibration(0.5, {"cadence": 0.6})
+    d = c.as_dict()
+    assert d["threshold"] == 0.5
+    assert d["source"] == "default"
+    assert d["precursor_min"] is None
+
+
+# -- engine integration ---------------------------------------------------
+
+class _StubRegistry:
+    def __init__(self, *names):
+        self._names = list(names)
+
+    def names(self):
+        return list(self._names)
+
+
+class _EngineLedger:
+    """Both ledger faces the engine touches: ``history()`` for the
+    calibrator replay, ``recent_transitions``/``last_state`` for the
+    live scorer."""
+
+    flap_threshold = 5
+
+    def __init__(self):
+        self.rows = []
+        self.live = {}  # component -> (state, [transition dicts])
+        self.annotations = {}
+
+    def history(self):
+        return list(reversed(sorted(self.rows,
+                                    key=lambda r: r["time"])))
+
+    def recent_transitions(self, component, limit=0):
+        return list(self.live.get(component, (None, []))[1])
+
+    def last_state(self, component):
+        state = self.live.get(component, (None, []))[0]
+        return {"state": state, "since": 0.0} if state else None
+
+    def set_annotation(self, component, key, value):
+        self.annotations.setdefault(component, {})[key] = value
+
+    def clear_annotation(self, component, key):
+        self.annotations.get(component, {}).pop(key, None)
+
+
+def _mk_engine(*names, **kw):
+    from gpud_tpu.predict.engine import PredictEngine
+
+    led = _EngineLedger()
+    kw.setdefault("registry", _StubRegistry(*names))
+    eng = PredictEngine(ledger=led, **kw)
+    return eng, led
+
+
+def test_engine_calibrate_now_swaps_thresholds():
+    eng, led = _mk_engine()
+    benign = _benign_rows()
+    ramp, _ = _ramp_rows()
+    led.rows = benign + ramp
+    out = eng.calibrate_now()
+    assert out["calibrated"] >= 1
+    view = eng.calibration()
+    assert view["schema"] == PREDICT_SCHEMA
+    cls = view["classes"]["accelerator-tpu"]
+    assert cls["source"] == "calibrated"
+    assert cls["threshold"] < 0.6
+    # per-component lookup honors the fitted class
+    assert eng._threshold_for("accelerator-tpu-1") == pytest.approx(
+        cls["threshold"], abs=1e-4
+    )
+    # a class the fit never saw keeps the global default
+    assert eng._threshold_for("cpu") == eng.threshold
+
+
+def test_engine_thin_history_keeps_default_threshold():
+    eng, led = _mk_engine()
+    led.rows = _benign_rows(blips=2)
+    eng.calibrate_now()
+    view = eng.calibration()
+    assert view["classes"]["accelerator-tpu"]["source"] == "default"
+    assert eng._threshold_for("accelerator-tpu-1") == eng.threshold
+
+
+def test_engine_status_and_scores_carry_calibration():
+    eng, led = _mk_engine("accelerator-tpu-1")
+    led.rows = _benign_rows() + _ramp_rows()[0]
+    eng.calibrate_now()
+    st = eng.status()
+    assert st["schema"] == PREDICT_SCHEMA
+    assert st["calibrate_enabled"] is True
+    assert st["classes_calibrated"] >= 1
+    now = time.time()
+    led.live["accelerator-tpu-1"] = ("Degraded", [
+        {"time": now - 30.0, "from": "Healthy", "to": "Degraded"},
+    ])
+    eng.time_now_fn = lambda: now
+    eng.tick_once()
+    sc = eng.scores()["components"]["accelerator-tpu-1"]
+    assert sc["component_class"] == "accelerator-tpu"
+    assert sc["threshold"] == pytest.approx(
+        eng._threshold_for("accelerator-tpu-1"), abs=1e-6
+    )
+
+
+def test_publish_payload_is_versioned():
+    eng, led = _mk_engine("accelerator-tpu-1", arm_ticks=1,
+                          warn_cooldown_seconds=0.0)
+    got = []
+    eng.on_publish = lambda payload: got.append(payload)
+    now = time.time()
+    # flapping hard + sitting Degraded: fused score over the default bar
+    led.live["accelerator-tpu-1"] = ("Degraded", [
+        {"time": now - 50 + i * 10, "from": "Healthy", "to": "Degraded"}
+        for i in range(6)
+    ])
+    eng.time_now_fn = lambda: now
+    eng.tick_once()
+    assert got, "engine never published"
+    p = got[-1]
+    assert p["schema"] == PREDICT_SCHEMA
+    assert p["component"] == "accelerator-tpu-1"
+    assert p["component_class"] == "accelerator-tpu"
+    assert p["event"] == "warn"
+    assert p["armed"] is True
+    assert "threshold" in p and "features" in p and "score" in p
+
+
+def test_scheduler_jobs_registered_when_enabled():
+    from gpud_tpu.scheduler import Scheduler
+
+    eng, led = _mk_engine()
+    led.rows = _benign_rows() + _ramp_rows()[0]
+    sched = Scheduler()
+    try:
+        eng.start(sched)
+        names = set(sched.job_names())
+        assert "predict-scan" in names
+        assert "predict-calibrate" in names
+    finally:
+        eng.close()
+        sched.close()
+
+
+def test_calibrate_disabled_skips_job():
+    from gpud_tpu.scheduler import Scheduler
+
+    eng, _ = _mk_engine(calibrate_enabled=False)
+    sched = Scheduler()
+    try:
+        eng.start(sched)
+        assert "predict-calibrate" not in set(sched.job_names())
+    finally:
+        eng.close()
+        sched.close()
+
+
+# -- co-occurrence --------------------------------------------------------
+
+def test_peer_corroboration_pairwise_min():
+    scores = {"a-1": 0.8, "a-2": 0.5, "b": 0.0}
+    assert peer_corroboration("a-1", scores, ["a-2", "b"]) == 0.5
+    assert peer_corroboration("a-1", scores, ["b"]) == 0.0
+    assert peer_corroboration("b", scores, ["a-1"]) == 0.0  # own zero
+    assert peer_corroboration("a-1", scores, ["a-1"]) == 0.0  # self skip
+
+
+def test_cooccur_feature_raises_fused_score():
+    """Two same-class siblings elevated together score higher than one
+    alone — correlated precursors corroborate each other."""
+    base = {"cadence": 0.5}
+    alone = fuse(base)
+    together = fuse({**base, "cooccur": 0.5})
+    assert together > alone
+
+
+def test_engine_cooccur_peers():
+    from gpud_tpu.predict.engine import PredictEngine
+
+    peers = PredictEngine._cooccur_peers(
+        "accelerator-tpu-1",
+        {"accelerator-tpu-1": 0.5, "accelerator-tpu-2": 0.4,
+         "cpu": 0.9, "fabric": 0.3},
+        "fabric",
+    )
+    # same-class sibling + the fabric component; never the unrelated cpu
+    assert set(peers) == {"accelerator-tpu-2", "fabric"}
+    # the fabric component corroborates with every accelerator
+    fab_peers = PredictEngine._cooccur_peers(
+        "fabric",
+        {"accelerator-tpu-1": 0.5, "cpu": 0.9, "fabric": 0.3},
+        "fabric",
+    )
+    assert set(fab_peers) == {"accelerator-tpu-1"}
