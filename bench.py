@@ -2079,6 +2079,447 @@ def bench_fleet_socket(agents: int = FLEET_SOCKET_AGENTS,
     return 0 if ok else 1
 
 
+FLEET_FED_RECORDS_PER_AGENT = 60
+FLEET_FED_OVERLAP_RECORDS = 20      # redelivered tail: the dedupe proof
+FLEET_FED_FAILOVER_P95_MS = 3000.0  # per-agent reconnect+redeliver+ack at B
+FLEET_FED_SCATTER_P95_MS = 1000.0   # federated rollup pane p95, both-live
+# the one-dead pane poll runs DURING the failover re-ingest, when the
+# lone survivor carries the whole fleet's redeliver load plus the
+# adopted cohort's rollup — a cold-under-ingest read at double the
+# per-manager responsibility of the standalone bench's 500ms budget
+FLEET_FED_POST_P95_MS = 750.0
+FLEET_FED_ADOPT_MAX_S = 20.0        # SIGKILL → survivor finished adopt()
+
+
+def bench_fleet_socket_federated(
+    agents: int = FLEET_SOCKET_AGENTS,
+    records_per_agent: int = FLEET_FED_RECORDS_PER_AGENT,
+    shards: int = 0,
+) -> int:
+    """``--fleet --socket --managers 2`` mode: the HA tier end to end
+    (docs/fleet.md "Federation & failover"). Two REAL peered managers;
+    the agents split between them by the rendezvous hash; each cohort
+    streams over the live v2 gRPC Frame tunnel to its owner while the
+    survivor's federated ``/v1/fleet/rollup`` pane is polled under
+    ingest. At the midpoint the victim manager is torn down (ports drop
+    instantly — the in-process SIGKILL stand-in), its cohort fails over
+    to the survivor, and every failed-over agent re-sends its last
+    delivered tail before the new records (the at-least-once overlap a
+    real outbox replays). Gates:
+
+      - zero loss: the survivor's rollup ends at exactly
+        ``agents * records_per_agent`` unique records — the adopted
+        prefix, the deduped overlap, and the post-failover suffix;
+      - byte-identical survivor rebuild: the survivor's replica of the
+        victim's journal equals the victim's own rows, every column,
+        payload blobs included;
+      - failover reconnect p95: per failed-over agent, connect → Hello →
+        redeliver → final cumulative ack at the survivor (drivers are
+        simulated, so breaker detection time is the chaos scenario's
+        job — ``manager-failover.yaml`` — not this gate's);
+      - scatter-gather pane p95 both-live and with the dead peer marked
+        unreachable in the ``peers`` block (never silently absent);
+      - adoption latency from teardown to the rebuilt cohort."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+    import shutil
+    import threading
+
+    import grpc
+    import requests
+
+    from gpud_tpu.manager.control_plane import ControlPlane
+    from gpud_tpu.manager.peers import owner_of
+    from gpud_tpu.manager.rollup import TABLE as JOURNAL_TABLE
+    from gpud_tpu.session import wire
+    from gpud_tpu.session.v2 import session_pb2 as pb
+    from gpud_tpu.session.v2.client import METHOD
+
+    tmp = tempfile.mkdtemp(prefix="tpud-fleet-fed-")
+    concurrency = min(
+        int(os.environ.get("TPUD_BENCH_CONC", str(FLEET_SOCKET_CONCURRENCY))),
+        agents,
+    )
+    victim_id, survivor_id = "m-a", "m-b"
+    peer_ids = [victim_id, survivor_id]
+    cps = {}
+    for pid in peer_ids:
+        cp = ControlPlane(
+            instance_id=pid,
+            data_dir=os.path.join(tmp, pid),
+            shards=shards or None,
+            max_v2_agents=concurrency + 16,
+        )
+        cp.start()
+        cps[pid] = cp
+    specs = [
+        f"{pid}=http://127.0.0.1:{cp.port}|127.0.0.1:{cp.grpc_port}"
+        for pid, cp in cps.items()
+    ]
+    for pid, cp in cps.items():
+        # tightened cadences: the bench measures the failover path, not
+        # the production intervals; ship_batch stays under the gRPC 4MB
+        # frame cap with hex-carried payload blobs
+        cp.attach_peers(
+            pid, specs,
+            replication_interval=0.1, probe_interval=0.5,
+            fanout_timeout=2.0, dead_after_probes=2,
+            ship_batch=4000, redeliver_after=5.0,
+        )
+    victim, survivor = cps[victim_id], cps[survivor_id]
+    sess = requests.Session()
+
+    # -- pre-encode OUTSIDE the measured windows (a real fleet encodes on
+    # 2048 separate machines); the phase-2 run re-encodes keyframe-first
+    # with a fresh DeltaEncoder, exactly what a reconnect does
+    components = ["tpu-hbm", "tpu-ici", "tpu-kmsg", "tpu-runtime"]
+    batch_size = int(os.environ.get("TPUD_BENCH_BATCH", "60"))
+    t_base = time.time()
+    half = max(1, records_per_agent // 2)
+    overlap = min(FLEET_FED_OVERLAP_RECORDS, half)
+
+    def _encode(params_run):
+        enc = wire.DeltaEncoder()
+        frames, recs = [], []
+        last = len(params_run) - 1
+        for idx, (seq, ts, key, payload) in enumerate(params_run):
+            recs.append(enc.encode_record(seq, ts, "transition", key, payload))
+            if len(recs) >= batch_size or idx == last:
+                pkt = pb.AgentPacket()
+                pkt.frame.req_id = f"outbox-{seq}"
+                pkt.frame.data = wire.encode_payload(wire.build_batch(recs))
+                frames.append(pkt)
+                recs = []
+        return frames
+
+    phase1 = {victim_id: [], survivor_id: []}
+    phase2 = []  # victim cohort, redelivered tail + second half, at B
+    for i in range(agents):
+        machine_id = f"fed-{i:04d}"
+        params = []
+        for n in range(records_per_agent):
+            comp = components[n % len(components)]
+            to = "Unhealthy" if n % 2 == 0 else "Healthy"
+            frm = "Healthy" if to == "Unhealthy" else "Unhealthy"
+            ts = t_base + n * 0.001
+            params.append((
+                n + 1, ts, f"transition:{comp}:{ts}:{to}",
+                {"component": comp, "from": frm, "to": to,
+                 "ts": ts, "reason": "bench"},
+            ))
+        owner = owner_of(machine_id, peer_ids)
+        if owner == victim_id:
+            phase1[victim_id].append((machine_id, _encode(params[:half]), half))
+            phase2.append((
+                machine_id, _encode(params[half - overlap:]), records_per_agent,
+            ))
+        else:
+            phase1[survivor_id].append(
+                (machine_id, _encode(params), records_per_agent)
+            )
+    victim_cohort_n = len(phase1[victim_id])
+    if not victim_cohort_n or not phase1[survivor_id]:
+        print("[fleet-fed] rendezvous produced an empty cohort "
+              f"({victim_cohort_n} vs {len(phase1[survivor_id])})",
+              file=sys.stderr)
+        return 1
+    total = agents * records_per_agent
+
+    failures: list = []
+    import queue as _q
+
+    def _drive_agent(stream, machine_id, frames, last_seq) -> bool:
+        out_q: "_q.Queue" = _q.Queue()
+        hello = pb.AgentPacket()
+        hello.hello.machine_id = machine_id
+        hello.hello.token = "bench"
+        hello.hello.revision = 1
+        hello.hello.min_revision = 1
+        hello.hello.max_revision = 3
+        out_q.put(hello)
+        for f in frames:
+            out_q.put(f)
+        call = stream(iter(out_q.get, None), timeout=120.0)
+        acked = False
+        for mpkt in call:
+            kind = mpkt.WhichOneof("payload")
+            if kind == "hello_ack":
+                if not mpkt.hello_ack.accepted:
+                    failures.append(f"{machine_id}: {mpkt.hello_ack.reason}")
+                    out_q.put(None)
+                    return False
+            elif kind == "frame":
+                try:
+                    data = wire.decode_payload(mpkt.frame.data)
+                except ValueError:
+                    continue
+                if (not acked and isinstance(data, dict)
+                        and data.get("method") == "outboxAck"
+                        and int(data.get("seq", 0)) >= last_seq):
+                    acked = True
+                    out_q.put(None)
+        if not acked:
+            failures.append(f"{machine_id}: stream ended before final ack")
+        return acked
+
+    def _run_cohort(target, work, conc, lat_ms=None) -> int:
+        """Drive a cohort against one manager; returns agents fully
+        acked. When ``lat_ms`` is given, each agent's whole drive
+        (connect share + Hello + frames + final ack) is timed — the
+        failover-reconnect sample in phase 2."""
+        done = [0]
+        lock = threading.Lock()
+
+        def _worker(work_slice) -> None:
+            channel = grpc.insecure_channel(target)
+            stream = channel.stream_stream(
+                METHOD,
+                request_serializer=pb.AgentPacket.SerializeToString,
+                response_deserializer=pb.ManagerPacket.FromString,
+            )
+            try:
+                for machine_id, frames, last_seq in work_slice:
+                    t0 = time.monotonic()
+                    try:
+                        ok = _drive_agent(stream, machine_id, frames, last_seq)
+                    except grpc.RpcError as e:
+                        failures.append(f"{machine_id}: {e.code()}")
+                        continue
+                    if ok:
+                        with lock:
+                            done[0] += 1
+                            if lat_ms is not None:
+                                lat_ms.append(
+                                    (time.monotonic() - t0) * 1000.0
+                                )
+            finally:
+                channel.close()
+
+        slices = [work[w::conc] for w in range(conc)]
+        threads = [threading.Thread(target=_worker, args=(s,), daemon=True)
+                   for s in slices if s]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600)
+        return done[0]
+
+    pane_stop = threading.Event()
+    read_errors: list = []
+
+    def _pane_poller(lat_list) -> None:
+        # an operator watching the SURVIVOR's single federated pane
+        # through the whole drill — fanout to the peer while it lives,
+        # merged-with-adopted once it's dead
+        while not pane_stop.is_set():
+            t0 = time.monotonic()
+            try:
+                r = sess.get(f"{survivor.endpoint}/v1/fleet/rollup",
+                             timeout=30)
+                if r.status_code != 200:
+                    read_errors.append(f"rollup: HTTP {r.status_code}")
+                    return
+            except Exception as e:  # noqa: BLE001
+                read_errors.append(f"rollup: {e}")
+                return
+            lat_list.append((time.monotonic() - t0) * 1000.0)
+            time.sleep(0.4)
+
+    def _p95(xs):
+        return (statistics.quantiles(xs, n=20)[-1]
+                if len(xs) >= 2 else float("inf"))
+
+    # -- phase 1: both cohorts to their rendezvous owners, pane under load
+    scatter_live_ms: list = []
+    poller = threading.Thread(
+        target=_pane_poller, args=(scatter_live_ms,), daemon=True
+    )
+    poller.start()
+    conc_a = max(1, concurrency // 2)
+    conc_b = max(1, concurrency - conc_a)
+    t0 = time.monotonic()
+    results = {}
+    runners = [
+        threading.Thread(target=lambda: results.update(a=_run_cohort(
+            f"127.0.0.1:{victim.grpc_port}", phase1[victim_id], conc_a))),
+        threading.Thread(target=lambda: results.update(b=_run_cohort(
+            f"127.0.0.1:{survivor.grpc_port}", phase1[survivor_id], conc_b))),
+    ]
+    for r in runners:
+        r.start()
+    for r in runners:
+        r.join(timeout=600)
+    phase1_s = time.monotonic() - t0
+    phase1_driven = results.get("a", 0) + results.get("b", 0)
+
+    # -- replication convergence + the byte-identity snapshot, pre-kill;
+    # the live-pane poller keeps running here — both peers are still up,
+    # so these samples are legitimately "both-live" and guarantee a
+    # sample set even when the ingest phase itself is short
+    victim.ingest_executor.flush(timeout=60)
+    victim.writer.flush(timeout=60.0)
+    head = victim.federation.shipper.journal_head()
+    t0 = time.monotonic()
+    while (survivor.federation.replica.watermark(victim_id) < head
+           and time.monotonic() - t0 < 120.0):
+        time.sleep(0.05)
+    replication_s = time.monotonic() - t0
+    survivor.writer.flush(timeout=60.0)
+    src_rows = victim.db.query(
+        f"SELECT rowid, agent, seq, ts, ingested, kind, dedupe_key, "
+        f"correlation_id, payload, shard FROM {JOURNAL_TABLE} ORDER BY rowid"
+    )
+    rep_rows = survivor.federation.replica.rows(victim_id)
+    byte_identical = [tuple(r) for r in rep_rows] == [tuple(r) for r in src_rows]
+    replicated_rows = len(rep_rows)
+    t0 = time.monotonic()
+    while (len(scatter_live_ms) < 4 and not read_errors
+           and time.monotonic() - t0 < 5.0):
+        time.sleep(0.1)
+    pane_stop.set()
+    poller.join(timeout=60)
+
+    # -- kill the victim; the survivor's probes flip it dead and adopt
+    records_before_kill = survivor.rollup.records_total()
+    t_kill = time.monotonic()
+    victim.stop()
+    while (not survivor.federation.peers.is_adopted(victim_id)
+           and time.monotonic() - t_kill < 60.0):
+        time.sleep(0.05)
+    adopted = survivor.federation.peers.is_adopted(victim_id)
+    adopt_s = time.monotonic() - t_kill
+    adopted_records = survivor.rollup.records_total() - records_before_kill
+
+    # -- phase 2: the dead cohort fails over to the survivor, pane polled
+    scatter_post_ms: list = []
+    failover_ms: list = []
+    pane_stop.clear()
+    poller = threading.Thread(
+        target=_pane_poller, args=(scatter_post_ms,), daemon=True
+    )
+    poller.start()
+    t0 = time.monotonic()
+    phase2_driven = _run_cohort(
+        f"127.0.0.1:{survivor.grpc_port}", phase2, concurrency,
+        lat_ms=failover_ms,
+    )
+    phase2_s = time.monotonic() - t0
+    # pane latencies settle a moment past ingest so the short phase still
+    # yields a sample set
+    time.sleep(1.0)
+    pane_stop.set()
+    poller.join(timeout=60)
+
+    survivor.ingest_executor.flush(timeout=60)
+    survivor.writer.flush(timeout=60.0)
+    records_final = survivor.rollup.records_total()
+    pane = sess.get(f"{survivor.endpoint}/v1/fleet/rollup", timeout=30).json()
+    dead = [p for p in pane.get("peers", []) if p.get("peer_id") == victim_id]
+    pane_ok = (
+        pane.get("federated") is True
+        and pane.get("agents") == agents
+        and bool(dead)
+        and dead[0].get("reachable") is False
+        and bool(dead[0].get("adopted"))
+    )
+    exec_stats = survivor.ingest_executor.stats()
+    dropped = sum(exec_stats["dropped"])
+    survivor.stop()
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    zero_loss = (
+        records_final == total
+        and phase1_driven == agents
+        and phase2_driven == victim_cohort_n
+        and not failures
+        and dropped == 0
+    )
+    failover_p95 = _p95(failover_ms)
+    scatter_live_p95 = _p95(scatter_live_ms)
+    scatter_post_p95 = _p95(scatter_post_ms)
+
+    print(
+        f"[fleet-fed] cohorts: {victim_cohort_n} agents → {victim_id} "
+        f"(victim), {agents - victim_cohort_n} → {survivor_id} "
+        f"(survivor) by rendezvous; phase 1 {phase1_s:.2f}s "
+        f"({phase1_driven}/{agents} acked), phase 2 {phase2_s:.2f}s "
+        f"({phase2_driven}/{victim_cohort_n} failed over)",
+        file=sys.stderr,
+    )
+    print(
+        f"[fleet-fed] replication: {replicated_rows:,} journal rows at "
+        f"the survivor (converged {replication_s:.2f}s after flush), "
+        f"byte-identical={byte_identical}; adopt {adopt_s:.2f}s after "
+        f"teardown [<= {FLEET_FED_ADOPT_MAX_S:g}], "
+        f"{adopted_records:,} records rebuilt",
+        file=sys.stderr,
+    )
+    print(
+        f"[fleet-fed] failover reconnect p95 {failover_p95:.1f}ms over "
+        f"{len(failover_ms)} agents [<= {FLEET_FED_FAILOVER_P95_MS:g}]; "
+        f"federated pane p95 both-live {scatter_live_p95:.1f}ms "
+        f"[<= {FLEET_FED_SCATTER_P95_MS:g}] / one-dead "
+        f"{scatter_post_p95:.1f}ms [<= {FLEET_FED_POST_P95_MS:g}]",
+        file=sys.stderr,
+    )
+    print(
+        f"[fleet-fed] survivor journal: {records_final:,} records "
+        f"(expected {total:,}, zero_loss={zero_loss}, "
+        f"failures={len(failures)}), dead peer in pane: "
+        f"{'unreachable+adopted' if pane_ok else 'MISSING'}",
+        file=sys.stderr,
+    )
+    if failures:
+        print(f"[fleet-fed] FAILURES: {failures[:5]}", file=sys.stderr)
+    if read_errors:
+        print(f"[fleet-fed] READ ERRORS: {read_errors[:5]}", file=sys.stderr)
+    ok = (
+        zero_loss
+        and byte_identical
+        and adopted
+        and adopt_s <= FLEET_FED_ADOPT_MAX_S
+        and failover_p95 <= FLEET_FED_FAILOVER_P95_MS
+        and scatter_live_p95 <= FLEET_FED_SCATTER_P95_MS
+        and scatter_post_p95 <= FLEET_FED_POST_P95_MS
+        and pane_ok
+        and not read_errors
+    )
+    def _fin(x):
+        # inf (no samples) must not leak into the JSON line — bare
+        # Infinity is not valid JSON; -1 signals a failed measurement
+        return round(x, 2) if x not in (float("inf"), float("-inf")) else -1.0
+
+    print(json.dumps({
+        "metric": "fleet federated failover reconnect p95",
+        "value": _fin(failover_p95),
+        "unit": "ms",
+        "vs_baseline": round(
+            FLEET_FED_FAILOVER_P95_MS / failover_p95, 2
+        ) if failover_p95 > 0 and failover_p95 != float("inf") else 0.0,
+        "detail": {
+            "agents": agents,
+            "records_per_agent": records_per_agent,
+            "records_total": total,
+            "victim_cohort": victim_cohort_n,
+            "phase1_s": round(phase1_s, 3),
+            "phase2_s": round(phase2_s, 3),
+            "replicated_rows": replicated_rows,
+            "replication_converge_s": round(replication_s, 3),
+            "byte_identical": byte_identical,
+            "adopt_s": round(adopt_s, 3),
+            "adopted_records": adopted_records,
+            "failover_p95_ms": _fin(failover_p95),
+            "scatter_live_p95_ms": _fin(scatter_live_p95),
+            "scatter_post_p95_ms": _fin(scatter_post_p95),
+            "records_final": records_final,
+            "zero_loss": zero_loss,
+            "dead_peer_in_pane": pane_ok,
+            "pass": ok,
+        },
+    }))
+    return 0 if ok else 1
+
+
 FLEET_PREDICT_AGENTS = 256
 FLEET_PREDICT_RECORDS_PER_AGENT = 24
 FLEET_PREDICT_FAULTED = 8
@@ -2567,12 +3008,35 @@ def main(argv=None) -> int:
         help="manager shard count for --fleet --socket (default: the "
              "manager's own default)",
     )
+    ap.add_argument(
+        "--managers", type=int, default=1,
+        help="with --fleet --socket: manager count; 2 boots a federated "
+             "peer pair, splits the agents by rendezvous hash, tears one "
+             "manager down at the midpoint, and gates zero loss, the "
+             "byte-identical survivor rebuild, failover reconnect p95, "
+             "and the scatter-gather /v1/fleet/rollup p95 (default 1: "
+             "the standalone fleet-socket bench)",
+    )
     args = ap.parse_args(argv)
     if args.fleet and args.predict:
         return bench_fleet_predict(
             agents=(args.fleet_agents
                     if args.fleet_agents != FLEET_TARGET_AGENTS
                     else FLEET_PREDICT_AGENTS),
+            shards=args.fleet_shards,
+        )
+    if args.fleet and args.socket and args.managers > 1:
+        if args.managers != 2:
+            ap.error("--managers supports 1 (standalone) or 2 (the "
+                     "federated pair drill)")
+        return bench_fleet_socket_federated(
+            agents=(args.fleet_agents
+                    if args.fleet_agents != FLEET_TARGET_AGENTS
+                    else FLEET_SOCKET_AGENTS),
+            records_per_agent=(args.fleet_records
+                               if args.fleet_records
+                               != FLEET_SOCKET_RECORDS_PER_AGENT
+                               else FLEET_FED_RECORDS_PER_AGENT),
             shards=args.fleet_shards,
         )
     if args.fleet and args.socket:

@@ -470,6 +470,67 @@ def act_manager_kill_rebuild(server, step: Dict, ctx) -> Optional[str]:
     return None
 
 
+def act_peer_plane_boot(server, step: Dict, ctx) -> Optional[str]:
+    """HA manager tier stand-in: boot a SECOND fake control plane that
+    shares the primary's delivery ledgers (outbox keys/frames/acks,
+    rollup store, connected event) — two managers over one logical
+    journal, like a real peer that replicated the primary's journal —
+    and hand the agent's circuit breaker a ``peers`` list so its next
+    trip to OPEN rotates to the peer with an immediate probe
+    (docs/session.md "Peer failover"). A later ``plane_refuse`` on the
+    primary then IS the manager SIGKILL: the agent must fail over to
+    the surviving peer inside the breaker cooldown, and ``zero_loss`` /
+    ``fleet`` expectations hold across both planes because the ledgers
+    are one. Cleanup retargets the session at the primary, restores the
+    breaker's peer list, and stops the peer plane."""
+    from gpud_tpu.chaos.fake_plane import FakeControlPlane
+
+    plane = ctx.plane
+    if plane is None:
+        return "no fake control plane attached to this campaign"
+    cb = getattr(server, "session_circuit", None)
+    session = getattr(server, "session", None)
+    if cb is None or session is None:
+        return "peer failover needs a live session + circuit breaker"
+    if getattr(ctx, "peer_plane", None) is not None:
+        return "peer plane already booted"
+
+    peer = FakeControlPlane()
+    # one logical manager tier: the peer serves the same ledgers, so a
+    # record delivered to EITHER plane counts once, dedupes once, and
+    # lands in the same rollup — the chaos analogue of the replicated
+    # journal a real surviving peer rebuilds from
+    peer.outbox_keys = plane.outbox_keys
+    peer.outbox_frames = plane.outbox_frames
+    peer.outbox_acked = plane.outbox_acked
+    peer.rollup = plane.rollup
+    peer.connected = plane.connected
+    peer.start()
+    ctx.peer_plane = peer
+    peer_endpoint = f"http://127.0.0.1:{peer.port}"
+
+    primary_endpoint = session.endpoint
+    old_peers = list(cb.peers)
+    cb.peers = [primary_endpoint, peer_endpoint]
+
+    def _undo() -> None:
+        with cb._mu:  # noqa: SLF001 — chaos harness resets breaker state
+            cb.peers = old_peers
+            cb._peer_index = 0
+            cb._failover_probe = False
+            cb._sweep = 0
+        session._apply_peer(primary_endpoint)  # noqa: SLF001
+        ctx.peer_plane = None
+        peer.stop()
+
+    ctx.cleanups.append(_undo)
+    logger.info(
+        "chaos: peer manager up at %s (primary %s); breaker owns failover",
+        peer_endpoint, primary_endpoint,
+    )
+    return None
+
+
 def _poke(comp, server, block: bool = False) -> None:
     """Run the component's check now: poked to the front of the heap when
     scheduler-driven, else a direct (or one-shot) check."""
@@ -513,4 +574,5 @@ ACTIONS: Dict[str, Callable] = {
     "storage_flush": act_storage_flush,
     "storage_crash": act_storage_crash,
     "manager_kill_rebuild": act_manager_kill_rebuild,
+    "peer_plane_boot": act_peer_plane_boot,
 }

@@ -68,6 +68,7 @@ KNOWN_ACTIONS = (
     "storage_flush",   # write-behind flush barrier (pre-crash durability line)
     "storage_crash",   # discard the write-behind buffer uncommitted (SIGKILL sim)
     "manager_kill_rebuild",  # SIGKILL the manager: rebuild rollups from journal
+    "peer_plane_boot",  # HA tier: boot a peer manager + breaker failover list
 )
 
 # expectation kinds evaluated after each phase (gpud_tpu/chaos/expectations.py)

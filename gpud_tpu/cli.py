@@ -850,6 +850,7 @@ def cmd_manager(args) -> int:
             grpc_port=args.grpc_port,
             session_token=args.session_token or None,
             admin_token=args.admin_token or None,
+            instance_id=args.peer_id or None,
             data_dir=args.data_dir or None,
             shards=args.shards or None,
         )
@@ -861,6 +862,39 @@ def cmd_manager(args) -> int:
         for sig in (signal.SIGINT, signal.SIGTERM):
             signal.signal(sig, lambda *_: stop.set())
         cp.start()
+        if args.peers:
+            # HA tier (docs/fleet.md "Federation & failover"): join the
+            # peer set. The knob defaults mirror Config.federation_* —
+            # the daemon-side dataclass is the documented reference for
+            # these, even though the manager is configured by flags
+            from gpud_tpu.config import Config as _Cfg
+
+            defaults = _Cfg()
+            cp.attach_peers(
+                args.peer_id or cp.instance_id,
+                list(args.peers),
+                replication_interval=(
+                    args.replication_interval
+                    if args.replication_interval > 0
+                    else defaults.federation_replication_interval_seconds
+                ),
+                probe_interval=(
+                    args.probe_interval if args.probe_interval > 0
+                    else defaults.federation_probe_interval_seconds
+                ),
+                fanout_timeout=(
+                    args.fanout_timeout if args.fanout_timeout > 0
+                    else defaults.federation_fanout_timeout_seconds
+                ),
+                dead_after_probes=(
+                    args.dead_after_probes if args.dead_after_probes > 0
+                    else defaults.federation_dead_after_probes
+                ),
+                auto_adopt=(
+                    defaults.federation_auto_adopt
+                    and not args.no_auto_adopt
+                ),
+            )
         print(
             _json.dumps(
                 {
@@ -922,6 +956,17 @@ def cmd_fleet(args) -> int:
                 "/v1/fleet/agents",
                 params={"offset": args.offset, "limit": args.limit},
             )
+            if data is not None and args.peer:
+                # cohort placement view: keep only rows the named peer
+                # owns. Rows carry "peer" on federated managers; on a
+                # standalone manager the filter matches nothing
+                rows = [
+                    a for a in data.get("agents", [])
+                    if a.get("peer", "") == args.peer
+                ]
+                data["agents"] = rows
+                data["peer_filter"] = args.peer
+                data["filtered"] = len(rows)
         elif args.fleet_cmd == "history":
             params = {"limit": args.limit, "offset": args.offset}
             if args.since:
@@ -934,6 +979,8 @@ def cmd_fleet(args) -> int:
                 "/v1/fleet/traces",
                 params={"correlation_id": args.correlation_id},
             )
+        elif args.fleet_cmd == "peers":
+            data = get("/v1/fleet/peers")
         else:
             return 2
     except Exception as e:  # noqa: BLE001 - CLI boundary: no tracebacks
@@ -1246,6 +1293,29 @@ def build_parser() -> argparse.ArgumentParser:
                          "(default: 8; agents hash to shards by stable "
                          "crc32 slots, so this is safe to change between "
                          "restarts)")
+    ms.add_argument("--peer-id", default="",
+                    help="stable peer id in the manager peer set (also "
+                         "used as instance_id; required with --peers)")
+    ms.add_argument("--peers", action="append", default=[],
+                    metavar="ID=ENDPOINT[|GRPC]",
+                    help="full peer map incl. this manager's own entry; "
+                         "repeatable. Enables federation (docs/fleet.md)")
+    ms.add_argument("--replication-interval", type=float, default=0.0,
+                    help="journal replication tick seconds (0 = "
+                         "federation_replication_interval_seconds default)")
+    ms.add_argument("--probe-interval", type=float, default=0.0,
+                    help="peer health probe seconds (0 = "
+                         "federation_probe_interval_seconds default)")
+    ms.add_argument("--fanout-timeout", type=float, default=0.0,
+                    help="per-peer scatter-gather seconds (0 = "
+                         "federation_fanout_timeout_seconds default)")
+    ms.add_argument("--dead-after-probes", type=int, default=0,
+                    help="consecutive failed probes before a peer is "
+                         "declared dead (0 = federation_dead_after_probes "
+                         "default)")
+    ms.add_argument("--no-auto-adopt", action="store_true",
+                    help="never auto-adopt a dead peer's replicated "
+                         "cohort (overrides federation_auto_adopt)")
     ms.set_defaults(fn=cmd_manager)
     mm = msub.add_parser("machines", help="list connected agents")
     mm.add_argument("--endpoint", default="http://127.0.0.1:15135")
@@ -1288,7 +1358,16 @@ def build_parser() -> argparse.ArgumentParser:
     fa = fsub.add_parser("agents", help="paginated per-agent rollups")
     fa.add_argument("--offset", type=int, default=0)
     fa.add_argument("--limit", type=int, default=100)
+    fa.add_argument("--peer", default="",
+                    help="only agents owned by this peer id (cohort "
+                         "placement view; federated managers only)")
     _fleet_common(fa)
+    fpe = fsub.add_parser(
+        "peers",
+        help="the manager peer map: ring, health, rendezvous cohorts, "
+             "replication watermarks",
+    )
+    _fleet_common(fpe)
     fh = fsub.add_parser(
         "history", help="one agent's journaled records, newest first"
     )

@@ -351,3 +351,10 @@ class Client:
             "GET", "/v1/fleet/traces",
             params={"correlation_id": correlation_id},
         )
+
+    def get_fleet_peers(self) -> Dict:
+        """The manager peer map (``GET /v1/fleet/peers``): ring order,
+        per-peer health, rendezvous cohort counts, and replication
+        watermarks; ``{"federation": false, ...}`` from a standalone
+        manager."""
+        return self._req("GET", "/v1/fleet/peers")

@@ -215,6 +215,22 @@ class Config:
     # connect failures → half-open probe after the cooldown
     session_circuit_failure_threshold: int = DEFAULT_SESSION_CIRCUIT_THRESHOLD
     session_circuit_open_seconds: float = DEFAULT_SESSION_CIRCUIT_OPEN_SECONDS
+    # HA manager tier (docs/session.md "Peer failover"): standby manager
+    # specs ("endpoint", "endpoint|grpc_target", or the full
+    # "peer_id=endpoint[|grpc_target]" form) tried in order when the
+    # breaker trips on the primary. Empty = classic single-manager
+    # parking behavior
+    session_peers: List[str] = field(default_factory=list)
+    # manager-side federation knobs (gpud_tpu/manager/federation.py),
+    # consumed by `tpud manager serve`: journal-replication tick cadence,
+    # peer health probe cadence, per-peer scatter-gather budget, probes
+    # before a peer is declared dead, and whether the ring successor
+    # auto-adopts a dead peer's replicated cohort
+    federation_replication_interval_seconds: float = 1.0
+    federation_probe_interval_seconds: float = 5.0
+    federation_fanout_timeout_seconds: float = 2.0
+    federation_dead_after_probes: int = 3
+    federation_auto_adopt: bool = True
     # unified check scheduler (docs/scheduler.md)
     scheduler_workers: int = DEFAULT_SCHEDULER_WORKERS
     scheduler_watchdog_seconds: int = DEFAULT_SCHEDULER_WATCHDOG
@@ -366,6 +382,21 @@ class Config:
             return "session circuit failure threshold must be >= 1"
         if self.session_circuit_open_seconds <= 0:
             return "session circuit open seconds must be > 0s"
+        for spec in self.session_peers:
+            s = (spec or "").strip()
+            if not s or "://" not in s:
+                return (
+                    f"session peer {spec!r} must be an http(s) endpoint "
+                    "spec (endpoint, endpoint|grpc, or id=endpoint|grpc)"
+                )
+        if self.federation_replication_interval_seconds <= 0:
+            return "federation replication interval must be > 0s"
+        if self.federation_probe_interval_seconds <= 0:
+            return "federation probe interval must be > 0s"
+        if self.federation_fanout_timeout_seconds <= 0:
+            return "federation fanout timeout must be > 0s"
+        if self.federation_dead_after_probes < 1:
+            return "federation dead-after-probes must be >= 1"
         if self.session_wire_keyframe_interval < 1:
             return "session wire keyframe interval must be >= 1"
         if self.session_wire_compress_min_bytes < 0:

@@ -363,12 +363,79 @@ class ControlPlane:
         # reader threads enqueue, shard workers journal + ack
         self.ingest_executor = ShardIngestExecutor(self.shards)
         self._scheduler = None
+        # HA tier (manager/federation.py): None until attach_peers().
+        # Specs can't be known at construction when ports are dynamic,
+        # so federation always binds late
+        self.federation = None
+        # rate limiter for the mid-batch-abandon warning, per machine
+        self._abandon_warn_ts: Dict[str, float] = {}
+
+    # -- federation --------------------------------------------------------
+    def attach_peers(
+        self,
+        peer_id: str,
+        peer_specs: List[str],
+        *,
+        replication_interval: Optional[float] = None,
+        probe_interval: Optional[float] = None,
+        fanout_timeout: Optional[float] = None,
+        dead_after_probes: Optional[int] = None,
+        auto_adopt: bool = True,
+        ship_batch: Optional[int] = None,
+        redeliver_after: Optional[float] = None,
+    ):
+        """Join a peer set (manager/federation.py). ``peer_specs`` must
+        include this manager's own ``peer_id=endpoint[|grpc]`` entry.
+        Call after start() — peer addresses usually aren't known until
+        every manager has bound its ports."""
+        from gpud_tpu.manager.federation import FederationPlane
+        from gpud_tpu.manager.peers import PeerSet, parse_peer_spec
+
+        if self.federation is not None:
+            raise RuntimeError("peers already attached")
+        if self._scheduler is None:
+            raise RuntimeError("attach_peers() requires a started manager")
+        descriptors = [parse_peer_spec(s) for s in peer_specs]
+        kwargs = {}
+        if dead_after_probes is not None:
+            kwargs["dead_after_probes"] = dead_after_probes
+        peerset = PeerSet(peer_id, descriptors, **kwargs)
+        fed_kwargs = {"auto_adopt": auto_adopt}
+        for name, val in (
+            ("replication_interval", replication_interval),
+            ("probe_interval", probe_interval),
+            ("fanout_timeout", fanout_timeout),
+            ("ship_batch", ship_batch),
+            ("redeliver_after", redeliver_after),
+        ):
+            if val is not None:
+                fed_kwargs[name] = val
+        self.federation = FederationPlane(
+            peerset, self.rollup, self.db, self.writer,
+            session_token=self.session_token,
+            admin_token=self.admin_token,
+            **fed_kwargs,
+        )
+        self.federation.start(self._scheduler)
+        logger.info(
+            "federation up: self=%s ring=%s", peer_id, peerset.ring
+        )
+        return self.federation
 
     # -- registry ----------------------------------------------------------
     def _register(self, handle: AgentHandle) -> None:
         # point the transport's outbox hook at the rollup store before
-        # the handle is visible, so the very first frame is journaled
-        handle.on_records = self.rollup.ingest
+        # the handle is visible, so the very first frame is journaled.
+        # Peer replication streams (machine_id "peer:<id>") journal into
+        # the replica store instead — a live peer's cohort must never
+        # leak into this manager's own pane
+        from gpud_tpu.manager.federation import PEER_MACHINE_PREFIX
+
+        fed = self.federation
+        if handle.machine_id.startswith(PEER_MACHINE_PREFIX) and fed is not None:
+            handle.on_records = fed.replica_sink(handle.machine_id)
+        else:
+            handle.on_records = self.rollup.ingest
         handle.ingest_executor = self.ingest_executor
         with self._lock:
             old = self.agents.get(handle.machine_id)
@@ -380,10 +447,32 @@ class ControlPlane:
         )
 
     def _unregister(self, handle: AgentHandle) -> None:
+        # sample BEFORE mark_gone(): it enqueues a None wake sentinel,
+        # so qsize afterwards can't distinguish abandonment from drain
+        leftover = handle.outbound.qsize()
         handle.mark_gone()
         with self._lock:
             if self.agents.get(handle.machine_id) is handle:
                 del self.agents[handle.machine_id]
+        if leftover > 0 and not handle.draining.is_set():
+            # the agent walked away mid-batch: frames (usually cumulative
+            # acks) it never read are dropped with the stream. Warn —
+            # silently eating these is how "why did the agent redeliver
+            # a whole batch" hunts start — but rate-limit per machine,
+            # because a flapping agent would otherwise log every cycle
+            now = time.monotonic()
+            last = self._abandon_warn_ts.get(handle.machine_id, 0.0)
+            if now - last >= 30.0:
+                if len(self._abandon_warn_ts) >= 1024:
+                    self._abandon_warn_ts.clear()
+                self._abandon_warn_ts[handle.machine_id] = now
+                logger.warning(
+                    "agent %s abandoned its %s stream mid-batch: %d "
+                    "undelivered frame(s) dropped (acked watermark %d); "
+                    "the agent will redeliver above its last acked seq",
+                    handle.machine_id, handle.transport, leftover,
+                    handle.outbox_acked,
+                )
         logger.info("agent %s disconnected", handle.machine_id)
 
     def agent(self, machine_id: str) -> AgentHandle:
@@ -634,15 +723,30 @@ class ControlPlane:
             return default
         return caster(raw)
 
+    def _fleet_pane(self, kind: str, local_fn, params: dict, scope: str):
+        """Run one local pane read and, when federated and the caller
+        didn't pin ``?scope=local``, widen it across live peers. Every
+        inter-peer fan-out pins ``scope=local`` so depth stops at one."""
+        local = local_fn()
+        fed = self.federation
+        if fed is None or scope == "local":
+            return local
+        return fed.federate(kind, local, params)
+
     async def _fleet_rollup_route(self, request):  # noqa: ANN001
         """Fleet-wide rollup aggregates (availability, MTTR/MTBF,
-        flapping, remediation outcomes)."""
+        flapping, remediation outcomes); one pane across all peers
+        unless ``?scope=local``."""
         from aiohttp import web
 
         if not self._check_admin(request):
             return web.Response(status=401, text="unauthorized")
+        scope = request.query.get("scope", "")
         data = await asyncio.get_event_loop().run_in_executor(
-            self._op_pool, self.rollup.fleet_rollup
+            self._op_pool,
+            lambda: self._fleet_pane(
+                "rollup", self.rollup.fleet_rollup, {}, scope
+            ),
         )
         return web.json_response(data)
 
@@ -658,8 +762,13 @@ class ControlPlane:
             since = self._q_num(request, "since", 0.0, float)
         except ValueError:
             return web.Response(status=400, text="since must be a number")
+        scope = request.query.get("scope", "")
         data = await asyncio.get_event_loop().run_in_executor(
-            self._op_pool, lambda: self.rollup.fleet_fabric(since)
+            self._op_pool,
+            lambda: self._fleet_pane(
+                "fabric", lambda: self.rollup.fleet_fabric(since),
+                {"since": since}, scope,
+            ),
         )
         return web.json_response(data)
 
@@ -676,8 +785,13 @@ class ControlPlane:
             top = self._q_num(request, "top", 20, int)
         except ValueError:
             return web.Response(status=400, text="top must be an integer")
+        scope = request.query.get("scope", "")
         data = await asyncio.get_event_loop().run_in_executor(
-            self._op_pool, lambda: self.rollup.fleet_predict(top)
+            self._op_pool,
+            lambda: self._fleet_pane(
+                "predict", lambda: self.rollup.fleet_predict(top),
+                {"top": top}, scope,
+            ),
         )
         return web.json_response(data)
 
@@ -692,8 +806,13 @@ class ControlPlane:
             limit = self._q_num(request, "limit", 50, int)
         except ValueError:
             return web.Response(status=400, text="offset/limit must be integers")
+        scope = request.query.get("scope", "")
         data = await asyncio.get_event_loop().run_in_executor(
-            self._op_pool, lambda: self.rollup.agents_page(offset, limit)
+            self._op_pool,
+            lambda: self._fleet_pane(
+                "agents", lambda: self.rollup.agents_page(offset, limit),
+                {"offset": offset, "limit": limit}, scope,
+            ),
         )
         return web.json_response(data)
 
@@ -711,9 +830,22 @@ class ControlPlane:
             offset = self._q_num(request, "offset", 0, int)
         except ValueError:
             return web.Response(status=400, text="since/limit/offset must be numbers")
+        scope = request.query.get("scope", "")
+
+        def read():
+            local = self.rollup.history(agent_id, since, limit, offset)
+            fed = self.federation
+            if fed is None or scope == "local":
+                return local
+            # history is single-owner data: proxy to the rendezvous
+            # owner when the journal doesn't know the agent locally
+            return fed.federate_history(
+                agent_id, local,
+                {"since": since, "limit": limit, "offset": offset},
+            )
+
         data = await asyncio.get_event_loop().run_in_executor(
-            self._op_pool,
-            lambda: self.rollup.history(agent_id, since, limit, offset),
+            self._op_pool, read
         )
         return web.json_response(data)
 
@@ -731,8 +863,34 @@ class ControlPlane:
             limit = self._q_num(request, "limit", 200, int)
         except ValueError:
             return web.Response(status=400, text="limit must be an integer")
+        scope = request.query.get("scope", "")
         data = await asyncio.get_event_loop().run_in_executor(
-            self._op_pool, lambda: self.rollup.traces(cid, limit)
+            self._op_pool,
+            lambda: self._fleet_pane(
+                "traces", lambda: self.rollup.traces(cid, limit),
+                {"correlation_id": cid, "limit": limit}, scope,
+            ),
+        )
+        return web.json_response(data)
+
+    async def _fleet_peers_route(self, request):  # noqa: ANN001
+        """The peer map itself: ring order, rendezvous cohort counts,
+        replication + replica watermarks, per-peer health. Standalone
+        managers answer ``federation: false`` (200, not 404) so probes
+        and the CLI work unchanged against either shape."""
+        from aiohttp import web
+
+        if not self._check_admin(request):
+            return web.Response(status=401, text="unauthorized")
+        fed = self.federation
+        if fed is None:
+            return web.json_response({
+                "federation": False,
+                "instance_id": self.instance_id,
+                "peers": [],
+            })
+        data = await asyncio.get_event_loop().run_in_executor(
+            self._op_pool, fed.peers_view
         )
         return web.json_response(data)
 
@@ -746,7 +904,9 @@ class ControlPlane:
         body = await asyncio.get_event_loop().run_in_executor(
             self._op_pool,
             lambda: render_fleet_metrics(
-                self.rollup, ingest_executor=self.ingest_executor
+                self.rollup,
+                ingest_executor=self.ingest_executor,
+                federation=self.federation,
             ),
         )
         return web.Response(
@@ -792,6 +952,7 @@ class ControlPlane:
             "/v1/fleet/agents/{agent_id}/history", self._fleet_history_route
         )
         app.router.add_get("/v1/fleet/traces", self._fleet_traces_route)
+        app.router.add_get("/v1/fleet/peers", self._fleet_peers_route)
         app.router.add_get("/metrics", self._metrics_route)
 
         # the writer needs a periodic drain job (threshold pokes are
@@ -1035,6 +1196,12 @@ class ControlPlane:
 
     def _stop_locked(self) -> None:
         self._stopped = True
+        # federation first: the shipper's session threads reconnect-loop
+        # against the successor, and the fan-out pool must stop taking
+        # work before the op pool beneath it does
+        if self.federation is not None:
+            self.federation.stop()
+            self.federation = None
         self.drain("manager stopping")
         if self._grpc_server is not None:
             self._grpc_server.stop(grace=1.0)

@@ -88,6 +88,30 @@ _g_predict_lead_mean = gauge(
 )
 
 
+# peer-federation gauges (fixed cardinality: one number each, refreshed
+# from FederationPlane.stats() at scrape time; docs/fleet.md)
+_g_peers_total = gauge(
+    "tpud_fleet_peers",
+    "managers in this manager's peer set (0 when not federated)",
+)
+_g_peers_live = gauge(
+    "tpud_fleet_peers_live",
+    "peers currently believed reachable, including self",
+)
+_g_replication_lag = gauge(
+    "tpud_fleet_replication_lag_rows",
+    "journal rows appended locally but not yet acked by the successor",
+)
+_g_replication_connected = gauge(
+    "tpud_fleet_replication_connected",
+    "1 when the replication stream to the successor is connected",
+)
+_g_adopts = gauge(
+    "tpud_fleet_peer_adopts",
+    "dead-peer cohorts this manager has adopted from its replica",
+)
+
+
 def _escape(v: str) -> str:
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
 
@@ -96,10 +120,21 @@ def render_fleet_metrics(
     rollup_store,
     max_agents: int = DEFAULT_MAX_AGENTS,
     ingest_executor=None,
+    federation=None,
 ) -> str:
     """The manager's full /metrics body: global registry + bounded
     per-agent federation block."""
     t0 = time.monotonic()
+    if federation is not None:
+        fs = federation.stats()
+        _g_peers_total.set(fs["peers_total"])
+        _g_peers_live.set(fs["peers_live"])
+        _g_adopts.set(fs["adopts"])
+        _g_replication_lag.set(fs.get("replication_lag_rows", 0))
+        _g_replication_connected.set(fs.get("replication_connected", 0))
+    else:
+        _g_peers_total.set(0)
+        _g_peers_live.set(0)
     # refresh the per-shard gauges (cardinality bounded by shard count,
     # not fleet size) before the registry renders them
     from gpud_tpu.manager.shard import update_shard_gauges

@@ -948,6 +948,25 @@ class Server:
                     self.last_replay_jitter_seconds = 0.0
                     job.poke()
 
+            # HA manager tier (docs/session.md "Peer failover"): the
+            # breaker owns failover order — the endpoint we enrolled
+            # with first, then the configured standby peers (minus any
+            # duplicate spelling of the primary). Set before start();
+            # with no session_peers the list stays empty and the breaker
+            # behaves exactly as before
+            peer_specs = [
+                p.strip() for p in (self.config.session_peers or [])
+                if p and p.strip()
+            ]
+            if peer_specs:
+                def _spec_endpoint(spec: str) -> str:
+                    return md.normalize_endpoint(
+                        spec.split("=", 1)[-1].split("|", 1)[0]
+                    )
+
+                self.session_circuit.peers = [endpoint] + [
+                    p for p in peer_specs if _spec_endpoint(p) != endpoint
+                ]
             session.circuit = self.session_circuit
             session.on_frame_dropped = self._session_frame_drop_event
             session.on_connected = on_connected

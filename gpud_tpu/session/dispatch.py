@@ -519,6 +519,30 @@ class Dispatcher:
         out["wire"] = wire.codec_stats()
         return out
 
+    def _m_peerStatus(self, req: Dict) -> Dict:
+        """Which manager this agent is parked on and how failover stands
+        (docs/session.md "Peer failover"): the breaker's peer list,
+        current index, and failover count, plus the session's active
+        endpoint/transport."""
+        out: Dict = {}
+        circuit = getattr(self.server, "session_circuit", None)
+        if circuit is not None:
+            stats = circuit.stats()
+            out["peers"] = stats["peers"]
+            out["peer_index"] = stats["peer_index"]
+            out["failovers"] = stats["failovers"]
+            out["circuit_state"] = stats["state"]
+        session = getattr(self.server, "session", None)
+        if session is not None:
+            out["endpoint"] = session.endpoint
+            out["v2_target"] = session.v2_target
+            out["connected"] = session.connected
+            out["active_protocol"] = session.active_protocol
+            out["reconnects"] = session.reconnect_count
+        if not out:
+            return {"error": "no session or circuit configured"}
+        return out
+
     def _m_bootstrap(self, req: Dict) -> Dict:
         """base64 script exec (reference: session bootstrap)."""
         script, err = self._decode_script(req.get("script_base64", ""))

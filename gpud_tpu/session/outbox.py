@@ -510,6 +510,18 @@ class CircuitBreaker:
     the circuit, its ``record_failure`` re-opens it for a fresh cooldown.
     State rides ``tpud_session_circuit_state`` and a bounded transition
     history feeds the chaos expectation layer.
+
+    With a ``peers`` list (HA manager tier, docs/session.md) the breaker
+    also owns failover: every trip to OPEN rotates ``current_peer()`` to
+    the next configured manager, and until one full sweep of the peer
+    list has failed, the rotation grants an immediate probe at the new
+    peer instead of sitting out the cooldown — a dead manager costs
+    reconnect latency, not ``open_seconds`` per peer. Once every peer
+    has failed in one sweep, the normal cooldown resumes (the whole
+    tier is down; hammering it helps nobody). The acked-watermark
+    contract is unaffected: ``SessionOutbox.ack`` is monotonic MAX, so
+    acks arriving late from the old peer can never regress what the new
+    peer has acknowledged.
     """
 
     GUARDED_BY = {
@@ -518,6 +530,10 @@ class CircuitBreaker:
         "_opened_at": "_mu",
         "_blocked": "_mu",
         "history": "_mu",
+        "_peer_index": "_mu",
+        "_failover_probe": "_mu",
+        "_sweep": "_mu",
+        "_failovers": "_mu",
     }
 
     def __init__(
@@ -525,15 +541,24 @@ class CircuitBreaker:
         failure_threshold: int = DEFAULT_FAILURE_THRESHOLD,
         open_seconds: float = DEFAULT_OPEN_SECONDS,
         time_fn: Callable[[], float] = time.monotonic,
+        peers: Optional[List[str]] = None,
     ) -> None:
         self.failure_threshold = max(1, int(failure_threshold))
         self.open_seconds = float(open_seconds)
         self.time_fn = time_fn
+        # peer endpoints in failover order; entry 0 is the primary. Set
+        # at configuration time, before the session's keep-alive thread
+        # starts — only the index is guarded
+        self.peers: List[str] = [p for p in (peers or []) if p]
         self._mu = threading.Lock()
         self._state = CIRCUIT_CLOSED
         self._failures = 0
         self._opened_at = 0.0
         self._blocked = 0
+        self._peer_index = 0
+        self._failover_probe = False
+        self._sweep = 0  # consecutive peers failed in the current sweep
+        self._failovers = 0
         # (monotonic_ts, state) transitions, oldest first, bounded
         self.history: List[Tuple[float, str]] = [(self.time_fn(), CIRCUIT_CLOSED)]
         _g_circuit.set(0)
@@ -562,6 +587,14 @@ class CircuitBreaker:
             if self._state == CIRCUIT_CLOSED:
                 return True
             if self._state == CIRCUIT_OPEN:
+                if self._failover_probe:
+                    # a failover just rotated current_peer(): probe the
+                    # new peer immediately instead of serving the dead
+                    # peer's cooldown (one probe — it either closes the
+                    # circuit or burns this peer too)
+                    self._failover_probe = False
+                    self._transition_locked(CIRCUIT_HALF_OPEN)
+                    return True
                 if self.time_fn() - self._opened_at >= self.open_seconds:
                     self._transition_locked(CIRCUIT_HALF_OPEN)
                     return True  # the single half-open probe
@@ -594,11 +627,15 @@ class CircuitBreaker:
         with self._mu:
             if self._state != CIRCUIT_OPEN:
                 return 0.0
+            if self._failover_probe:
+                return 0.0  # a rotated peer is waiting for its probe
             return max(0.0, self.open_seconds - (self.time_fn() - self._opened_at))
 
     def record_success(self) -> None:
         with self._mu:
             self._failures = 0
+            self._sweep = 0
+            self._failover_probe = False
             self._transition_locked(CIRCUIT_CLOSED)
 
     def record_failure(self) -> None:
@@ -608,12 +645,43 @@ class CircuitBreaker:
                 # failed probe: back to open for a fresh cooldown
                 self._opened_at = self.time_fn()
                 self._transition_locked(CIRCUIT_OPEN)
+                self._rotate_peer_locked()
             elif (
                 self._state == CIRCUIT_CLOSED
                 and self._failures >= self.failure_threshold
             ):
                 self._opened_at = self.time_fn()
                 self._transition_locked(CIRCUIT_OPEN)
+                self._rotate_peer_locked()
+
+    def _rotate_peer_locked(self) -> None:
+        """On every trip to OPEN with >1 configured peers: advance to
+        the next peer and decide whether it gets an immediate probe
+        (still inside the current sweep) or the normal cooldown (one
+        full sweep failed — every peer is down)."""
+        if len(self.peers) < 2:
+            return
+        self._peer_index = (self._peer_index + 1) % len(self.peers)
+        self._failovers += 1
+        self._sweep += 1
+        if self._sweep < len(self.peers):
+            self._failover_probe = True
+        else:
+            self._sweep = 0
+            self._failover_probe = False
+
+    def current_peer(self) -> str:
+        """The endpoint spec the session should dial now ("" without a
+        configured peer list)."""
+        with self._mu:
+            if not self.peers:
+                return ""
+            return self.peers[self._peer_index]
+
+    @property
+    def failover_count(self) -> int:
+        with self._mu:
+            return self._failovers
 
     @property
     def blocked_count(self) -> int:
@@ -629,4 +697,7 @@ class CircuitBreaker:
                 "open_seconds": self.open_seconds,
                 "blocked_attempts": self._blocked,
                 "states_seen": [s for _ts, s in self.history],
+                "peers": list(self.peers),
+                "peer_index": self._peer_index,
+                "failovers": self._failovers,
             }

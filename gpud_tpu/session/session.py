@@ -243,6 +243,37 @@ class Session:
     def connected(self) -> bool:
         return self._connected.is_set()
 
+    def _apply_peer(self, spec: str) -> None:
+        """Retarget this session at the breaker's current peer. Accepts
+        a bare endpoint, ``endpoint|grpc_target``, or the full
+        ``peer_id=endpoint[|grpc_target]`` manager spec; a no-op when
+        the spec is empty or already the active target. Only the
+        keep-alive thread calls this, between connects."""
+        if not spec:
+            return
+        raw = spec.strip()
+        _head, sep, tail = raw.partition("=")
+        if sep and "://" in tail:
+            raw = tail  # peer_id=endpoint form: the id is routing-only
+        endpoint, _, grpc_target = raw.partition("|")
+        endpoint = endpoint.strip().rstrip("/")
+        grpc_target = grpc_target.strip()
+        if not endpoint:
+            return
+        if endpoint == self.endpoint and (
+            not grpc_target or grpc_target == self.v2_target
+        ):
+            return
+        logger.warning(
+            "session failing over: %s -> %s", self.endpoint, endpoint
+        )
+        self.endpoint = endpoint
+        self.v2_target = grpc_target
+        # the new peer negotiates its own transport: a v1-only previous
+        # peer must not pin the replacement to v1
+        self._v2_failed = False
+        self._v2_skip_cycles = 0
+
     # -- keep-alive / reconnect (reference: session_keepalive.go,
     #    session_reconnect.go) -------------------------------------------
     def _keep_alive(self) -> None:
@@ -259,6 +290,12 @@ class Session:
                 if self.time_sleep_fn(wait):
                     return
                 continue
+            if cb is not None:
+                # HA failover: the breaker owns which manager to dial
+                # (it rotates current_peer() on every trip to open);
+                # retarget BEFORE the attempt so the immediate failover
+                # probe already lands on the new peer
+                self._apply_peer(cb.current_peer())
             self._drain_reader()
             self._reconnect_signal.clear()
             self._last_reason_auth = None

@@ -55,6 +55,8 @@ GUARD_MODULES = (
     "gpud_tpu/chaos/runner.py",
     "gpud_tpu/fabric/plane.py",
     "gpud_tpu/health_history.py",
+    "gpud_tpu/manager/federation.py",
+    "gpud_tpu/manager/peers.py",
     "gpud_tpu/manager/rollup.py",
     "gpud_tpu/manager/shard.py",
     "gpud_tpu/metrics/registry.py",

@@ -19,8 +19,9 @@ pins them:
    mapping must cover the method set exactly — a new dispatch method
    fails the lint until its SDK story is stated.
 3. **HTTP routes** — every registered ``/v1/*`` path in server/app.py
-   must appear in the HTTP route matrix (tests/test_http_route_matrix
-   .py), so a new route ships with at least one method/shape row.
+   AND in the manager's control_plane.py must appear in the HTTP route
+   matrix (tests/test_http_route_matrix.py), so a new route ships with
+   at least one method/shape row.
 
 Run: ``python -m gpud_tpu.tools.parity_lint`` (exit 1 on any problem);
 registered in ``tools/lint_all.py`` so tier-1 enforces it.
@@ -38,6 +39,7 @@ CONFIG_MODULE = "gpud_tpu/config.py"
 DISPATCH_MODULE = "gpud_tpu/session/dispatch.py"
 SDK_MODULE = "gpud_tpu/client/v1.py"
 APP_MODULE = "gpud_tpu/server/app.py"
+MANAGER_MODULE = "gpud_tpu/manager/control_plane.py"
 DISPATCH_MATRIX_TEST = "tests/test_dispatch_error_matrix.py"
 ROUTE_MATRIX_TEST = "tests/test_http_route_matrix.py"
 
@@ -69,6 +71,10 @@ DISPATCH_TO_SDK: Dict[str, Tuple[Optional[str], str]] = {
     "outboxAck": (None, "manager->agent delivery ack; internal to the "
                         "at-least-once session protocol"),
     "outboxStatus": ("get_session_status", ""),
+    "peerStatus": (None, "agent-side failover introspection over the "
+                         "session channel; the operator pane is the "
+                         "manager's GET /v1/fleet/peers (SDK "
+                         "get_fleet_peers)"),
     "bootstrap": (None, "control-plane provisioning script channel"),
     "updateConfig": (None, "control-plane config push"),
     "updateToken": (None, "enrollment rotation; control-plane only"),
@@ -275,8 +281,8 @@ def dispatch_problems(root: str) -> List[str]:
 
 # -- 3. /v1 route matrix ------------------------------------------------------
 
-def route_problems(root: str) -> List[str]:
-    tree = ast.parse(_read(root, APP_MODULE), filename=APP_MODULE)
+def _module_routes(root: str, module: str) -> List[Tuple[str, str, int]]:
+    tree = ast.parse(_read(root, module), filename=module)
     routes: List[Tuple[str, str, int]] = []  # (method, path, line)
     for node in ast.walk(tree):
         if (isinstance(node, ast.Call)
@@ -291,16 +297,31 @@ def route_problems(root: str) -> List[str]:
                 routes.append(
                     (node.func.attr[len("add_"):].upper(), path, node.lineno)
                 )
-    if not routes:
-        return [f"{APP_MODULE}: no /v1/* routes found (parser drift?)"]
+    return routes
+
+
+def route_problems(root: str) -> List[str]:
     matrix_src = _read(root, ROUTE_MATRIX_TEST)
     problems: List[str] = []
-    for method, path, line in sorted(routes):
-        if path not in matrix_src:
+    for module in (APP_MODULE, MANAGER_MODULE):
+        # the agent app is the lint's anchor and must exist; the manager
+        # module is optional so the synthetic fixture trees the lint's
+        # own tests build (agent app only) stay valid inputs
+        if (module is not APP_MODULE
+                and not os.path.isfile(os.path.join(root, module))):
+            continue
+        routes = _module_routes(root, module)
+        if not routes:
             problems.append(
-                f"{APP_MODULE}:{line}: {method} {path} has no row in "
-                f"{ROUTE_MATRIX_TEST}"
+                f"{module}: no /v1/* routes found (parser drift?)"
             )
+            continue
+        for method, path, line in sorted(routes):
+            if path not in matrix_src:
+                problems.append(
+                    f"{module}:{line}: {method} {path} has no row in "
+                    f"{ROUTE_MATRIX_TEST}"
+                )
     return problems
 
 

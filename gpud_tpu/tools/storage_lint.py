@@ -40,6 +40,7 @@ from typing import Dict, List, Tuple
 STORE_MODULES = (
     "gpud_tpu/eventstore.py",
     "gpud_tpu/health_history.py",
+    "gpud_tpu/manager/federation.py",
     "gpud_tpu/manager/rollup.py",
     "gpud_tpu/metrics/store.py",
     "gpud_tpu/remediation/audit.py",

@@ -127,6 +127,10 @@ MATRIX = [
     ("outboxAck", {"seq": -1}, "error"),
     ("outboxAck", {"seq": 0}, "ok"),
     ("outboxStatus", {}, "ok"),
+    # peer failover introspection: always answers — circuit stats even
+    # before any session exists, never a crash
+    ("peerStatus", {}, "ok"),
+    ("peerStatus", {"unexpected": "param"}, "ok"),
     # traces: ring snapshot; non-numeric filters error, filters that
     # match nothing (unknown component / correlation id) are empty-ok
     ("traces", {}, "ok"),

@@ -1,0 +1,708 @@
+"""Manager federation tier (manager/peers.py, manager/federation.py,
+session-side failover in session/outbox.py + session/session.py).
+
+Covers, per docs/fleet.md "Federation & failover":
+- rendezvous routing: deterministic, balanced, and minimal-remap (a
+  dead peer's cohort moves; everyone else's owner is unchanged),
+- the replication stream: shipper → wire frames → replica store, with
+  the agent-outbox contract (cumulative acks, monotonic watermark,
+  ack-stall redelivery) and byte-identical replica rows,
+- survivor rebuild (adopt) and scatter-gather merges,
+- agent-side failover: breaker peer rotation with an immediate probe,
+  full-sweep cooldown, and the never-regressing acked watermark when
+  acks from two different peers arrive out of order,
+- the end-to-end two-manager path over real HTTP/gRPC transports.
+"""
+
+import threading
+import time
+
+import pytest
+
+from gpud_tpu.manager import federation as fed_mod
+from gpud_tpu.manager.federation import (
+    REPLICA_KIND,
+    FederationPlane,
+    JournalShipper,
+    ReplicaStore,
+    journal_row_body,
+    merge_agents,
+    merge_fabric,
+    merge_predict,
+    merge_rollup,
+    merge_traces,
+)
+from gpud_tpu.manager.peers import (
+    PeerSet,
+    PeerSpecError,
+    owner_of,
+    parse_peer_spec,
+    rendezvous_rank,
+)
+from gpud_tpu.manager.rollup import TABLE as JOURNAL_TABLE
+from gpud_tpu.manager.rollup import FleetRollupStore
+from gpud_tpu.session import wire
+from gpud_tpu.session.outbox import CircuitBreaker, SessionOutbox
+from gpud_tpu.sqlite import DB
+
+
+# -- peer specs --------------------------------------------------------------
+
+def test_parse_peer_spec_forms():
+    d = parse_peer_spec("m-a=http://127.0.0.1:8000|127.0.0.1:8001")
+    assert d.peer_id == "m-a"
+    assert d.endpoint == "http://127.0.0.1:8000"
+    assert d.grpc_target == "127.0.0.1:8001"
+    d = parse_peer_spec("m-b=http://h:9000/")
+    assert (d.peer_id, d.endpoint, d.grpc_target) == ("m-b", "http://h:9000", "")
+
+
+@pytest.mark.parametrize(
+    "bad", ["", "http://h:9000", "m-a=", "m-a=not-a-url", "=http://h:1"]
+)
+def test_parse_peer_spec_rejects(bad):
+    with pytest.raises(PeerSpecError):
+        parse_peer_spec(bad)
+
+
+# -- rendezvous routing ------------------------------------------------------
+
+PEERS3 = ["m-a", "m-b", "m-c"]
+
+
+def test_rendezvous_deterministic():
+    for agent in ("tpu-vm-0", "tpu-vm-1", "x"):
+        assert owner_of(agent, PEERS3) == owner_of(agent, list(PEERS3))
+        # full rank is a permutation of the ring
+        assert sorted(rendezvous_rank(agent, PEERS3)) == sorted(PEERS3)
+
+
+def test_rendezvous_balanced():
+    agents = [f"tpu-vm-{i}" for i in range(600)]
+    counts = {p: 0 for p in PEERS3}
+    for a in agents:
+        counts[owner_of(a, PEERS3)] += 1
+    # crc32 over the stable slot: not perfect, but nobody starves and
+    # nobody owns the fleet
+    for p, n in counts.items():
+        assert 100 <= n <= 320, counts
+
+
+def test_rendezvous_minimal_remap():
+    """Removing one peer only remaps that peer's cohort."""
+    agents = [f"tpu-vm-{i}" for i in range(400)]
+    before = {a: owner_of(a, PEERS3) for a in agents}
+    after = {a: owner_of(a, ["m-a", "m-c"]) for a in agents}
+    for a in agents:
+        if before[a] != "m-b":
+            assert after[a] == before[a], a
+        else:
+            assert after[a] in ("m-a", "m-c")
+
+
+# -- PeerSet -----------------------------------------------------------------
+
+def _peerset(self_id="m-a", ids=PEERS3, **kw):
+    descs = [parse_peer_spec(f"{p}=http://127.0.0.1:1{i}000")
+             for i, p in enumerate(ids)]
+    return PeerSet(self_id, descs, **kw)
+
+
+def test_peerset_ring_and_neighbors():
+    ps = _peerset()
+    assert ps.ring == sorted(PEERS3)
+    assert ps.successor().peer_id == "m-b"
+    assert ps.predecessor().peer_id == "m-c"
+    assert ps.successor_of("m-c").peer_id == "m-a"
+    assert {p.peer_id for p in ps.others()} == {"m-b", "m-c"}
+
+
+def test_peerset_single_peer_has_no_successor():
+    ps = _peerset(ids=["m-a"])
+    assert ps.successor() is None
+    assert ps.others() == []
+
+
+def test_peerset_probe_flip_edge_and_recovery():
+    ps = _peerset(dead_after_probes=2)
+    now = time.time()
+    assert ps.is_reachable("m-b")
+    assert ps.mark_probe("m-b", False, now, error="boom") is False
+    # the flip edge fires exactly once, at the threshold
+    assert ps.mark_probe("m-b", False, now, error="boom") is True
+    assert ps.mark_probe("m-b", False, now, error="boom") is False
+    assert not ps.is_reachable("m-b")
+    assert [p.peer_id for p in ps.live_others()] == ["m-c"]
+    ps.mark_adopted("m-b")
+    assert ps.is_adopted("m-b")
+    # a successful probe resurrects the peer and clears adoption
+    ps.mark_probe("m-b", True, now + 1, rtt_ms=1.5)
+    assert ps.is_reachable("m-b") and not ps.is_adopted("m-b")
+
+
+def test_peerset_health_block_shape():
+    ps = _peerset()
+    rows = ps.health_block(time.time())
+    assert [r["peer_id"] for r in rows][0] == "m-a"  # self first
+    assert rows[0]["self"] is True
+    for r in rows:
+        for k in ("endpoint", "reachable", "consecutive_failures", "adopted"):
+            assert k in r, r
+
+
+def test_peerset_cohort_counts():
+    ps = _peerset()
+    counts = ps.cohort_counts([f"tpu-vm-{i}" for i in range(60)])
+    assert sum(counts.values()) == 60
+    assert set(counts) <= set(PEERS3)
+
+
+# -- replica store -----------------------------------------------------------
+
+def _mk_db(tmp_path, name="m.db"):
+    return DB(str(tmp_path / name))
+
+
+def _body(agent, seq, payload=b"\x00\x01\xffbin"):
+    return {
+        "agent": agent, "seq": seq, "ts": 100.0 + seq, "ingested": 101.0,
+        "kind": "transition", "dedupe_key": f"k-{agent}-{seq}",
+        "correlation_id": "", "payload_hex": payload.hex(), "shard": 3,
+    }
+
+
+def test_replica_ingest_dedupe_and_watermark(tmp_path):
+    db = _mk_db(tmp_path)
+    rs = ReplicaStore(db)
+    recs = [(i, 0.0, REPLICA_KIND, f"j:{i}", _body("a1", i)) for i in (1, 2, 3)]
+    assert rs.replica_ingest("m-b", recs) == 3
+    # at-least-once redelivery: same rowids are a durable no-op
+    rs.replica_ingest("m-b", recs)
+    assert rs.count("m-b") == 3
+    assert rs.watermark("m-b") == 3
+    rows = rs.rows("m-b")
+    assert [r[0] for r in rows] == [1, 2, 3]
+    # payload blobs survive the hex round-trip byte-identical
+    assert rows[0][8] == b"\x00\x01\xffbin"
+
+
+def test_replica_ingest_rejects_malformed(tmp_path):
+    rs = ReplicaStore(_mk_db(tmp_path))
+    bad = [
+        (1, 0.0, "wrong-kind", "k", _body("a", 1)),
+        (2, 0.0, REPLICA_KIND, "k", "not-a-dict"),
+        (3, 0.0, REPLICA_KIND, "k", {**_body("a", 3), "payload_hex": "zz"}),
+    ]
+    assert rs.replica_ingest("m-b", bad) == 0
+    assert rs.stats()["malformed"] == 3
+    assert rs.count("m-b") == 0
+
+
+# -- journal shipper ---------------------------------------------------------
+
+class _StubSession:
+    """Stands in for the shipper's Session: always connected, records
+    every frame, and can be told to fail sends."""
+
+    def __init__(self):
+        self.connected = True
+        self.active_protocol = "stub"
+        self.frames = []
+        self.send_ok = True
+
+    def send(self, frame):
+        if self.send_ok:
+            self.frames.append(frame)
+        return self.send_ok
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+def _journal_fixture(tmp_path, agents=2, per_agent=5):
+    db = _mk_db(tmp_path, "src.db")
+    rollup = FleetRollupStore(db, shard_count=1)
+    for a in range(agents):
+        recs = [
+            (s, 100.0 + s, "transition",
+             f"k-{a}-{s}", {"component": "cpu", "n": s})
+            for s in range(1, per_agent + 1)
+        ]
+        rollup.ingest(f"tpu-vm-{a}", recs)
+    return db, rollup
+
+
+def _mk_shipper(db, clock=None, **kw):
+    peer = parse_peer_spec("m-b=http://127.0.0.1:19999")
+    kw.setdefault("time_fn", clock or time.monotonic)
+    sh = JournalShipper(db, peer, "m-a", **kw)
+    sh.session = _StubSession()
+    return sh
+
+
+def _decode_frames(frames):
+    dec = wire.DeltaDecoder()
+    out = []
+    for fr in frames:
+        batch = wire.parse_batch(fr.data)
+        assert batch is not None
+        out.extend(dec.decode_record(r) for r in batch["records"])
+    return out
+
+
+def test_shipper_ships_and_advances_on_ack(tmp_path):
+    db, _ = _journal_fixture(tmp_path)
+    sh = _mk_shipper(db, ship_batch=4)
+    assert sh.tick() == 4
+    assert sh.tick() == 4
+    assert sh.tick() == 2  # 10 rows total
+    assert sh.tick() == 0  # nothing above the delivered cursor
+    decoded = _decode_frames(sh.session.frames)
+    assert [seq for seq, *_ in decoded] == list(range(1, 11))
+    # the shipped bodies reconstruct the journal rows exactly
+    src = db.query(f"SELECT rowid, agent, seq, ts, ingested, kind, "
+                   f"dedupe_key, correlation_id, payload, shard "
+                   f"FROM {JOURNAL_TABLE} ORDER BY rowid")
+    for (seq, _ts, kind, key, body), row in zip(decoded, src):
+        assert kind == REPLICA_KIND and key == f"j:{seq}"
+        assert body == journal_row_body(row)
+    sh.on_ack(10)
+    s = sh.stats()
+    assert s["acked_rowid"] == 10 and s["lag_rows"] == 0
+    assert s["frames"] == 3 and s["shipped_rows"] == 10
+
+
+def test_shipper_ack_watermark_is_monotonic(tmp_path):
+    db, _ = _journal_fixture(tmp_path)
+    sh = _mk_shipper(db)
+    sh.on_ack(7)
+    sh.on_ack(3)  # late/out-of-order ack never regresses
+    assert sh.stats()["acked_rowid"] == 7
+
+
+def test_shipper_ack_stall_redelivers_from_watermark(tmp_path):
+    db, _ = _journal_fixture(tmp_path)  # 10 rows
+    clock = [0.0]
+    sh = _mk_shipper(db, clock=lambda: clock[0],
+                     ship_batch=100, redeliver_after=5.0)
+    assert sh.tick() == 10
+    sh.on_ack(4)
+    assert sh.tick() == 0  # delivered cursor is ahead; acks still moving
+    clock[0] = 10.0  # ack progress stalls past the window
+    assert sh.tick() == 6  # rewound to the watermark, rows 5..10 again
+    s = sh.stats()
+    assert s["redeliveries"] == 1
+    tail = _decode_frames(sh.session.frames[-1:])
+    assert [seq for seq, *_ in tail] == list(range(5, 11))
+
+
+def test_shipper_send_failure_rewinds(tmp_path):
+    db, _ = _journal_fixture(tmp_path)
+    sh = _mk_shipper(db, ship_batch=100)
+    sh.session.send_ok = False
+    assert sh.tick() == 0
+    assert sh.stats()["delivered_rowid"] == 0
+    sh.session.send_ok = True
+    assert sh.tick() == 10  # keyframe-anchored retry of the full batch
+
+
+def test_shipper_reconnect_resets_to_acked(tmp_path):
+    db, _ = _journal_fixture(tmp_path)
+    sh = _mk_shipper(db, ship_batch=100)
+    sh.tick()
+    sh.on_ack(6)
+    sh._on_connected()  # the receiving handle's decoder is fresh
+    assert sh.stats()["delivered_rowid"] == 6
+    assert sh.tick() == 4  # 7..10 redelivered, starting at a keyframe
+    tail = _decode_frames(sh.session.frames[-1:])
+    assert tail[0][0] == 7
+
+
+# -- scatter-gather merges ---------------------------------------------------
+
+def test_merge_rollup_sums_and_weights():
+    local = {
+        "agents": 2, "series": 4, "records_total": 100,
+        "availability": 1.0, "mttr_seconds": 0.0, "mtbf_seconds": 100.0,
+        "records_by_kind": {"transition": 100}, "flapping": [],
+        "max_outbox_lag_seconds": 1.0,
+    }
+    remote = {
+        "agents": 3, "series": 12, "records_total": 50,
+        "availability": 0.5, "mttr_seconds": 8.0, "mtbf_seconds": 50.0,
+        "records_by_kind": {"transition": 40, "event": 10},
+        "flapping": [{"agent": "b1", "component": "cpu", "flap_count": 9}],
+        "max_outbox_lag_seconds": 3.0,
+    }
+    m = merge_rollup(local, {"m-b": remote})
+    assert m["agents"] == 5 and m["records_total"] == 150
+    assert m["records_by_kind"] == {"event": 10, "transition": 140}
+    # series-weighted mean: (4*1.0 + 12*0.5) / 16
+    assert m["availability"] == pytest.approx(0.625)
+    assert m["max_outbox_lag_seconds"] == 3.0
+    assert m["flapping"][0]["agent"] == "b1"
+    assert m["cohorts"]["m-b"]["agents"] == 3
+
+
+def test_merge_fabric_ranks_degraded():
+    local = {"agents": 1, "links_total": 4, "degraded_count": 1,
+             "links_by_state": {"healthy": 3, "degraded": 1},
+             "degraded": [{"agent": "a", "link": "l1", "state": "degraded",
+                           "last_degraded_ts": 5.0}]}
+    remote = {"agents": 1, "links_total": 4, "degraded_count": 1,
+              "links_by_state": {"healthy": 3, "down": 1},
+              "degraded": [{"agent": "b", "link": "l2", "state": "down",
+                            "last_degraded_ts": 1.0}]}
+    m = merge_fabric(local, {"m-b": remote})
+    assert m["links_total"] == 8
+    assert m["links_by_state"] == {"degraded": 1, "down": 1, "healthy": 6}
+    assert m["degraded"][0]["state"] == "down"  # severity outranks recency
+
+
+def test_merge_predict_lead_distribution():
+    local = {"agents": 1, "series": 2, "top_k": 3,
+             "risk_buckets": {"high": 1},
+             "top": [{"agent": "a", "component": "cpu", "risk": 0.9}],
+             "lead": {"count": 2, "mean_seconds": 10.0,
+                      "min_seconds": 5.0, "max_seconds": 15.0}}
+    remote = {"agents": 1, "series": 2,
+              "risk_buckets": {"low": 2},
+              "top": [{"agent": "b", "component": "tpu", "risk": 0.95}],
+              "lead": {"count": 2, "mean_seconds": 30.0,
+                       "min_seconds": 2.0, "max_seconds": 60.0}}
+    m = merge_predict(local, {"m-b": remote})
+    assert m["risk_buckets"] == {"high": 1, "low": 2}
+    assert m["top"][0]["agent"] == "b"
+    assert m["lead"]["count"] == 4
+    assert m["lead"]["mean_seconds"] == pytest.approx(20.0)
+    assert m["lead"]["min_seconds"] == 2.0 and m["lead"]["max_seconds"] == 60.0
+
+
+def test_merge_agents_union_annotates_peer():
+    local = {"agents": [{"agent": "a-2"}], "total": 1,
+             "offset": 0, "next_offset": None}
+    remote = {"agents": [{"agent": "a-1"}, {"agent": "a-3"}], "total": 2,
+              "next_offset": None}
+    m = merge_agents(local, {"m-b": remote}, limit=10, self_id="m-a")
+    assert [r["agent"] for r in m["agents"]] == ["a-1", "a-2", "a-3"]
+    assert [r["peer"] for r in m["agents"]] == ["m-b", "m-a", "m-b"]
+    assert m["total"] == 3 and m["next_offset"] is None
+    m = merge_agents(local, {"m-b": remote}, limit=2, self_id="m-a")
+    assert len(m["agents"]) == 2 and m["next_offset"] == 2
+
+
+def test_merge_traces_dedupes_and_sorts():
+    rec = {"agent": "a", "seq": 1, "dedupe_key": "k", "ts": 2.0}
+    local = {"records": [rec], "count": 1}
+    remote = {"records": [dict(rec),
+                          {"agent": "b", "seq": 1, "dedupe_key": "k2",
+                           "ts": 1.0}]}
+    m = merge_traces(local, {"m-b": remote}, limit=10)
+    assert m["count"] == 2
+    assert [r["agent"] for r in m["records"]] == ["b", "a"]
+
+
+# -- breaker failover --------------------------------------------------------
+
+def _tripped(cb):
+    for _ in range(cb.failure_threshold):
+        cb.record_failure()
+
+
+def test_breaker_rotates_and_probes_immediately():
+    clock = [0.0]
+    cb = CircuitBreaker(failure_threshold=2, open_seconds=30.0,
+                        time_fn=lambda: clock[0],
+                        peers=["http://a:1", "http://b:1", "http://c:1"])
+    assert cb.current_peer() == "http://a:1"
+    _tripped(cb)
+    # trip #1: rotated to b, immediate probe — no cooldown served
+    assert cb.current_peer() == "http://b:1"
+    assert cb.seconds_until_probe() == 0.0
+    assert cb.allow() is True
+    assert cb.state == "half_open"
+    # the probe at b fails too → rotate to c, again immediate
+    cb.record_failure()
+    assert cb.current_peer() == "http://c:1"
+    assert cb.allow() is True
+    assert cb.failover_count == 2
+
+
+def test_breaker_full_sweep_falls_back_to_cooldown():
+    clock = [0.0]
+    cb = CircuitBreaker(failure_threshold=1, open_seconds=30.0,
+                        time_fn=lambda: clock[0],
+                        peers=["http://a:1", "http://b:1"])
+    cb.record_failure()          # trip at a → b, immediate probe
+    assert cb.allow() is True
+    cb.record_failure()          # b fails: the whole tier is down
+    assert cb.current_peer() == "http://a:1"  # wrapped
+    assert cb.allow() is False   # normal cooldown now
+    assert cb.seconds_until_probe() == pytest.approx(30.0)
+    clock[0] = 31.0
+    assert cb.allow() is True    # half-open probe after the cooldown
+
+
+def test_breaker_success_resets_sweep():
+    cb = CircuitBreaker(failure_threshold=1, peers=["http://a:1", "http://b:1"])
+    cb.record_failure()
+    assert cb.allow() is True
+    cb.record_success()
+    assert cb.state == "closed"
+    # the sweep counter reset: the next trip gets an immediate probe again
+    cb.record_failure()
+    assert cb.allow() is True
+    s = cb.stats()
+    assert s["peers"] == ["http://a:1", "http://b:1"]
+    assert s["failovers"] == 2
+
+
+def test_breaker_without_peers_unchanged():
+    clock = [0.0]
+    cb = CircuitBreaker(failure_threshold=1, open_seconds=5.0,
+                        time_fn=lambda: clock[0])
+    cb.record_failure()
+    assert cb.current_peer() == ""
+    assert cb.allow() is False  # classic park-for-cooldown behavior
+    clock[0] = 6.0
+    assert cb.allow() is True
+
+
+def test_breaker_single_peer_never_rotates():
+    cb = CircuitBreaker(failure_threshold=1, peers=["http://a:1"])
+    cb.record_failure()
+    assert cb.current_peer() == "http://a:1"
+    assert cb.failover_count == 0
+    assert cb.allow() is False
+
+
+# -- watermark safety across peers -------------------------------------------
+
+def test_outbox_watermark_never_regresses_across_peers(tmp_path):
+    """Acks from two different managers arriving out of order: the
+    watermark is MAX in memory AND in SQL, so the late, smaller ack from
+    the dead peer is a no-op."""
+    db = _mk_db(tmp_path, "agent.db")
+    ob = SessionOutbox(db)
+    for i in range(8):
+        ob.publish("transition", {"n": i}, dedupe_key=f"k{i}")
+    ob.ack(3)                # peer A acked the prefix before dying
+    ob.ack(8)                # peer B acked the redelivered batch
+    assert ob.acked_seq == 8
+    ob.ack(5)                # A's stale ack arrives late (network queue)
+    assert ob.acked_seq == 8
+    from gpud_tpu.session.outbox import ACK_TABLE
+
+    row = db.query_one(f"SELECT acked_seq FROM {ACK_TABLE} WHERE id=1")
+    assert int(row[0]) == 8
+    assert ob.backlog() == 0
+
+
+def test_outbox_watermark_concurrent_two_peer_acks(tmp_path):
+    ob = SessionOutbox(_mk_db(tmp_path, "agent2.db"))
+    for i in range(100):
+        ob.publish("event", {"n": i})
+    seqs_a = list(range(1, 101, 2))
+    seqs_b = list(range(2, 101, 2))
+
+    def hammer(seqs):
+        for s in seqs:
+            ob.ack(s)
+
+    ta = threading.Thread(target=hammer, args=(seqs_a,))
+    tb = threading.Thread(target=hammer, args=(seqs_b,))
+    ta.start(); tb.start(); ta.join(); tb.join()
+    assert ob.acked_seq == 100
+
+
+# -- Session._apply_peer -----------------------------------------------------
+
+def _mk_session():
+    from gpud_tpu.session.session import Session
+
+    return Session(endpoint="http://old:1", machine_id="m",
+                   v2_target="old:2", protocol="auto")
+
+
+@pytest.mark.parametrize("spec,endpoint,v2", [
+    ("http://new:1", "http://new:1", ""),
+    ("http://new:1|new:2", "http://new:1", "new:2"),
+    ("m-b=http://new:1|new:2", "http://new:1", "new:2"),
+    ("m-b=http://new:1/", "http://new:1", ""),
+])
+def test_apply_peer_retargets(spec, endpoint, v2):
+    s = _mk_session()
+    s._v2_failed = True
+    s._v2_skip_cycles = 3
+    s._apply_peer(spec)
+    assert s.endpoint == endpoint
+    assert s.v2_target == v2
+    # the new peer negotiates its own transport
+    assert s._v2_failed is False and s._v2_skip_cycles == 0
+
+
+def test_apply_peer_noop_on_same_or_empty():
+    s = _mk_session()
+    s._apply_peer("")
+    s._apply_peer("http://old:1|old:2")
+    assert s.endpoint == "http://old:1" and s.v2_target == "old:2"
+
+
+# -- end-to-end: two real managers -------------------------------------------
+
+def _wait(pred, timeout=20.0, interval=0.05, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _spec(pid, cp):
+    return f"{pid}=http://127.0.0.1:{cp.port}|127.0.0.1:{cp.grpc_port}"
+
+
+@pytest.fixture()
+def two_managers(tmp_path):
+    from gpud_tpu.manager.control_plane import ControlPlane
+
+    cps = {}
+    for pid in ("m-a", "m-b"):
+        cp = ControlPlane(
+            instance_id=pid, data_dir=str(tmp_path / pid), shards=1
+        )
+        cp.start()
+        cps[pid] = cp
+    specs = [_spec(pid, cp) for pid, cp in cps.items()]
+    for pid, cp in cps.items():
+        cp.attach_peers(
+            pid, specs,
+            replication_interval=0.1, probe_interval=0.3,
+            fanout_timeout=2.0, dead_after_probes=2,
+        )
+    yield cps
+    for cp in cps.values():
+        try:
+            cp.stop()
+        except Exception:
+            pass
+
+
+def _http_json(url):
+    import json as _json
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return _json.loads(resp.read().decode())
+
+
+def test_two_manager_replication_failover_and_scatter(two_managers):
+    a, b = two_managers["m-a"], two_managers["m-b"]
+
+    # cohort ingest at A (the agent transport path feeds rollup.ingest
+    # exactly like this — the wire layers have their own e2e tests)
+    for n in range(4):
+        recs = [
+            (s, 1000.0 + s, "transition", f"k-{n}-{s}",
+             {"component": "cpu", "health": "healthy", "n": s})
+            for s in range(1, 26)
+        ]
+        a.rollup.ingest(f"tpu-vm-a{n}", recs)
+    a.writer.flush(timeout=10.0)
+    head = a.federation.shipper.journal_head()
+    assert head == 100
+
+    # replication stream: B's replica converges on A's journal head.
+    # Generous ceiling: on a loaded 1-core CI box the shipper's first
+    # connects can fail and walk the session backoff (1s doubling,
+    # BACKOFF_MAX 60s) before the stream establishes
+    _wait(lambda: b.federation.replica.watermark("m-a") >= head,
+          timeout=90.0, msg="replica watermark")
+    b.writer.flush(timeout=10.0)
+    src_rows = a.db.query(
+        f"SELECT rowid, agent, seq, ts, ingested, kind, dedupe_key, "
+        f"correlation_id, payload, shard FROM {JOURNAL_TABLE} ORDER BY rowid"
+    )
+    rep_rows = b.federation.replica.rows("m-a")
+    # byte-identical survivor prefix: every column, payload blobs included
+    assert [tuple(r) for r in rep_rows] == [tuple(r) for r in src_rows]
+
+    # scatter-gather while both peers live: one pane over both cohorts
+    b.rollup.ingest("tpu-vm-b0", [(1, 1000.0, "transition", "kb-1",
+                                   {"component": "cpu", "health": "healthy"})])
+    pane = _http_json(f"{b.endpoint}/v1/fleet/rollup")
+    assert pane["federated"] is True
+    assert pane["agents"] == 5
+    assert {p["peer_id"] for p in pane["peers"]} == {"m-a", "m-b"}
+    assert "m-a" in pane["fanout"] and "error" not in pane["fanout"]["m-a"]
+    local = _http_json(f"{b.endpoint}/v1/fleet/rollup?scope=local")
+    assert "federated" not in local and local["agents"] == 1
+
+    peers_view = _http_json(f"{b.endpoint}/v1/fleet/peers")
+    assert peers_view["federation"] is True
+    assert peers_view["ring"] == ["m-a", "m-b"]
+    assert peers_view["successor"] == "m-a"
+    assert sum(peers_view["rendezvous"].values()) == 1  # B's own cohort
+
+    # kill A; B's probes flip it dead and the survivor adopts the cohort
+    records_before = b.rollup.records_total()
+    a.stop()
+    _wait(lambda: b.federation.peers.is_adopted("m-a"), timeout=60.0,
+          msg="survivor adopt")
+    assert b.rollup.records_total() == records_before + 100
+    assert set(b.rollup.agent_ids()) >= {f"tpu-vm-a{n}" for n in range(4)}
+
+    # a failed-over agent redelivers its last batch: dedupe, not growth
+    recs = [(s, 1000.0 + s, "transition", f"k-0-{s}",
+             {"component": "cpu", "health": "healthy", "n": s})
+            for s in range(1, 26)]
+    assert b.rollup.ingest("tpu-vm-a0", recs) == 0
+
+    # the single pane survives: dead peer visibly unreachable, not silent
+    pane = _http_json(f"{b.endpoint}/v1/fleet/rollup")
+    assert pane["federated"] is True
+    assert pane["agents"] == 5  # 4 adopted + b0, all served by the survivor
+    dead = [p for p in pane["peers"] if p["peer_id"] == "m-a"]
+    assert dead and dead[0]["reachable"] is False and dead[0]["adopted"]
+
+    # federated /metrics reflects the peer map
+    import urllib.request
+
+    with urllib.request.urlopen(f"{b.endpoint}/metrics", timeout=10) as resp:
+        text = resp.read().decode()
+    assert "tpud_fleet_peers 2" in text
+    assert "tpud_fleet_peer_adopts 1" in text
+
+
+def test_federation_plane_standalone_bits(tmp_path):
+    """FederationPlane odds and ends that don't need live peers."""
+    db = _mk_db(tmp_path, "fp.db")
+    rollup = FleetRollupStore(db, shard_count=1)
+    descs = [parse_peer_spec("m-a=http://127.0.0.1:1"),
+             parse_peer_spec("m-b=http://127.0.0.1:2")]
+    fp = FederationPlane(PeerSet("m-a", descs), rollup, db,
+                         probe_interval=600, replication_interval=600)
+    try:
+        # replica_sink strips the peer: prefix and journals the batch
+        sink = fp.replica_sink(f"{fed_mod.PEER_MACHINE_PREFIX}m-b")
+        body = _body("a1", 1, payload=wire.pack_obj(
+            {"component": "cpu", "health": "healthy"}
+        ))
+        sink("peer:m-b", [(1, 0.0, REPLICA_KIND, "j:1", body)])
+        assert fp.replica.count("m-b") == 1
+        # adopt replays the replicated prefix into the local rollup
+        fp.peers.mark_probe("m-b", False, time.time())
+        fp.adopt("m-b")
+        assert rollup.records_total() == 1
+        assert fp.adopt("m-b") == 0  # idempotent
+        view = fp.peers_view()
+        assert view["replication"]["peer"] == "m-b"
+        assert view["replica"]["accepted"] == 1
+        stats = fp.stats()
+        assert stats["peers_total"] == 2 and stats["adopts"] == 1
+    finally:
+        fp.stop()
