@@ -69,9 +69,11 @@ class Bucket:
         dedupe before insert (reference: xid/component.go:545-570)."""
         return self._store._find(self.component, ev)
 
-    def get(self, since: float) -> List[Event]:
-        """All events at/after ``since``, newest first."""
-        return self._store._get(self.component, since)
+    def get(self, since: float, barrier: bool = True) -> List[Event]:
+        """All events at/after ``since``, newest first. ``barrier=False``
+        skips the writer flush — for callers that already flushed once
+        and fan out over many components (health-timeline correlation)."""
+        return self._store._get(self.component, since, barrier=barrier)
 
     def latest(self) -> Optional[Event]:
         evs = self._store._get(self.component, 0.0, limit=1)
@@ -183,8 +185,10 @@ class EventStore:
             return None
         return _row_to_event(component, row)
 
-    def _get(self, component: str, since: float, limit: int = 0) -> List[Event]:
-        self.flush()
+    def _get(self, component: str, since: float, limit: int = 0,
+             barrier: bool = True) -> List[Event]:
+        if barrier:
+            self.flush()
         sql = (
             f"SELECT timestamp, name, type, message, extra_info FROM {TABLE} "
             "WHERE component=? AND timestamp>=? ORDER BY timestamp DESC"
@@ -196,8 +200,10 @@ class EventStore:
         rows = self.db.query(sql, params)
         return [_row_to_event(component, r) for r in rows]
 
-    def _purge(self, component: str, before: float) -> int:
-        self.flush()
+    def _purge(self, component: str, before: float,
+               barrier: bool = True) -> int:
+        if barrier:
+            self.flush()
         cur = self.db.execute(
             f"DELETE FROM {TABLE} WHERE component=? AND timestamp<?",
             (component, before),
@@ -240,7 +246,10 @@ class EventStore:
         ]
         total = 0
         for comp in comps:
-            n = self._purge(comp, cutoff)
+            # barrier=False: the single flush above already fenced every
+            # buffered row behind the cutoff — N per-component re-flushes
+            # bought nothing (flow_lint flush-audit, PR 19)
+            n = self._purge(comp, cutoff, barrier=False)
             if n:
                 _c_purged.inc(n, {"component": comp})
                 total += n

@@ -480,13 +480,20 @@ class HealthLedger:
         'what else happened around that flip' context for timelines."""
         w = self.correlation_window if window is None else window
         es = self.event_store
+        if es is not None and w >= 0 and transitions:
+            # one event-store barrier for the whole timeline; the
+            # per-transition gets below were each re-flushing the shared
+            # writer (flow_lint flush-audit, PR 19)
+            es.flush()
         for t in transitions:
             events: List[Dict] = []
             if es is not None and w >= 0:
                 try:
                     events = [
                         e.to_dict()
-                        for e in es.bucket(t["component"]).get(t["time"] - w)
+                        for e in es.bucket(t["component"]).get(
+                            t["time"] - w, barrier=False
+                        )
                         if e.time <= t["time"] + w
                     ]
                 except Exception:  # noqa: BLE001
@@ -576,8 +583,9 @@ class HealthLedger:
         )
         return mttr, mtbf
 
-    def components(self) -> List[str]:
-        self.flush()
+    def components(self, barrier: bool = True) -> List[str]:
+        if barrier:
+            self.flush()
         return [
             r[0]
             for r in self.db.query(
@@ -605,9 +613,16 @@ class HealthLedger:
         window_seconds: Optional[float] = None,
         now: Optional[float] = None,
     ) -> Dict[str, Dict]:
+        # one barrier for the whole sweep: the per-component availability
+        # reads see everything this flush committed, so the old N+1
+        # re-flushes (one inside components() plus one per availability()
+        # call) were pure barrier overhead (flow_lint flush-audit, PR 19)
+        self.flush()
         out = {}
-        for c in self.components():
-            av = self.availability(c, window_seconds=window_seconds, now=now)
+        for c in self.components(barrier=False):
+            av = self.availability(
+                c, window_seconds=window_seconds, now=now, barrier=False
+            )
             if av is not None:
                 out[c] = av
         return out
@@ -617,7 +632,7 @@ class HealthLedger:
         self.flush()
         ts = self.time_now_fn() if now is None else now
         row = self.db.query_one(f"SELECT COUNT(*) FROM {TABLE}")
-        comps = self.components()
+        comps = self.components(barrier=False)  # fenced by the flush above
         return {
             "transitions_total": int(row[0]) if row else 0,
             "components_tracked": len(comps),

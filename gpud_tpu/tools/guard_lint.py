@@ -22,7 +22,10 @@ deliberate and all visible in the report:
     methods are skipped but every waiver must carry a non-empty reason
     string, must still be *needed* (a waiver over a clean method is a
     stale-marker error), and is printed in the lint report so review
-    sees the full waiver surface on every run.
+    sees the full waiver surface on every run. A reason may carry an
+    expiry stamp ``until: PR-N``: the waiver fails once PR N is being
+    built (``current_pr_number`` = max CHANGES.md entry + 1), so
+    temporary waivers cannot quietly become permanent.
 
 The analysis is lexical, not interprocedural, with two affordances the
 codebase's idiom requires:
@@ -46,6 +49,7 @@ from __future__ import annotations
 
 import ast
 import os
+import re
 import sys
 from typing import Dict, FrozenSet, List, Optional, Tuple
 
@@ -73,6 +77,51 @@ def _repo_root() -> str:
     return os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     )
+
+
+_UNTIL_RE = re.compile(r"until:\s*PR-(\d+)\b")
+_PR_LINE_RE = re.compile(r"^PR (\d+)\b", re.M)
+_pr_cache: Dict[str, int] = {}
+
+
+def current_pr_number(root: str = "") -> int:
+    """The PR being built right now: max ``PR N`` entry in CHANGES.md
+    plus one (each session appends its line only at the end)."""
+    root = root or _repo_root()
+    cached = _pr_cache.get(root)
+    if cached is not None:
+        return cached
+    seen = 0
+    path = os.path.join(root, "CHANGES.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            for m in _PR_LINE_RE.finditer(f.read()):
+                seen = max(seen, int(m.group(1)))
+    except OSError:
+        pass
+    _pr_cache[root] = seen + 1
+    return seen + 1
+
+
+def waiver_reason_problems(reason: object, root: str = "") -> List[str]:
+    """Shared waiver-reason checks (guard_lint, flow_lint, boundary_lint):
+    a reason must be a non-empty string, and may carry an expiry stamp
+    ``until: PR-N`` — the waiver is good for PRs *before* N and fails
+    once PR N is being built, forcing the owner to resolve or re-justify
+    it in that PR."""
+    if not (isinstance(reason, str) and reason.strip()):
+        return ["has no justification — every waiver carries a reason"]
+    m = _UNTIL_RE.search(reason)
+    if m is not None:
+        deadline = int(m.group(1))
+        current = current_pr_number(root)
+        if current >= deadline:
+            return [
+                f"expired: stamped `until: PR-{deadline}` and this is "
+                f"PR {current} — resolve the waiver or restamp it with a "
+                "new deadline and justification"
+            ]
+    return []
 
 
 def _class_dict(cls: ast.ClassDef, name: str) -> Tuple[Optional[Dict], int]:
@@ -238,7 +287,8 @@ def _lock_defined(classes: List[ast.ClassDef], lock: str) -> bool:
     return False
 
 
-def lint_module(path: str, rel: str) -> Tuple[List[str], List[str]]:
+def lint_module(path: str, rel: str,
+                root: str = "") -> Tuple[List[str], List[str]]:
     """Returns (problems, waivers) for one module."""
     with open(path, encoding="utf-8") as f:
         tree = ast.parse(f.read(), filename=rel)
@@ -299,10 +349,9 @@ def lint_module(path: str, rel: str) -> Tuple[List[str], List[str]]:
                     f"{rel}:{lf_line}: {cls.name}._LOCK_FREE waives "
                     f"{name!r} but no such method exists (stale waiver)"
                 )
-            if not (isinstance(reason, str) and reason.strip()):
+            for why in waiver_reason_problems(reason, root=root):
                 problems.append(
-                    f"{rel}:{lf_line}: {cls.name}._LOCK_FREE[{name!r}] "
-                    "has no justification — every waiver carries a reason"
+                    f"{rel}:{lf_line}: {cls.name}._LOCK_FREE[{name!r}] {why}"
                 )
 
         for name, fn in methods.items():
@@ -347,7 +396,7 @@ def run_full(root: str = "") -> Tuple[List[str], List[str]]:
         if not os.path.isfile(path):
             problems.append(f"{rel}: guarded module missing")
             continue
-        p, w = lint_module(path, rel)
+        p, w = lint_module(path, rel, root=root)
         problems.extend(p)
         waivers.extend(w)
     return problems, waivers

@@ -24,9 +24,20 @@ Checks:
 - **race**: the ``bench.py --race`` harness stays wired — flag, dispatch,
   GIL amplifier, and exit gates all present (the harness itself is a
   bench, only its registration is linted here).
+- **flow**: interprocedural hot-path reachability — no session-reader /
+  shard-executor / scheduler / HTTP entrypoint reaches a blocking sink
+  without a justified waiver (flow_lint).
+- **boundary**: payloads crossing the outbox / Frame / ingest-executor
+  serialization seams stay msgpack-safe and journal-derivable
+  (boundary_lint).
+- **schema**: the wire surface (codec prefixes, delta records,
+  ``outbox_batch``, Frame revisions, journal rows, predict payloads)
+  matches the frozen golden (schema_lint).
 
 Run: ``python -m gpud_tpu.tools.lint_all`` (exit 1 on any problem);
-``--json`` emits a machine-readable problem list instead of text.
+``--json`` emits a machine-readable problem list instead of text;
+``--update-goldens`` regenerates the schema golden from the current
+tree (bumping its version) instead of linting.
 """
 
 from __future__ import annotations
@@ -151,7 +162,15 @@ def run_all() -> List[str]:
     """Every lint, one problem list; [] = clean. Problems are prefixed
     with their lint's name so a CI log line is self-locating."""
     from gpud_tpu.metrics.registry import DEFAULT_REGISTRY
-    from gpud_tpu.tools import guard_lint, metrics_lint, parity_lint, storage_lint
+    from gpud_tpu.tools import (
+        boundary_lint,
+        flow_lint,
+        guard_lint,
+        metrics_lint,
+        parity_lint,
+        schema_lint,
+        storage_lint,
+    )
 
     problems: List[str] = []
     metrics_lint.populate_default_registry()
@@ -163,13 +182,16 @@ def run_all() -> List[str]:
     problems.extend(f"guard: {p}" for p in guard_lint.run_lint())
     problems.extend(f"parity: {p}" for p in parity_lint.run_lint())
     problems.extend(f"race: {p}" for p in race_harness_problems())
+    problems.extend(f"flow: {p}" for p in flow_lint.run_lint())
+    problems.extend(f"boundary: {p}" for p in boundary_lint.run_lint())
+    problems.extend(f"schema: {p}" for p in schema_lint.run_lint())
     return problems
 
 
 # problems carry a "<lint>: <file>:<line>: <message>" shape when they
 # anchor to a source line; lints that check cross-file invariants (e.g.
 # openapi parity) omit the location
-_PROBLEM_RE = re.compile(r"^(?P<lint>[a-z]+): (?:(?P<file>[^\s:]+\.(?:py|md))"
+_PROBLEM_RE = re.compile(r"^(?P<lint>[a-z]+): (?:(?P<file>[^\s:]+\.(?:py|md|json))"
                          r"(?::(?P<line>\d+))?: )?(?P<message>.*)$", re.S)
 
 
@@ -192,6 +214,12 @@ def problems_as_json(problems: List[str]) -> List[Dict]:
 
 def main(argv: List[str] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
+    if "--update-goldens" in argv:
+        from gpud_tpu.tools import schema_lint
+
+        path, changed = schema_lint.update_golden()
+        print(f"lint-all: {'updated' if changed else 'unchanged'} {path}")
+        return 0
     as_json = "--json" in argv
     problems = run_all()
     if as_json:
@@ -203,7 +231,7 @@ def main(argv: List[str] = None) -> int:
         print(f"lint-all: {len(problems)} problem(s)", file=sys.stderr)
         return 1
     print("lint-all: metrics + storage + openapi + guard + parity + "
-          "race-wiring clean")
+          "race-wiring + flow + boundary + schema clean")
     return 0
 
 

@@ -1,0 +1,249 @@
+"""flow_lint fixture trees: the interprocedural walk must catch the
+PR 12 inline-ingest regression (a session reader reaching SQL and a
+writer flush barrier through the call graph), stay quiet on the
+enqueue-only shape PR 14 established, and keep its waiver book honest
+(used waivers clear findings, unused waivers are errors, expired
+``until: PR-N`` stamps fail).
+
+The fixture trees mirror the real repo's layout (same module paths,
+same class names) so the lint's declarative tables — PRIMITIVE_SINKS,
+ATTR_BINDINGS — resolve against them exactly as they do in the real
+tree."""
+
+from gpud_tpu.tools import flow_lint
+
+READER_EP = (
+    ("session_reader",
+     "gpud_tpu/manager/control_plane.py::AgentHandle.resolve",
+     "per-frame reader"),
+)
+
+WRITER_MODULE = '''\
+class BatchWriter:
+    def __init__(self, db):
+        self.db = db
+
+    def submit_many(self, store, sql, rows):
+        self.db.executemany(sql, rows)  # stopped-writer fallback
+
+    def flush(self, timeout=30.0):
+        pass
+'''
+
+SHARD_MODULE = '''\
+class ShardIngestExecutor:
+    def submit(self, machine_id, fn):
+        self._q.append(fn)
+'''
+
+
+def _write(root, rel, text):
+    p = root / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(text)
+
+
+def _tree(tmp_path, control_plane_src):
+    _write(tmp_path, "gpud_tpu/storage/writer.py", WRITER_MODULE)
+    _write(tmp_path, "gpud_tpu/manager/shard.py", SHARD_MODULE)
+    _write(tmp_path, "gpud_tpu/manager/control_plane.py", control_plane_src)
+    return str(tmp_path)
+
+
+# -- the PR 12 regression shape ----------------------------------------------
+
+REGRESSION_CP = '''\
+from gpud_tpu.storage.writer import BatchWriter
+
+
+class AgentHandle:
+    def __init__(self, db):
+        self.db = db
+        self.writer = BatchWriter(db)
+
+    def resolve(self, frame):
+        # regression: ingest runs inline on the session reader thread
+        self._ingest_outbox(frame.data)
+
+    def _ingest_outbox(self, payload):
+        self.db.execute("INSERT INTO j VALUES (?)", (payload,))
+        self.writer.flush(timeout=5.0)
+'''
+
+
+def test_inline_ingest_regression_reaches_both_sinks(tmp_path):
+    root = _tree(tmp_path, REGRESSION_CP)
+    problems, _ = flow_lint.run_full(root=root, waivers={},
+                                     entrypoints=READER_EP)
+    blob = "\n".join(problems)
+    assert "forbidden sql sink" in blob
+    assert "forbidden flush barrier BatchWriter.flush" in blob
+    # findings carry the full call chain for triage
+    assert "AgentHandle.resolve -> " in blob
+    assert "AgentHandle._ingest_outbox" in blob
+
+
+def test_waiver_clears_the_regression_and_is_marked_used(tmp_path):
+    root = _tree(tmp_path, REGRESSION_CP)
+    waivers = {
+        ("session_reader",
+         "gpud_tpu/manager/control_plane.py::AgentHandle._ingest_outbox",
+         "*"): "fixture: inline path is test-only",
+    }
+    problems, notes = flow_lint.run_full(root=root, waivers=waivers,
+                                         entrypoints=READER_EP)
+    assert problems == []
+    assert any("_ingest_outbox" in n for n in notes)
+
+
+def test_stale_waiver_is_an_error(tmp_path):
+    root = _tree(tmp_path, REGRESSION_CP)
+    waivers = {
+        ("session_reader",
+         "gpud_tpu/manager/control_plane.py::AgentHandle._ingest_outbox",
+         "*"): "fixture waiver",
+        ("session_reader",
+         "gpud_tpu/manager/control_plane.py::AgentHandle.never_reached",
+         "*"): "points at nothing",
+    }
+    problems, _ = flow_lint.run_full(root=root, waivers=waivers,
+                                     entrypoints=READER_EP)
+    assert any("never reached" in p and "stale waiver" in p
+               for p in problems)
+
+
+def test_expired_waiver_fails_even_when_used(tmp_path):
+    root = _tree(tmp_path, REGRESSION_CP)
+    _write(tmp_path, "CHANGES.md", "PR 7 something earlier\n")
+    waivers = {
+        ("session_reader",
+         "gpud_tpu/manager/control_plane.py::AgentHandle._ingest_outbox",
+         "*"): "temporary until: PR-3 while the executor lands",
+    }
+    problems, _ = flow_lint.run_full(root=root, waivers=waivers,
+                                     entrypoints=READER_EP)
+    assert any("expired" in p and "PR-3" in p for p in problems)
+
+
+# -- the PR 14 enqueue-only shape --------------------------------------------
+
+ENQUEUE_ONLY_CP = '''\
+from gpud_tpu.storage.writer import BatchWriter
+
+
+class AgentHandle:
+    def __init__(self, db):
+        self.db = db
+        self.writer = BatchWriter(db)
+        self.ingest_executor = None
+
+    def resolve(self, frame):
+        payload = frame.data
+        ex = self.ingest_executor
+        if ex is not None:
+            ex.submit("m1", lambda: self._ingest_outbox(payload))
+            return
+        self._ingest_outbox(payload)
+
+    def _ingest_outbox(self, payload):
+        self.writer.submit_many("journal", "INSERT", [(payload,)])
+'''
+
+
+def test_enqueue_only_reader_is_clean(tmp_path):
+    """The reader hands the closure to the shard executor and the
+    closure's own role (shard_executor) permits buffered appends — the
+    walk stops at BatchWriter.submit_many instead of flagging its
+    stopped-writer fallback SQL. The conditional inline edge still
+    needs its waiver (path-insensitivity is the documented contract)."""
+    root = _tree(tmp_path, ENQUEUE_ONLY_CP)
+    waivers = {
+        ("session_reader",
+         "gpud_tpu/manager/control_plane.py::AgentHandle._ingest_outbox",
+         "*"): "inline fallback is executor-less test handles only",
+    }
+    problems, _ = flow_lint.run_full(root=root, waivers=waivers,
+                                     entrypoints=READER_EP)
+    assert problems == []
+
+
+def test_submitted_closure_is_rechecked_as_shard_executor(tmp_path):
+    """Moving work onto the shard executor does not launder it: a
+    closure that sleeps is flagged under the shard_executor role even
+    though the reader itself only enqueues."""
+    src = ENQUEUE_ONLY_CP.replace(
+        'ex.submit("m1", lambda: self._ingest_outbox(payload))',
+        'ex.submit("m1", lambda: self._slow_ingest(payload))',
+    ) + '''
+    def _slow_ingest(self, payload):
+        import time
+        time.sleep(1.0)
+'''
+    root = _tree(tmp_path, src)
+    waivers = {
+        ("session_reader",
+         "gpud_tpu/manager/control_plane.py::AgentHandle._ingest_outbox",
+         "*"): "inline fallback is executor-less test handles only",
+    }
+    problems, _ = flow_lint.run_full(root=root, waivers=waivers,
+                                     entrypoints=READER_EP)
+    assert any("[shard_executor]" in p and "sleep" in p for p in problems)
+
+
+# -- discovered entrypoint families ------------------------------------------
+
+def test_scheduler_job_target_must_not_sleep(tmp_path):
+    _write(tmp_path, "gpud_tpu/storage/writer.py", WRITER_MODULE)
+    _write(tmp_path, "gpud_tpu/svc.py", '''\
+import time
+
+
+class Svc:
+    def start(self, scheduler):
+        scheduler.add_job("svc-tick", self._tick, interval=5.0)
+
+    def _tick(self):
+        time.sleep(0.5)  # steals a shared scheduler worker
+''')
+    problems, _ = flow_lint.run_full(root=str(tmp_path), waivers={},
+                                     entrypoints=())
+    assert any("[scheduler_worker]" in p and "time.sleep()" in p
+               and "svc-tick" in p for p in problems)
+
+
+def test_http_handler_blocking_sql_is_flagged(tmp_path):
+    _write(tmp_path, "gpud_tpu/storage/writer.py", WRITER_MODULE)
+    _write(tmp_path, "gpud_tpu/server/app.py", '''\
+def build_app(srv):
+    async def states(request):
+        return srv.db.query("SELECT * FROM states")
+
+    r = object()
+    r.add_get("/v1/states", states)
+    return r
+''')
+    problems, _ = flow_lint.run_full(root=str(tmp_path), waivers={},
+                                     entrypoints=())
+    assert any("[http_handler]" in p and "sql" in p and "/v1/states" in p
+               for p in problems)
+
+
+def test_missing_pinned_entrypoint_is_drift(tmp_path):
+    _write(tmp_path, "gpud_tpu/storage/writer.py", WRITER_MODULE)
+    eps = (("session_reader", "gpud_tpu/gone.py::Gone.resolve", "x"),)
+    problems, _ = flow_lint.run_full(root=str(tmp_path), waivers={},
+                                     entrypoints=eps)
+    assert any("is gone" in p and "ENTRYPOINTS" in p for p in problems)
+
+
+# -- the real tree -----------------------------------------------------------
+
+def test_real_tree_reader_invariant_holds():
+    """PR 14's reader-only-enqueues invariant, machine-checked: the
+    declared entrypoints plus every discovered scheduler job and HTTP
+    handler reach zero forbidden sinks, modulo the written waiver book."""
+    problems, notes = flow_lint.run_full()
+    assert problems == []
+    # the inline-fallback waiver is the load-bearing one; if it vanishes
+    # from the book the invariant is no longer being proven end-to-end
+    assert any("_ingest_outbox" in n for n in notes)

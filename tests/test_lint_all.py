@@ -7,8 +7,9 @@ module and assert it objects — a lint that silently passes everything
 is worse than no lint (it certifies unreviewed code)."""
 
 import json
+import os
 
-from gpud_tpu.tools import guard_lint, parity_lint
+from gpud_tpu.tools import boundary_lint, guard_lint, parity_lint, schema_lint
 from gpud_tpu.tools.lint_all import main, problems_as_json, run_all
 
 
@@ -25,6 +26,8 @@ def test_problems_as_json_splits_location():
     rows = problems_as_json([
         "guard: gpud_tpu/storage/writer.py:41: self._pending read outside _cv",
         "openapi: served but undocumented: GET /v1/x",
+        "schema: gpud_tpu/tools/goldens/wire_schema.json: drift at "
+        "predict.schema",
     ])
     assert rows[0] == {
         "lint": "guard",
@@ -34,6 +37,9 @@ def test_problems_as_json_splits_location():
     }
     assert rows[1]["lint"] == "openapi"
     assert rows[1]["file"] is None and rows[1]["line"] is None
+    # golden drift problems anchor to the .json golden itself
+    assert rows[2]["file"] == "gpud_tpu/tools/goldens/wire_schema.json"
+    assert rows[2]["line"] is None
 
 
 # -- guard_lint on a deliberately broken module ------------------------------
@@ -153,3 +159,180 @@ def test_parity_lint_flags_dispatch_method_without_sdk_disposition(tmp_path):
     # the new verb needs both a matrix row and an SDK disposition
     assert "'brandNewVerb' has no error-matrix row" in blob
     assert "'brandNewVerb' has no entry" in blob
+
+
+# -- guard_lint waiver expiry (until: PR-N) ----------------------------------
+
+EXPIRING_GUARD_MODULE = '''\
+import threading
+
+
+class Temp:
+    GUARDED_BY = {"_items": "_mu"}
+    _LOCK_FREE = {"expired_read": "snapshot ok until: PR-3 when shards land",
+                  "current_read": "snapshot ok until: PR-900"}
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._items = []
+
+    def expired_read(self):
+        return list(self._items)
+
+    def current_read(self):
+        return list(self._items)
+'''
+
+
+def test_guard_lint_expired_waiver_fails(tmp_path):
+    (tmp_path / "CHANGES.md").write_text("PR 9 earlier work\n")
+    path = tmp_path / "temp.py"
+    path.write_text(EXPIRING_GUARD_MODULE)
+    problems, waivers = guard_lint.lint_module(
+        str(path), "temp.py", root=str(tmp_path)
+    )
+    blob = "\n".join(problems)
+    # CHANGES.md tops out at PR 9 → this is PR 10 → the PR-3 stamp is
+    # long past; the PR-900 stamp is still a justified waiver
+    assert "expired_read" in blob and "until: PR-3" in blob.replace("`", "")
+    assert "current_read" not in blob
+    assert any("current_read" in w for w in waivers)
+
+
+def test_current_pr_number_is_changes_md_max_plus_one(tmp_path):
+    (tmp_path / "CHANGES.md").write_text(
+        "PR 1 one\nPR 12 twelve\nPR 3 three\n"
+    )
+    assert guard_lint.current_pr_number(str(tmp_path)) == 13
+
+
+# -- boundary_lint -----------------------------------------------------------
+
+BROKEN_BOUNDARY_MODULE = '''\
+class Publisher:
+    def bad_payload(self, outbox, comp):
+        outbox.publish("health", {
+            "component": comp,
+            "probe": lambda: 1,
+            "tags": {"a", "b"},
+        })
+
+    def bad_closure(self, payload):
+        ex = self.ingest_executor
+        ex.submit("m1", lambda: self._lock.acquire())
+
+    def fine(self, session, payload):
+        session.send(Frame(req_id="x", data=payload))
+'''
+
+
+def test_boundary_lint_flags_unserializable_payloads(tmp_path):
+    path = tmp_path / "pub.py"
+    path.write_text(BROKEN_BOUNDARY_MODULE)
+    _problems, flagged, n_sites = boundary_lint.lint_module(
+        str(path), "pub.py"
+    )
+    offenders = {(key, off) for _rel, key, off, _line in flagged}
+    assert ("publish@bad_payload", "a lambda") in offenders
+    assert ("publish@bad_payload", "a set literal") in offenders
+    # the submitted closure drags a lock across the shard boundary
+    assert ("submit-closure@bad_closure", "_lock") in offenders
+    # the clean Frame site is counted but not flagged
+    assert n_sites == 3
+    assert not any("fine" in key for key, _ in offenders)
+
+
+def test_boundary_lint_stale_waiver_is_an_error():
+    waivers = {
+        ("gpud_tpu/server/server.py", "publish@never_exists", "*"):
+            "points at nothing",
+    }
+    problems, _ = boundary_lint.run_full(waivers=waivers)
+    assert any("stale waiver" in p for p in problems)
+
+
+def test_boundary_lint_real_tree_clean():
+    problems, _notes = boundary_lint.run_full()
+    assert problems == []
+
+
+# -- schema_lint golden drift ------------------------------------------------
+
+def _mutated_golden(tmp_path, mutate):
+    """Copy the real golden, apply ``mutate(view)``, return an absolute
+    golden path usable as ``golden_rel`` (os.path.join ignores the root
+    when the second component is absolute)."""
+    real = os.path.join(schema_lint._repo_root(), schema_lint.GOLDEN_REL)
+    with open(real, encoding="utf-8") as f:
+        golden = json.load(f)
+    mutate(golden["view"])
+    path = tmp_path / "mutated_golden.json"
+    path.write_text(json.dumps(golden))
+    return str(path)
+
+
+def test_schema_lint_real_tree_matches_golden():
+    problems, notes = schema_lint.run_full()
+    assert problems == []
+    assert any("golden_version" in n for n in notes)
+
+
+def test_schema_lint_one_field_drift_fails(tmp_path):
+    def bump_predict_schema(view):
+        view["predict"]["schema"] = view["predict"]["schema"] + 1
+
+    golden = _mutated_golden(tmp_path, bump_predict_schema)
+    problems = schema_lint.run_full(golden_rel=golden)[0]
+    assert any("schema drift at predict.schema" in p for p in problems)
+    # the drift report tells the owner how to regenerate
+    assert any("--update-goldens" in p for p in problems)
+
+
+def test_schema_lint_renamed_journal_column_fails(tmp_path):
+    def rename_column(view):
+        cols = view["tables"]["journal"]["columns"]
+        cols[cols.index("dedupe_key")] = "dedup_key"
+
+    golden = _mutated_golden(tmp_path, rename_column)
+    problems = schema_lint.run_full(golden_rel=golden)[0]
+    assert any("tables.journal.columns" in p for p in problems)
+
+
+def test_schema_lint_dropped_batch_field_fails(tmp_path):
+    def drop_count(view):
+        del view["batch"]["frame"]["outbox_batch"]["count"]
+
+    golden = _mutated_golden(tmp_path, drop_count)
+    problems = schema_lint.run_full(golden_rel=golden)[0]
+    assert any("batch.frame.outbox_batch.count" in p for p in problems)
+
+
+def test_schema_lint_missing_golden_demands_generation(tmp_path):
+    problems = schema_lint.run_full(
+        golden_rel=str(tmp_path / "nope.json")
+    )[0]
+    assert any("golden missing" in p and "--update-goldens" in p
+               for p in problems)
+
+
+def test_update_goldens_is_idempotent_and_bumps_on_change(tmp_path):
+    # clean tree: regenerating the real golden writes nothing
+    _path, changed = schema_lint.update_golden()
+    assert changed is False
+    # stale golden: regeneration rewrites it and bumps the version
+    stale = _mutated_golden(
+        tmp_path, lambda view: view["predict"].update(schema=99)
+    )
+    with open(stale, encoding="utf-8") as f:
+        old_version = json.load(f)["golden_version"]
+    path, changed = schema_lint.update_golden(golden_rel=stale)
+    assert changed is True
+    with open(path, encoding="utf-8") as f:
+        fresh = json.load(f)
+    assert fresh["golden_version"] == old_version + 1
+    assert schema_lint.run_full(golden_rel=stale)[0] == []
+
+
+def test_lint_all_update_goldens_flag(capsys):
+    assert main(["--update-goldens"]) == 0
+    assert "unchanged" in capsys.readouterr().out
